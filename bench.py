@@ -1,0 +1,77 @@
+"""Headline benchmark: env-steps/sec/chip on the Atari-shaped pipeline.
+
+Runs the fused on-device training loop (act -> PixelPong step -> replay ->
+prioritized-style learner update cadence) on whatever single accelerator is
+present and reports the driver's north-star metric (BASELINE.json:2,5):
+env-steps/sec/chip against the 50k/sec/chip Ape-X target.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+BASELINE_ENV_STEPS_PER_SEC_PER_CHIP = 50_000.0  # BASELINE.json:5 target
+
+
+def main():
+    import jax
+
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.train_loop import make_fused_train
+
+    # BENCH_SMOKE=1 shrinks every dimension so the identical code path can be
+    # smoke-tested on a CPU dev box; default sizes target a real TPU chip.
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    num_envs = 8 if smoke else 128
+    chunk = 20 if smoke else 200
+    measure_s = 2.0 if smoke else 15.0
+
+    cfg = CONFIGS["atari"]
+    # Bench sizing: enough parallel envs to saturate the chip's batch dims,
+    # a replay ring bounded to fit HBM.
+    cfg = dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=num_envs),
+        replay=dataclasses.replace(cfg.replay,
+                                   capacity=2_048 if smoke else 65_536,
+                                   min_fill=128 if smoke else 4_096),
+        learner=dataclasses.replace(cfg.learner,
+                                    batch_size=32 if smoke else 256),
+    )
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run_chunk = make_fused_train(cfg, env, net)
+    run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
+
+    carry = init(jax.random.PRNGKey(0))
+    carry, _ = run(carry, chunk)  # compile + warmup
+    jax.block_until_ready(carry.learner.params)
+
+    t0 = time.perf_counter()
+    iters = 0
+    while time.perf_counter() - t0 < measure_s:
+        carry, metrics = run(carry, chunk)
+        jax.block_until_ready(carry.learner.params)
+        iters += chunk
+    dt = time.perf_counter() - t0
+
+    value = iters * num_envs / dt
+    print(json.dumps({
+        "metric": "env_steps_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "env-steps/sec/chip (synthetic 84x84 Atari-shaped pixel env,"
+                " Nature CNN, fused on-device actor+learner)",
+        "vs_baseline": round(value / BASELINE_ENV_STEPS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

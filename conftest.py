@@ -1,0 +1,21 @@
+"""Pytest bootstrap: force tests onto a virtual 8-device CPU mesh.
+
+Multi-chip sharding paths (shard_map/psum over the ICI mesh) are exercised on
+CPU with ``--xla_force_host_platform_device_count=8`` per SURVEY.md §4, so
+the full test suite runs anywhere, including boxes where a real accelerator
+is present. Note: a site hook may programmatically select an accelerator
+platform regardless of ``JAX_PLATFORMS``, so the CPU override must also go
+through ``jax.config`` (env vars alone are not enough), while XLA_FLAGS must
+be set before the backend initializes.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,25 @@
+"""dist_dqn_tpu — a TPU-native distributed deep-RL (DQN-family) framework.
+
+Brand-new design for JAX/XLA on TPU pods, with the capability surface of the
+``hbfs/dist-dqn`` reference (driver spec: /root/repo/BASELINE.json:5-12):
+
+* DQN (CartPole CPU-reference config, Atari Nature-CNN single-learner config)
+* Ape-X: distributed prioritized replay, many CPU actors, sharded multi-learner
+* R2D2: recurrent (LSTM) Q-network, sequence replay with burn-in
+* Rainbow / C51: distributional Q-learning on DM-Control pixels
+
+TPU-first architecture (NOT a port of the reference's CUDA/NCCL design):
+
+* forward + TD-loss + backward + Polyak sync compile into a single XLA ``jit``
+* multi-learner gradient allreduce = ``shard_map`` + ``psum`` over the ICI mesh
+* replay shards across TPU-VM host DRAM; on-device priority sampling (Pallas)
+* CPU rollout actors stream trajectories to the sharded buffer over the DCN
+* fully on-device (Anakin-style) training loops for JAX-native envs
+
+NOTE: the reference source was never mounted in this environment (SURVEY.md §0),
+so docstrings cite the driver spec (BASELINE.json:line), not reference files.
+"""
+
+__version__ = "0.1.0"
+
+from dist_dqn_tpu import config as config  # noqa: F401

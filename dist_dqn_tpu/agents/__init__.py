@@ -1,0 +1,2 @@
+from dist_dqn_tpu.agents.dqn import (  # noqa: F401
+    LearnerState, make_learner, make_actor_step)

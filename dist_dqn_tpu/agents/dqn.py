@@ -1,0 +1,180 @@
+"""The DQN-family learner: one jit-compiled train step for every head type.
+
+Covers the driver's single-jit requirement (BASELINE.json:5): Q-net forward,
+TD loss (scalar or C51), backward, optimizer update and target-network Polyak
+sync are all traced into one XLA program; ``donate_argnums`` lets XLA update
+parameters and optimizer state in place on device.
+
+The same ``train_step`` serves vanilla DQN, double-DQN, dueling, NoisyNet and
+C51 (BASELINE.json:7-9,11) — the variant is fixed at trace time by the
+network module and ``LearnerConfig``, so there is zero runtime dispatch in the
+compiled program. Per-example TD magnitudes are always returned as
+``priorities`` for the prioritized replay path (Ape-X, BASELINE.json:9).
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from dist_dqn_tpu.config import LearnerConfig
+from dist_dqn_tpu.ops import losses
+from dist_dqn_tpu.types import PyTree, Transition
+
+Array = jnp.ndarray
+
+
+class LearnerState(NamedTuple):
+    params: PyTree
+    target_params: PyTree
+    opt_state: PyTree
+    steps: Array  # scalar int32 — completed gradient steps
+    rng: Array    # for NoisyNet noise draws inside the train step
+
+
+def _apply(net: nn.Module, params: PyTree, obs: Array, rng: Optional[Array],
+           add_noise: bool) -> Array:
+    rngs = {"noise": rng} if (add_noise and rng is not None) else None
+    return net.apply(params, obs, add_noise=add_noise, rngs=rngs)
+
+
+def make_learner(net: nn.Module, cfg: LearnerConfig):
+    """Build (init, train_step) for a feed-forward Q-network.
+
+    train_step(state, batch, weights) -> (state, metrics); metrics includes
+    ``priorities`` [B] for replay priority updates.
+    """
+    tx_parts = []
+    if cfg.max_grad_norm:
+        tx_parts.append(optax.clip_by_global_norm(cfg.max_grad_norm))
+    tx_parts.append(optax.adam(cfg.learning_rate, eps=cfg.adam_eps))
+    tx = optax.chain(*tx_parts)
+
+    num_atoms = getattr(net, "num_atoms", 1)
+    distributional = num_atoms > 1
+    noisy = getattr(net, "noisy", False)
+
+    def init(rng: Array, obs_example: Array) -> LearnerState:
+        rng, k_param, k_noise = jax.random.split(rng, 3)
+        obs_b = jnp.expand_dims(obs_example, 0)
+        params = net.init({"params": k_param, "noise": k_noise}, obs_b,
+                          add_noise=noisy)
+        return LearnerState(
+            params=params,
+            # Distinct buffers: params and target_params are donated together
+            # by the fused loop, and XLA rejects aliased donated inputs.
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=tx.init(params),
+            steps=jnp.int32(0),
+            rng=rng,
+        )
+
+    def loss_fn(params: PyTree, target_params: PyTree, batch: Transition,
+                weights: Array, rng: Array) -> Tuple[Array, Tuple]:
+        k_online, k_next, k_target = jax.random.split(rng, 3)
+        if distributional:
+            logits = _apply(net, params, batch.obs, k_online, noisy)
+            logits_next_online = _apply(net, params, batch.next_obs, k_next,
+                                        noisy)
+            logits_next_target = _apply(net, target_params, batch.next_obs,
+                                        k_target, noisy)
+            atoms = net.atoms()
+            # Non-double = the same selection with the target net picking
+            # its own greedy action.
+            selector = (logits_next_online if cfg.double_dqn
+                        else logits_next_target)
+            next_probs = losses.categorical_double_q_probs(
+                selector, logits_next_target, atoms)
+            target_probs = losses.categorical_projection(
+                atoms, next_probs, batch.reward, batch.discount)
+            per_example = losses.categorical_td_loss(
+                logits, batch.action, target_probs)
+            priorities = per_example
+        else:
+            q = _apply(net, params, batch.obs, k_online, noisy)
+            q_next_target = _apply(net, target_params, batch.next_obs,
+                                   k_target, noisy)
+            if cfg.double_dqn:
+                q_next_online = _apply(net, params, batch.next_obs, k_next,
+                                       noisy)
+                boot = losses.double_q_bootstrap(q_next_online, q_next_target)
+            else:
+                boot = jnp.max(q_next_target, axis=-1)
+            if cfg.value_rescale:
+                boot = losses.inv_value_rescale(boot)
+            target = batch.reward + batch.discount * boot
+            if cfg.value_rescale:
+                target = losses.value_rescale(target)
+            qa = jnp.take_along_axis(
+                q, batch.action[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            td = qa - jax.lax.stop_gradient(target)
+            per_example = losses.huber(td, cfg.huber_delta)
+            priorities = jnp.abs(td)
+        loss = jnp.mean(weights * per_example)
+        aux = (jax.lax.stop_gradient(priorities),
+               jax.lax.stop_gradient(jnp.mean(per_example)))
+        return loss, aux
+
+    def train_step(state: LearnerState, batch: Transition,
+                   weights: Optional[Array] = None
+                   ) -> Tuple[LearnerState, dict]:
+        if weights is None:
+            weights = jnp.ones_like(batch.reward)
+        rng, k_loss = jax.random.split(state.rng)
+        (loss, (priorities, raw_loss)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, state.target_params, batch,
+                                   weights, k_loss)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        steps = state.steps + 1
+
+        if cfg.target_tau > 0.0:
+            # Soft Polyak sync every step (BASELINE.json:5).
+            target_params = jax.tree.map(
+                lambda t, p: t + cfg.target_tau * (p - t),
+                state.target_params, params)
+        else:
+            # Periodic hard copy, branch-free under jit.
+            do_sync = (steps % cfg.target_update_period) == 0
+            target_params = jax.tree.map(
+                lambda t, p: jnp.where(do_sync, p, t),
+                state.target_params, params)
+
+        new_state = LearnerState(params=params, target_params=target_params,
+                                 opt_state=opt_state, steps=steps, rng=rng)
+        metrics = {
+            "loss": loss,
+            "raw_loss": raw_loss,
+            "priorities": priorities,
+            "grad_norm": optax.global_norm(grads),
+            "mean_q_target_gap": jnp.mean(priorities),
+        }
+        return new_state, metrics
+
+    return init, train_step
+
+
+def make_actor_step(net: nn.Module) -> Callable:
+    """Epsilon-greedy acting on scalar Q-values (any head type).
+
+    act(params, obs, rng, epsilon) -> actions [B]. With a NoisyNet head,
+    exploration comes from parameter noise: pass epsilon=0 and noise is drawn
+    per call from ``rng``.
+    """
+    noisy = getattr(net, "noisy", False)
+
+    def act(params: PyTree, obs: Array, rng: Array, epsilon: Array) -> Array:
+        k_noise, k_eps, k_rand = jax.random.split(rng, 3)
+        rngs = {"noise": k_noise} if noisy else None
+        q = net.apply(params, obs, add_noise=noisy, rngs=rngs,
+                      method=net.q_values)
+        greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+        random_a = jax.random.randint(k_rand, greedy.shape, 0,
+                                      net.num_actions)
+        explore = jax.random.uniform(k_eps, greedy.shape) < epsilon
+        return jnp.where(explore, random_a, greedy)
+
+    return act

@@ -1,0 +1,186 @@
+"""Configuration system for the five driver configs (BASELINE.json:7-11).
+
+Frozen dataclasses so configs are hashable and can be closed over by ``jit``
+as static values. ``CONFIGS`` is the registry keyed by the names the train CLI
+accepts; each corresponds 1:1 to a driver config line.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkConfig:
+    """Q-network architecture knobs (models/qnets.py, models/recurrent.py)."""
+
+    torso: str = "nature"              # "mlp" | "nature" (84x84 Atari CNN)
+    mlp_features: Tuple[int, ...] = (256, 256)
+    hidden: int = 512                  # post-torso embedding width
+    dueling: bool = False              # dueling value/advantage streams
+    noisy: bool = False                # NoisyNet exploration heads (Rainbow)
+    num_atoms: int = 1                 # >1 => C51 distributional head
+    v_min: float = -10.0
+    v_max: float = 10.0
+    lstm_size: int = 0                 # >0 => recurrent core (R2D2)
+    compute_dtype: str = "float32"     # "bfloat16" for the TPU MXU path
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayConfig:
+    """Replay buffer knobs (replay/)."""
+
+    capacity: int = 100_000
+    prioritized: bool = False
+    priority_exponent: float = 0.6     # alpha
+    importance_exponent: float = 0.4   # beta (annealed -> 1.0 over training)
+    priority_eps: float = 1e-6
+    min_fill: int = 1_000              # learning starts after this many items
+    # R2D2 sequence replay (>0 enables sequence mode):
+    burn_in: int = 0
+    unroll_length: int = 0
+    sequence_stride: int = 0           # overlap between stored sequences
+
+
+@dataclasses.dataclass(frozen=True)
+class LearnerConfig:
+    """Optimizer / TD-learning knobs (agents/)."""
+
+    learning_rate: float = 1e-3
+    adam_eps: float = 1e-8
+    gamma: float = 0.99
+    n_step: int = 1
+    batch_size: int = 128
+    double_dqn: bool = True
+    huber_delta: float = 1.0
+    max_grad_norm: float = 10.0        # 0 disables clipping
+    # Target network sync (BASELINE.json:5 "target-network Polyak sync"):
+    target_update_period: int = 500    # hard copy every N steps (if tau == 0)
+    target_tau: float = 0.0            # >0 => soft Polyak every step
+    value_rescale: bool = False        # R2D2 h/h^-1 transform
+
+
+@dataclasses.dataclass(frozen=True)
+class ActorConfig:
+    """Rollout / exploration knobs (actors/, train loops)."""
+
+    num_envs: int = 16                 # vectorized envs per actor process
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 10_000
+    # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1) * alpha)
+    apex_epsilon_base: float = 0.4
+    apex_epsilon_alpha: float = 7.0
+    num_actors: int = 1                # actor processes (Ape-X: e.g. 256)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """One runnable experiment = env + net + replay + learner + actors."""
+
+    name: str
+    env_name: str                      # key into envs.make()
+    network: NetworkConfig = NetworkConfig()
+    replay: ReplayConfig = ReplayConfig()
+    learner: LearnerConfig = LearnerConfig()
+    actor: ActorConfig = ActorConfig()
+    total_env_steps: int = 500_000
+    train_every: int = 1               # learner updates per env *vector* step
+    updates_per_train: int = 1
+    eval_every_steps: int = 25_000
+    eval_episodes: int = 10
+    seed: int = 0
+
+
+# ---------------------------------------------------------------------------
+# The five driver configs (BASELINE.json:7-11), one entry each.
+# ---------------------------------------------------------------------------
+
+CARTPOLE = ExperimentConfig(
+    # BASELINE.json:7 — "CartPole-v1 single-process DQN (CPU ref)"
+    name="cartpole",
+    env_name="cartpole",
+    network=NetworkConfig(torso="mlp", mlp_features=(256, 256), hidden=0),
+    replay=ReplayConfig(capacity=50_000, min_fill=1_000),
+    learner=LearnerConfig(
+        learning_rate=1e-3, gamma=0.99, n_step=3, batch_size=128,
+        target_update_period=250,
+    ),
+    actor=ActorConfig(num_envs=16, epsilon_decay_steps=20_000),
+    total_env_steps=400_000,
+)
+
+ATARI = ExperimentConfig(
+    # BASELINE.json:8 — "Atari Pong/Breakout DQN (Nature CNN, 1 chip)"
+    name="atari",
+    env_name="pixel_pong",             # synthetic offline stand-in; real ALE
+    network=NetworkConfig(torso="nature", hidden=512,
+                          compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=200_000, min_fill=20_000),
+    learner=LearnerConfig(
+        learning_rate=6.25e-5, adam_eps=1.5e-4, gamma=0.99, n_step=3,
+        batch_size=256, target_update_period=2_000,
+    ),
+    actor=ActorConfig(num_envs=64, epsilon_decay_steps=250_000),
+    total_env_steps=10_000_000,
+    train_every=4,
+)
+
+APEX = ExperimentConfig(
+    # BASELINE.json:9 — "Ape-X DQN: 256 CPU actors + sharded learner on mesh"
+    name="apex",
+    env_name="pixel_pong",
+    network=NetworkConfig(torso="nature", hidden=512, dueling=True,
+                          compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=1_000_000, prioritized=True,
+                        priority_exponent=0.6, importance_exponent=0.4,
+                        min_fill=50_000),
+    learner=LearnerConfig(
+        learning_rate=1e-4, adam_eps=1.5e-4, gamma=0.99, n_step=3,
+        batch_size=512, double_dqn=True, target_update_period=2_500,
+    ),
+    actor=ActorConfig(num_envs=16, num_actors=256),
+    total_env_steps=100_000_000,
+)
+
+R2D2 = ExperimentConfig(
+    # BASELINE.json:10 — "R2D2 recurrent DQN (LSTM Q-net, seq replay, burn-in)"
+    name="r2d2",
+    env_name="pixel_pong",
+    network=NetworkConfig(torso="nature", hidden=512, dueling=True,
+                          lstm_size=512, compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=100_000, prioritized=True,
+                        priority_exponent=0.9, importance_exponent=0.6,
+                        burn_in=40, unroll_length=80, sequence_stride=40,
+                        min_fill=2_500),
+    learner=LearnerConfig(
+        learning_rate=1e-4, adam_eps=1e-3, gamma=0.997, n_step=5,
+        batch_size=64, double_dqn=True, target_update_period=2_500,
+        value_rescale=True,
+    ),
+    actor=ActorConfig(num_envs=16, num_actors=256),
+    total_env_steps=100_000_000,
+)
+
+RAINBOW = ExperimentConfig(
+    # BASELINE.json:11 — "Rainbow / C51 distributional DQN on DM-Control pixels"
+    name="rainbow",
+    env_name="dmc_pixels",             # synthetic pixel env offline fallback
+    network=NetworkConfig(torso="nature", hidden=512, dueling=True,
+                          noisy=True, num_atoms=51, v_min=-10.0, v_max=10.0,
+                          compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=200_000, prioritized=True,
+                        priority_exponent=0.5, importance_exponent=0.4,
+                        min_fill=20_000),
+    learner=LearnerConfig(
+        learning_rate=6.25e-5, adam_eps=1.5e-4, gamma=0.99, n_step=3,
+        batch_size=256, double_dqn=True, target_update_period=2_000,
+    ),
+    actor=ActorConfig(num_envs=64, epsilon_start=0.0, epsilon_end=0.0),
+    total_env_steps=10_000_000,
+    train_every=4,
+)
+
+CONFIGS: Dict[str, ExperimentConfig] = {
+    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW)
+}

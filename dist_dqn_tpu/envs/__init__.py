@@ -1,0 +1,32 @@
+"""Environment registry.
+
+Two env families:
+  * JAX-native envs (pure functions, jittable, vectorized, auto-resetting) —
+    these run *on device* and power the Anakin-style fused training loops.
+  * Host envs (gymnasium / dm_control adapters) — stepped by CPU actor
+    processes in the Ape-X/Sebulba configuration.
+"""
+from __future__ import annotations
+
+from dist_dqn_tpu.envs.cartpole import CartPole  # noqa: F401
+from dist_dqn_tpu.envs.pixel_pong import PixelPong  # noqa: F401
+
+
+def make_jax_env(name: str, **kwargs):
+    """Build a JAX-native env by registry name."""
+    if name == "cartpole":
+        return CartPole(**kwargs)
+    if name == "pixel_pong":
+        return PixelPong(**kwargs)
+    if name == "dmc_pixels":
+        # Offline stand-in: the DM-Control config runs on the synthetic pixel
+        # env when MuJoCo rendering is unavailable (no network / headless).
+        try:
+            from dist_dqn_tpu.envs.pixel_reacher import PixelReacher
+        except ImportError as e:
+            raise NotImplementedError(
+                "the DM-Control pixel env (and its synthetic stand-in) "
+                "lands in envs/pixel_reacher.py; not in this build yet"
+            ) from e
+        return PixelReacher(**kwargs)
+    raise KeyError(f"unknown JAX env {name!r}")

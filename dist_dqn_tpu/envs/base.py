@@ -1,0 +1,59 @@
+"""Base class for JAX-native environments.
+
+Envs implement single-instance ``reset`` / ``env_step`` as pure functions over
+a state pytree; the base class derives an auto-resetting ``step`` and
+vectorized ``v_reset`` / ``v_step`` via ``vmap``. Everything is jittable, so
+rollouts can live entirely on the TPU (Anakin-style) or be traced into the
+fused training loop.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dist_dqn_tpu.types import PyTree, StepOut
+
+Array = jnp.ndarray
+
+
+class JaxEnv:
+    """Interface: subclasses define ``num_actions`` / observation specs and
+    single-instance ``reset(rng) -> (state, obs)`` and ``env_step(state,
+    action) -> (state, next_obs, reward, terminated, truncated)``; the state
+    pytree must carry a per-env rng exposed via ``_reset_rng``.
+    """
+
+    num_actions: int
+    observation_shape: Tuple[int, ...]
+    observation_dtype = jnp.float32
+
+    def reset(self, rng: Array) -> Tuple[PyTree, Array]:
+        raise NotImplementedError
+
+    def env_step(self, state: PyTree, action: Array):
+        raise NotImplementedError
+
+    def _reset_rng(self, state: PyTree) -> Array:
+        raise NotImplementedError
+
+    # -- auto-reset single-instance step (scalar `done` broadcasts) ---------
+    def step(self, state: PyTree, action: Array) -> Tuple[PyTree, StepOut]:
+        new_state, next_obs, reward, terminated, truncated = self.env_step(
+            state, action)
+        done = jnp.logical_or(terminated, truncated)
+        reset_state, reset_obs = self.reset(self._reset_rng(new_state))
+        state_out = jax.tree.map(lambda r, c: jnp.where(done, r, c),
+                                 reset_state, new_state)
+        obs_out = jnp.where(done, reset_obs, next_obs)
+        return state_out, StepOut(obs=obs_out, next_obs=next_obs,
+                                  reward=reward, terminated=terminated,
+                                  truncated=truncated)
+
+    # -- vectorized forms ---------------------------------------------------
+    def v_reset(self, rng: Array, num_envs: int):
+        return jax.vmap(self.reset)(jax.random.split(rng, num_envs))
+
+    def v_step(self, state: PyTree, action: Array):
+        return jax.vmap(self.step)(state, action)

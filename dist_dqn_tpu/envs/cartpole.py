@@ -1,0 +1,80 @@
+"""Pure-JAX CartPole-v1 (the driver's CPU-reference config, BASELINE.json:7).
+
+Dynamics match the classic Barto-Sutton-Anderson cart-pole as published in
+gymnasium's CartPole-v1 (Euler integration, tau=0.02, force 10N, terminate at
+|x| > 2.4 or |theta| > 12 deg, truncate at 500 steps, reward 1 per step, start
+state uniform in [-0.05, 0.05]^4). Being pure JAX it runs vectorized on
+device, which is what lets the CartPole config train entirely inside one jit.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dist_dqn_tpu.envs.base import JaxEnv
+
+Array = jnp.ndarray
+
+_GRAVITY = 9.8
+_MASS_CART = 1.0
+_MASS_POLE = 0.1
+_TOTAL_MASS = _MASS_CART + _MASS_POLE
+_LENGTH = 0.5  # half the pole length
+_POLEMASS_LENGTH = _MASS_POLE * _LENGTH
+_FORCE_MAG = 10.0
+_TAU = 0.02
+_THETA_LIMIT = 12 * 2 * math.pi / 360
+_X_LIMIT = 2.4
+
+
+class CartPoleState(NamedTuple):
+    phys: Array  # [4] = (x, x_dot, theta, theta_dot)
+    t: Array     # scalar int32 step count
+    rng: Array   # per-env key for auto-reset
+
+
+class CartPole(JaxEnv):
+    num_actions = 2
+    observation_shape = (4,)
+    observation_dtype = jnp.float32
+
+    def __init__(self, max_steps: int = 500):
+        self.max_steps = max_steps
+
+    def reset(self, rng: Array) -> Tuple[CartPoleState, Array]:
+        rng, sub = jax.random.split(rng)
+        phys = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
+        return CartPoleState(phys=phys, t=jnp.int32(0), rng=rng), phys
+
+    def _reset_rng(self, state: CartPoleState) -> Array:
+        return state.rng
+
+    def env_step(self, state: CartPoleState, action: Array):
+        x, x_dot, theta, theta_dot = (state.phys[0], state.phys[1],
+                                      state.phys[2], state.phys[3])
+        force = jnp.where(action == 1, _FORCE_MAG, -_FORCE_MAG)
+        cos_t = jnp.cos(theta)
+        sin_t = jnp.sin(theta)
+        temp = (force + _POLEMASS_LENGTH * theta_dot ** 2 * sin_t) / _TOTAL_MASS
+        theta_acc = (_GRAVITY * sin_t - cos_t * temp) / (
+            _LENGTH * (4.0 / 3.0 - _MASS_POLE * cos_t ** 2 / _TOTAL_MASS))
+        x_acc = temp - _POLEMASS_LENGTH * theta_acc * cos_t / _TOTAL_MASS
+
+        x = x + _TAU * x_dot
+        x_dot = x_dot + _TAU * x_acc
+        theta = theta + _TAU * theta_dot
+        theta_dot = theta_dot + _TAU * theta_acc
+        phys = jnp.stack([x, x_dot, theta, theta_dot])
+
+        t = state.t + 1
+        terminated = (jnp.abs(x) > _X_LIMIT) | (jnp.abs(theta) > _THETA_LIMIT)
+        truncated = jnp.logical_and(t >= self.max_steps, ~terminated)
+        # Split so the continuing branch never reuses the key consumed by the
+        # auto-reset branch in JaxEnv.step.
+        rng, _ = jax.random.split(state.rng)
+        new_state = CartPoleState(phys=phys, t=t, rng=rng)
+        reward = jnp.float32(1.0)
+        return new_state, phys, reward, terminated, truncated

@@ -1,0 +1,1 @@
+from dist_dqn_tpu.models.qnets import QNetwork, NoisyDense, build_network  # noqa: F401

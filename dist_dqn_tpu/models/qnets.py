@@ -1,0 +1,186 @@
+"""Feed-forward Q-networks: MLP / Nature-CNN torsos, dueling, noisy, C51.
+
+One configurable ``QNetwork`` covers the feed-forward half of the driver's
+capability list (BASELINE.json:7-9,11): vanilla DQN heads, dueling streams,
+NoisyNet exploration and C51 distributional output. The recurrent (R2D2)
+network lives in ``models/recurrent.py``.
+
+TPU notes: convs/matmuls run in ``compute_dtype`` (bfloat16 on TPU) with
+float32 params and float32 head outputs, keeping the MXU fed without losing
+loss precision. All shapes are static; no data-dependent control flow.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dist_dqn_tpu.config import NetworkConfig
+
+Array = jnp.ndarray
+
+
+def _symmetric_uniform(scale: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+class NoisyDense(nn.Module):
+    """Factorized-Gaussian NoisyNet layer (Fortunato et al., 2018).
+
+    w = mu_w + sigma_w * (f(eps_in) f(eps_out)^T), f(x) = sign(x) sqrt(|x|).
+    Noise is drawn from the ``noise`` rng collection when ``add_noise`` is
+    True; otherwise the layer is the deterministic mu-only affine map.
+    """
+
+    features: int
+    sigma0: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, *, add_noise: bool = False) -> Array:
+        in_features = x.shape[-1]
+        bound = 1.0 / math.sqrt(in_features)
+        mu_w = self.param("mu_w", _symmetric_uniform(bound),
+                          (in_features, self.features))
+        mu_b = self.param("mu_b", _symmetric_uniform(bound), (self.features,))
+        sigma_w = self.param(
+            "sigma_w", nn.initializers.constant(self.sigma0 * bound),
+            (in_features, self.features))
+        sigma_b = self.param(
+            "sigma_b", nn.initializers.constant(self.sigma0 * bound),
+            (self.features,))
+
+        w = mu_w
+        b = mu_b
+        if add_noise:
+            key = self.make_rng("noise")
+            k_in, k_out = jax.random.split(key)
+            f = lambda e: jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+            eps_in = f(jax.random.normal(k_in, (in_features,)))
+            eps_out = f(jax.random.normal(k_out, (self.features,)))
+            w = w + sigma_w * (eps_in[:, None] * eps_out[None, :])
+            b = b + sigma_b * eps_out
+        y = jnp.dot(x.astype(self.dtype), w.astype(self.dtype))
+        return (y + b.astype(self.dtype)).astype(jnp.float32)
+
+
+class NatureCNN(nn.Module):
+    """The 84x84 Atari torso (Mnih et al., 2015): 8x8/4, 4x4/2, 3x3/1 convs."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        # x: [B, 84, 84, C] float in [0, 1]
+        x = x.astype(self.dtype)
+        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                        padding="VALID", dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return x.reshape((x.shape[0], -1))
+
+
+class MLPTorso(nn.Module):
+    features: Sequence[int]
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return x
+
+
+class QNetwork(nn.Module):
+    """Configurable feed-forward Q-network.
+
+    Output: [B, A] Q-values when ``num_atoms == 1``, else [B, A, num_atoms]
+    C51 logits (use ``atoms()`` for the support and expected-Q reduction).
+    """
+
+    num_actions: int
+    torso: str = "nature"
+    mlp_features: Tuple[int, ...] = (256, 256)
+    hidden: int = 512
+    dueling: bool = False
+    noisy: bool = False
+    num_atoms: int = 1
+    v_min: float = -10.0
+    v_max: float = 10.0
+    compute_dtype: jnp.dtype = jnp.float32
+
+    def atoms(self) -> Array:
+        return jnp.linspace(self.v_min, self.v_max, self.num_atoms)
+
+    def _head(self, name: str, features: int):
+        if self.noisy:
+            return NoisyDense(features, dtype=self.compute_dtype, name=name)
+        return nn.Dense(features, dtype=self.compute_dtype, name=name)
+
+    def _apply_head(self, layer, x, add_noise):
+        if self.noisy:
+            return layer(x, add_noise=add_noise)
+        return layer(x).astype(jnp.float32)
+
+    @nn.compact
+    def __call__(self, obs: Array, *, add_noise: bool = False) -> Array:
+        x = obs
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        if self.torso == "nature":
+            x = NatureCNN(dtype=self.compute_dtype)(x)
+        elif self.torso == "mlp":
+            x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
+        else:
+            raise ValueError(f"unknown torso {self.torso!r}")
+        if self.hidden:
+            x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype)(x))
+
+        a_out = self.num_actions * self.num_atoms
+        adv = self._apply_head(self._head("advantage", a_out), x, add_noise)
+        adv = adv.reshape((-1, self.num_actions, self.num_atoms))
+        if self.dueling:
+            val = self._apply_head(self._head("value", self.num_atoms),
+                                   x, add_noise)
+            val = val.reshape((-1, 1, self.num_atoms))
+            q = val + adv - jnp.mean(adv, axis=1, keepdims=True)
+        else:
+            q = adv
+        if self.num_atoms == 1:
+            return q[..., 0]
+        return q
+
+    def q_values(self, obs: Array, *, add_noise: bool = False) -> Array:
+        """Scalar Q-values [B, A] regardless of head type (for acting)."""
+        out = self(obs, add_noise=add_noise)
+        if self.num_atoms == 1:
+            return out
+        return jnp.sum(jax.nn.softmax(out, axis=-1) * self.atoms(), axis=-1)
+
+
+def build_network(cfg: NetworkConfig, num_actions: int) -> nn.Module:
+    """Build the Q-network for a config; recurrent if cfg.lstm_size > 0."""
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    if cfg.lstm_size:
+        try:
+            from dist_dqn_tpu.models.recurrent import RecurrentQNetwork
+        except ImportError as e:
+            raise NotImplementedError(
+                "recurrent (R2D2) networks land in models/recurrent.py; "
+                "this build does not include them yet") from e
+        return RecurrentQNetwork(
+            num_actions=num_actions, torso=cfg.torso,
+            mlp_features=cfg.mlp_features, hidden=cfg.hidden,
+            lstm_size=cfg.lstm_size, dueling=cfg.dueling,
+            compute_dtype=dtype)
+    return QNetwork(
+        num_actions=num_actions, torso=cfg.torso,
+        mlp_features=cfg.mlp_features, hidden=cfg.hidden,
+        dueling=cfg.dueling, noisy=cfg.noisy, num_atoms=cfg.num_atoms,
+        v_min=cfg.v_min, v_max=cfg.v_max, compute_dtype=dtype)

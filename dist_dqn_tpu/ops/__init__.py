@@ -1,0 +1,1 @@
+from dist_dqn_tpu.ops import losses as losses  # noqa: F401

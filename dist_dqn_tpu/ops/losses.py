@@ -1,0 +1,163 @@
+"""TD-loss family: n-step, double-Q, distributional (C51), R2D2 rescaling.
+
+All functions are pure and shape-polymorphic over leading batch dims, so the
+same code runs under ``jit``, ``vmap``, ``scan`` and ``shard_map``. The driver
+spec requires forward + TD-loss + backward to compile into a single XLA jit
+(BASELINE.json:5) — these ops are the loss half of that program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def huber(x: Array, delta: float = 1.0) -> Array:
+    """Huber loss elementwise; quadratic within ``delta``, linear outside."""
+    abs_x = jnp.abs(x)
+    quad = jnp.minimum(abs_x, delta)
+    return 0.5 * quad * quad + delta * (abs_x - quad)
+
+
+def n_step_from_rollout(rewards: Array, discounts: Array, n: int):
+    """Fold a rollout into n-step returns and compound discounts.
+
+    Args:
+      rewards:   [..., T] per-step rewards r_t.
+      discounts: [..., T] per-step discounts (gamma * (1 - terminated_t)).
+      n: static n-step horizon (loop is unrolled at trace time).
+
+    Returns:
+      (returns, discounts): each [..., T - n + 1] where
+        returns[t]   = sum_{k<n} (prod_{j<k} discounts[t+j]) * rewards[t+k]
+        discounts[t] = prod_{k<n} discounts[t+k]
+      so target_t = returns[t] + discounts[t] * bootstrap(obs[t+n]).
+    """
+    T = rewards.shape[-1]
+    if n < 1 or n > T:
+        raise ValueError(f"n_step={n} out of range for rollout length {T}")
+    out = T - n + 1
+    acc_r = jnp.zeros_like(rewards[..., :out])
+    acc_d = jnp.ones_like(acc_r)
+    for k in range(n):
+        acc_r = acc_r + acc_d * rewards[..., k:k + out]
+        acc_d = acc_d * discounts[..., k:k + out]
+    return acc_r, acc_d
+
+
+def double_q_bootstrap(q_next_online: Array, q_next_target: Array) -> Array:
+    """Double-DQN bootstrap: argmax from online net, value from target net."""
+    a_star = jnp.argmax(q_next_online, axis=-1)
+    return jnp.take_along_axis(
+        q_next_target, a_star[..., None], axis=-1)[..., 0]
+
+
+def q_learning_error(
+    q: Array,
+    actions: Array,
+    rewards: Array,
+    discounts: Array,
+    bootstrap_q: Array,
+) -> Array:
+    """TD error q(s,a) - (r + discount * bootstrap). Gradient flows into q only."""
+    qa = jnp.take_along_axis(q, actions[..., None].astype(jnp.int32),
+                             axis=-1)[..., 0]
+    target = rewards + discounts * bootstrap_q
+    return qa - jax.lax.stop_gradient(target)
+
+
+# ---------------------------------------------------------------------------
+# R2D2 value rescaling (BASELINE.json:10): h(x) = sign(x)(sqrt(|x|+1)-1)+eps*x
+# ---------------------------------------------------------------------------
+
+def value_rescale(x: Array, eps: float = 1e-3) -> Array:
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def inv_value_rescale(x: Array, eps: float = 1e-3) -> Array:
+    """Exact inverse of ``value_rescale`` (closed form)."""
+    inner = jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps))
+    return jnp.sign(x) * (jnp.square((inner - 1.0) / (2.0 * eps)) - 1.0)
+
+
+# ---------------------------------------------------------------------------
+# C51 / categorical distributional RL (BASELINE.json:11)
+# ---------------------------------------------------------------------------
+
+def categorical_projection(
+    atoms: Array,
+    next_probs: Array,
+    rewards: Array,
+    discounts: Array,
+) -> Array:
+    """Project the shifted/shrunk target distribution back onto ``atoms``.
+
+    The Bellman update maps atom z_j to Tz_j = r + discount * z_j; the mass of
+    each Tz_j is split linearly between its two neighbouring atoms.
+
+    Args:
+      atoms:      [M] support (uniformly spaced v_min..v_max).
+      next_probs: [B, M] target-net distribution at the chosen next action.
+      rewards:    [B] n-step returns.
+      discounts:  [B] compound discounts (0 at terminal).
+
+    Returns:
+      [B, M] projected target distribution (rows sum to 1).
+    """
+    v_min, v_max = atoms[0], atoms[-1]
+    m = atoms.shape[0]
+    dz = (v_max - v_min) / (m - 1)
+
+    tz = rewards[:, None] + discounts[:, None] * atoms[None, :]   # [B, M]
+    tz = jnp.clip(tz, v_min, v_max)
+    b = (tz - v_min) / dz                                         # in [0, M-1]
+    low = jnp.floor(b)
+    high = jnp.ceil(b)
+    # When b lands exactly on an atom, low == high and both weights below are
+    # zero; route the full mass through the `low` bucket in that case.
+    eq = (low == high).astype(next_probs.dtype)
+    w_low = (high - b) + eq
+    w_high = b - low
+
+    low_i = low.astype(jnp.int32)
+    high_i = high.astype(jnp.int32)
+    batch = jnp.arange(next_probs.shape[0])[:, None]
+    out = jnp.zeros_like(next_probs)
+    out = out.at[batch, low_i].add(next_probs * w_low)
+    out = out.at[batch, high_i].add(next_probs * w_high)
+    return out
+
+
+def categorical_double_q_probs(
+    logits_next_online: Array,
+    logits_next_target: Array,
+    atoms: Array,
+) -> Array:
+    """Pick next-greedy action by online expected value; return target probs.
+
+    Args: logits [B, A, M]; atoms [M]. Returns probs [B, M].
+    """
+    probs_online = jax.nn.softmax(logits_next_online, axis=-1)
+    q_online = jnp.sum(probs_online * atoms, axis=-1)             # [B, A]
+    a_star = jnp.argmax(q_online, axis=-1)                        # [B]
+    logits_t = jnp.take_along_axis(
+        logits_next_target, a_star[:, None, None], axis=1)[:, 0]  # [B, M]
+    return jax.nn.softmax(logits_t, axis=-1)
+
+
+def categorical_td_loss(
+    logits: Array,
+    actions: Array,
+    target_probs: Array,
+) -> Array:
+    """Per-example cross-entropy between projected target and predicted dist.
+
+    Args: logits [B, A, M]; actions [B]; target_probs [B, M] (stop-gradded).
+    Returns: [B] losses. The per-example loss also serves as the Ape-X/Rainbow
+    priority signal.
+    """
+    logits_a = jnp.take_along_axis(
+        logits, actions[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    log_p = jax.nn.log_softmax(logits_a, axis=-1)
+    return -jnp.sum(jax.lax.stop_gradient(target_probs) * log_p, axis=-1)

@@ -1,0 +1,3 @@
+from dist_dqn_tpu.replay.device import (  # noqa: F401
+    TimeRingState, time_ring_init, time_ring_add, time_ring_sample,
+    time_ring_can_sample)

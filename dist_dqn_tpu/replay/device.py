@@ -1,0 +1,173 @@
+"""On-device replay: a time-major ring buffer living in TPU HBM.
+
+Replaces the reference's host/GPU replay store (BASELINE.json:5) with a
+TPU-native layout: one ring of ``T`` time slots, each holding one step from
+all ``B`` parallel envs — leaves are ``[T, B, ...]``. The fused (Anakin)
+training loop appends one time slice per env step, entirely inside jit.
+
+n-step returns are computed *at sample time* from the stored per-step
+(reward, terminated, truncated) fields, which
+
+  * stores every frame exactly once (no n-step precomputation, no per-
+    transition copies of overlapping windows),
+  * handles episode boundaries exactly (rewards stop at the first done in
+    the window; bootstrap is taken at the first done or at horizon n), and
+  * bootstraps correctly through *truncation* (time-limit cuts) because the
+    window's successor observation is the stored next time slot.
+
+The same window-gather machinery is reused by the prioritized sampler
+(replay/prioritized_device.py) and the R2D2 sequence sampler.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dist_dqn_tpu.types import PyTree, Transition
+
+Array = jnp.ndarray
+
+
+class TimeRingState(NamedTuple):
+    obs: PyTree        # [T, B, ...] observation at each step (post auto-reset)
+    action: Array      # [T, B] int32
+    reward: Array      # [T, B] float32
+    terminated: Array  # [T, B] bool
+    truncated: Array   # [T, B] bool
+    final_obs: PyTree  # [T, B, ...] pre-reset successor obs, or None.
+    #   Only differs from the next slot's ``obs`` at episode ends; storing it
+    #   buys exact bootstrapping through *truncation*. When None (memory-
+    #   tight pixel configs), truncation is treated as terminal instead.
+    pos: Array         # scalar int32 — next slot to write
+    size: Array        # scalar int32 — slots filled (<= T)
+
+
+def time_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
+                   store_final_obs: bool = False) -> TimeRingState:
+    """Allocate a zeroed ring; ``obs_example`` fixes per-env obs shape/dtype."""
+    def zeros(x):
+        return jnp.zeros((num_slots, num_envs) + x.shape, x.dtype)
+
+    obs = jax.tree.map(zeros, obs_example)
+    return TimeRingState(
+        obs=obs,
+        action=jnp.zeros((num_slots, num_envs), jnp.int32),
+        reward=jnp.zeros((num_slots, num_envs), jnp.float32),
+        terminated=jnp.zeros((num_slots, num_envs), jnp.bool_),
+        truncated=jnp.zeros((num_slots, num_envs), jnp.bool_),
+        final_obs=jax.tree.map(zeros, obs_example) if store_final_obs
+        else None,
+        pos=jnp.int32(0),
+        size=jnp.int32(0),
+    )
+
+
+def time_ring_add(state: TimeRingState, obs: PyTree, action: Array,
+                  reward: Array, terminated: Array, truncated: Array,
+                  final_obs: PyTree = None) -> TimeRingState:
+    """Append one time slice (all envs) at ``pos``; wraps around."""
+    num_slots = state.action.shape[0]
+    p = state.pos
+
+    def write(buf, x):
+        return buf.at[p].set(x)
+
+    return TimeRingState(
+        obs=jax.tree.map(write, state.obs, obs),
+        action=write(state.action, action.astype(jnp.int32)),
+        reward=write(state.reward, reward.astype(jnp.float32)),
+        terminated=write(state.terminated, terminated),
+        truncated=write(state.truncated, truncated),
+        final_obs=jax.tree.map(write, state.final_obs, final_obs)
+        if state.final_obs is not None else None,
+        pos=(p + 1) % num_slots,
+        size=jnp.minimum(state.size + 1, num_slots),
+    )
+
+
+def time_ring_can_sample(state: TimeRingState, n_step: int) -> Array:
+    """True once windows of length ``n_step`` (plus bootstrap slot) exist."""
+    return state.size > n_step
+
+
+def _gather_window(field: Array, t_idx: Array, b_idx: Array, n: int,
+                   num_slots: int) -> Array:
+    """Gather [..., n] windows starting at ring slot ``t_idx`` for env
+    ``b_idx``. field: [T, B]; t_idx/b_idx: [S]. Returns [S, n]."""
+    offs = jnp.arange(n, dtype=jnp.int32)
+    tt = (t_idx[:, None] + offs[None, :]) % num_slots  # [S, n]
+    return field[tt, b_idx[:, None]]
+
+
+def compute_n_step(reward_w: Array, term_w: Array, trunc_w: Array,
+                   gamma: float) -> Tuple[Array, Array, Array]:
+    """Exact n-step return over a window with episode-boundary masking.
+
+    Args: [S, n] windows of per-step reward / terminated / truncated.
+    Returns:
+      returns:  [S] — sum_{k<=k*} gamma^k r_k, where k* is the first done in
+                the window (or n-1 if none).
+      discount: [S] — gamma^(k*+1) * (1 - terminated[k*]); zero on terminal,
+                a live bootstrap through truncation or a full window.
+      kstar:    [S] int32 — index of the last step inside the transition,
+                i.e. bootstrap observation lives at slot t + k* + 1.
+    """
+    n = reward_w.shape[-1]
+    done_w = jnp.logical_or(term_w, trunc_w)
+    # prefix_cont[k] = prod_{j<k} (1 - done_j): 1 until just after first done.
+    cont = 1.0 - done_w.astype(jnp.float32)
+    prefix = jnp.concatenate(
+        [jnp.ones_like(cont[:, :1]), jnp.cumprod(cont[:, :-1], axis=-1)],
+        axis=-1)
+    gammas = gamma ** jnp.arange(n, dtype=jnp.float32)
+    returns = jnp.sum(prefix * gammas[None, :] * reward_w, axis=-1)
+
+    any_done = jnp.any(done_w, axis=-1)
+    first_done = jnp.argmax(done_w, axis=-1).astype(jnp.int32)
+    kstar = jnp.where(any_done, first_done, n - 1)
+    term_at_k = jnp.take_along_axis(term_w, kstar[:, None], axis=-1)[:, 0]
+    discount = (gamma ** (kstar + 1).astype(jnp.float32)) * \
+        (1.0 - term_at_k.astype(jnp.float32))
+    return returns, discount, kstar
+
+
+def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
+                     n_step: int, gamma: float) -> Transition:
+    """Uniformly sample ``batch_size`` n-step transitions.
+
+    Valid window starts are the oldest ``size - n_step`` slots, so the
+    bootstrap slot (start + k* + 1 <= start + n_step) is always a stored,
+    in-order step of the same env.
+    """
+    num_slots, num_envs = state.action.shape
+    k_t, k_b = jax.random.split(rng)
+    num_valid = state.size - n_step  # traced; callers gate on can_sample
+    u = jax.random.randint(k_t, (batch_size,), 0, jnp.maximum(num_valid, 1))
+    t_idx = (state.pos - state.size + u) % num_slots
+    b_idx = jax.random.randint(k_b, (batch_size,), 0, num_envs)
+
+    reward_w = _gather_window(state.reward, t_idx, b_idx, n_step, num_slots)
+    term_w = _gather_window(state.terminated, t_idx, b_idx, n_step, num_slots)
+    trunc_w = _gather_window(state.truncated, t_idx, b_idx, n_step, num_slots)
+    returns, discount, kstar = compute_n_step(reward_w, term_w, trunc_w,
+                                              gamma)
+
+    obs = jax.tree.map(lambda x: x[t_idx, b_idx], state.obs)
+    action = state.action[t_idx, b_idx]
+    if state.final_obs is not None:
+        # Exact path: the stored pre-reset successor of step k*.
+        boot_t = (t_idx + kstar) % num_slots
+        next_obs = jax.tree.map(lambda x: x[boot_t, b_idx], state.final_obs)
+    else:
+        # The next slot's obs is post-reset at episode ends, so it is only a
+        # valid bootstrap within an episode: zero the discount at truncation
+        # (termination already zeroes it in compute_n_step).
+        trunc_at_k = jnp.take_along_axis(trunc_w, kstar[:, None],
+                                         axis=-1)[:, 0]
+        discount = discount * (1.0 - trunc_at_k.astype(jnp.float32))
+        boot_t = (t_idx + kstar + 1) % num_slots
+        next_obs = jax.tree.map(lambda x: x[boot_t, b_idx], state.obs)
+    return Transition(obs=obs, action=action, reward=returns,
+                      discount=discount, next_obs=next_obs)

@@ -1,0 +1,86 @@
+"""Training entrypoint: ``python -m dist_dqn_tpu.train --config cartpole``.
+
+The repo's own training entrypoint in the sense of BASELINE.json:5 — picks a
+driver config (BASELINE.json:7-11), builds the env/network/learner, and runs
+the fused on-device loop (JAX-native envs) with periodic greedy evaluation
+and throughput logging of the north-star metrics (env-steps/sec/chip,
+learner grad-steps/sec — BASELINE.json:2).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import numpy as np
+
+from dist_dqn_tpu.config import CONFIGS, ExperimentConfig
+from dist_dqn_tpu.envs import make_jax_env
+from dist_dqn_tpu.models import build_network
+from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
+
+
+def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
+          chunk_iters: int = 2000, log_fn=print):
+    """Run training; returns (final_carry, history list of metric dicts)."""
+    seed = cfg.seed if seed is None else seed
+    total = total_env_steps or cfg.total_env_steps
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+
+    init, run_chunk = make_fused_train(cfg, env, net)
+    evaluate = jax.jit(make_evaluator(cfg, env, net,
+                                      num_episodes=cfg.eval_episodes))
+    run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
+
+    rng = jax.random.PRNGKey(seed)
+    rng, k_init = jax.random.split(rng)
+    carry = init(k_init)
+
+    B = cfg.actor.num_envs
+    history = []
+    frames = 0
+    next_eval = 0
+    while frames < total:
+        t0 = time.perf_counter()
+        carry, metrics = run(carry, chunk_iters)
+        metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
+        dt = time.perf_counter() - t0
+        frames = int(metrics["env_frames"])
+        row = {
+            "env_frames": frames,
+            "episode_return": float(metrics["episode_return"]),
+            "loss": float(metrics["loss"]),
+            "env_steps_per_sec": chunk_iters * B / dt,
+            "grad_steps_per_sec": float(metrics["grad_steps_in_chunk"]) / dt,
+        }
+        if frames >= next_eval:
+            rng, k_eval = jax.random.split(rng)
+            row["eval_return"] = float(evaluate(carry.learner.params, k_eval))
+            next_eval = frames + cfg.eval_every_steps
+        history.append(row)
+        log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
+                           for k, v in row.items()}))
+    return carry, history
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", choices=sorted(CONFIGS), required=True)
+    parser.add_argument("--total-env-steps", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--chunk-iters", type=int, default=2000)
+    parser.add_argument("--platform", default=None,
+                        help="force a JAX platform (e.g. cpu, tpu); "
+                             "overrides site-level platform selection")
+    args = parser.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    cfg = CONFIGS[args.config]
+    train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
+          chunk_iters=args.chunk_iters)
+
+
+if __name__ == "__main__":
+    main()

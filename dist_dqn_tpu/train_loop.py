@@ -1,0 +1,186 @@
+"""Fused on-device training loop (Anakin-style, after Podracer/PAPERS.md:5).
+
+For JAX-native envs the entire act -> env.step -> replay.add -> sample ->
+train iteration is one ``lax.scan`` body compiled into a single XLA program:
+zero host round-trips in steady state, which is what a TPU needs to hit the
+driver's env-steps/sec/chip north star (BASELINE.json:2). Host envs (real
+Atari / DM-Control) instead use the Ape-X actor/learner split in
+``actors/`` — same learner, different feeding mechanism.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dist_dqn_tpu.agents.dqn import LearnerState, make_actor_step, \
+    make_learner
+from dist_dqn_tpu.config import ExperimentConfig
+from dist_dqn_tpu.envs.base import JaxEnv
+from dist_dqn_tpu.replay import device as ring
+from dist_dqn_tpu.types import PyTree
+
+Array = jnp.ndarray
+
+
+class TrainCarry(NamedTuple):
+    env_state: PyTree
+    obs: PyTree
+    replay: ring.TimeRingState
+    learner: LearnerState
+    rng: Array
+    iteration: Array       # scalar int32 — env vector steps taken
+    # Per-env episode trackers and chunk-level accumulators.
+    ep_return: Array       # [B]
+    completed_return: Array  # scalar float32 — sum of finished-episode returns
+    completed_count: Array   # scalar float32
+    loss_sum: Array
+    train_count: Array
+
+
+def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
+    """Returns (init, run_chunk): ``run_chunk(carry, num_iters)`` executes
+    ``num_iters`` fused iterations and reports aggregated metrics."""
+    if cfg.replay.prioritized:
+        raise NotImplementedError(
+            "prioritized replay in the fused loop lands with "
+            "replay/prioritized_device.py; not wired in this build yet")
+    init_learner, train_step = make_learner(net, cfg.learner)
+    act = make_actor_step(net)
+    B = cfg.actor.num_envs
+    num_slots = max(cfg.replay.capacity // B, cfg.learner.n_step + 2)
+    # Exact truncation bootstrap for cheap (non-pixel) observations; pixel
+    # rings skip final_obs to halve HBM use (truncation treated as terminal).
+    store_final = env.observation_dtype != jnp.uint8
+
+    epsilon = optax.linear_schedule(
+        cfg.actor.epsilon_start, cfg.actor.epsilon_end,
+        max(cfg.actor.epsilon_decay_steps // B, 1))
+
+    def can_train(replay: ring.TimeRingState, iteration: Array) -> Array:
+        filled = replay.size * B >= cfg.replay.min_fill
+        return jnp.logical_and(
+            jnp.logical_and(filled,
+                            ring.time_ring_can_sample(replay,
+                                                      cfg.learner.n_step)),
+            iteration % cfg.train_every == 0)
+
+    def init(rng: Array) -> TrainCarry:
+        k_env, k_learn, k_run = jax.random.split(rng, 3)
+        env_state, obs = env.v_reset(k_env, B)
+        # Envs may return obs aliasing their own state (e.g. CartPole's
+        # phys vector); the carry is donated, so every leaf must be distinct.
+        obs = jax.tree.map(jnp.copy, obs)
+        obs_example = jax.tree.map(lambda x: x[0], obs)
+        replay = ring.time_ring_init(num_slots, B, obs_example,
+                                     store_final_obs=store_final)
+        learner = init_learner(k_learn, obs_example)
+        zero = jnp.float32(0.0)
+        return TrainCarry(env_state=env_state, obs=obs, replay=replay,
+                          learner=learner, rng=k_run,
+                          iteration=jnp.int32(0),
+                          ep_return=jnp.zeros((B,), jnp.float32),
+                          completed_return=zero, completed_count=zero,
+                          loss_sum=zero, train_count=zero)
+
+    def one_iteration(carry: TrainCarry, _) -> Tuple[TrainCarry, None]:
+        rng, k_act, k_sample = jax.random.split(carry.rng, 3)
+        eps = epsilon(carry.iteration)
+        actions = act(carry.learner.params, carry.obs,
+                      k_act, eps)
+        env_state, out = env.v_step(carry.env_state, actions)
+        replay = ring.time_ring_add(
+            carry.replay, carry.obs, actions, out.reward, out.terminated,
+            out.truncated,
+            final_obs=out.next_obs if store_final else None)
+
+        def do_train(learner: LearnerState):
+            def one_update(l, key):
+                batch = ring.time_ring_sample(replay, key,
+                                              cfg.learner.batch_size,
+                                              cfg.learner.n_step,
+                                              cfg.learner.gamma)
+                l, metrics = train_step(l, batch)
+                return l, metrics["loss"]
+
+            keys = jax.random.split(k_sample, cfg.updates_per_train)
+            learner, losses_u = jax.lax.scan(one_update, learner, keys)
+            return (learner, jnp.sum(losses_u),
+                    jnp.float32(cfg.updates_per_train))
+
+        def no_train(learner: LearnerState):
+            return learner, jnp.float32(0.0), jnp.float32(0.0)
+
+        learner, loss, trained = jax.lax.cond(
+            can_train(replay, carry.iteration), do_train, no_train,
+            carry.learner)
+
+        done = jnp.logical_or(out.terminated, out.truncated)
+        ep_return = carry.ep_return + out.reward
+        completed_return = carry.completed_return + jnp.sum(
+            jnp.where(done, ep_return, 0.0))
+        completed_count = carry.completed_count + jnp.sum(
+            done.astype(jnp.float32))
+        ep_return = jnp.where(done, 0.0, ep_return)
+
+        return TrainCarry(
+            env_state=env_state, obs=out.obs, replay=replay, learner=learner,
+            rng=rng, iteration=carry.iteration + 1, ep_return=ep_return,
+            completed_return=completed_return,
+            completed_count=completed_count,
+            loss_sum=carry.loss_sum + loss,
+            train_count=carry.train_count + trained), None
+
+    def run_chunk(carry: TrainCarry, num_iters: int):
+        """Run ``num_iters`` iterations; returns (carry, summary metrics)."""
+        carry = carry._replace(completed_return=jnp.float32(0.0),
+                               completed_count=jnp.float32(0.0),
+                               loss_sum=jnp.float32(0.0),
+                               train_count=jnp.float32(0.0))
+        carry, _ = jax.lax.scan(one_iteration, carry, None, length=num_iters)
+        metrics = {
+            "env_frames": carry.iteration * B,
+            "episode_return":
+                carry.completed_return / jnp.maximum(carry.completed_count,
+                                                     1.0),
+            "episodes": carry.completed_count,
+            "loss": carry.loss_sum / jnp.maximum(carry.train_count, 1.0),
+            "grad_steps_in_chunk": carry.train_count,
+        }
+        return carry, metrics
+
+    return init, run_chunk
+
+
+def make_evaluator(cfg: ExperimentConfig, env: JaxEnv, net,
+                   num_episodes: int = 10, epsilon: float = 0.001):
+    """Greedy-policy evaluation: one episode per vmapped env instance.
+
+    Runs ``env.max_steps`` steps under a mask that freezes each env at its
+    first episode end; returns mean undiscounted return.
+    """
+    act = make_actor_step(net)
+
+    def evaluate(params: PyTree, rng: Array) -> Array:
+        k_reset, k_run = jax.random.split(rng)
+        env_state, obs = env.v_reset(k_reset, num_episodes)
+
+        def step(carry, _):
+            env_state, obs, ret, alive, rng = carry
+            rng, k = jax.random.split(rng)
+            a = act(params, obs, k, jnp.float32(epsilon))
+            env_state, out = env.v_step(env_state, a)
+            ret = ret + out.reward * alive
+            done = jnp.logical_or(out.terminated, out.truncated)
+            alive = jnp.logical_and(alive > 0, ~done).astype(jnp.float32)
+            return (env_state, out.obs, ret, alive, rng), None
+
+        init = (env_state, obs, jnp.zeros((num_episodes,), jnp.float32),
+                jnp.ones((num_episodes,), jnp.float32), k_run)
+        carry, _ = jax.lax.scan(step, init, None, length=env.max_steps)
+        returns = carry[2]
+        return jnp.mean(returns)
+
+    return evaluate

@@ -1,0 +1,59 @@
+"""Core datatypes shared across the framework.
+
+Everything here is a pytree-compatible NamedTuple so it can flow through
+``jit`` / ``scan`` / ``shard_map`` without adapters.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+# A pytree of arrays (params, optimizer state, batches, ...).
+PyTree = Any
+
+
+class StepOut(NamedTuple):
+    """Result of one (vectorized, auto-resetting) environment step.
+
+    Auto-reset semantics: when an episode ends, the environment resets
+    immediately and ``obs`` is the *new* episode's first observation, while
+    ``next_obs`` is the true successor of the acted-on observation (pre-reset)
+    so that bootstrapping on truncation stays correct.
+    """
+
+    obs: PyTree          # observation to act on next (post auto-reset)
+    next_obs: PyTree     # true successor of the acted-on obs (pre-reset)
+    reward: jnp.ndarray  # [B] float32
+    terminated: jnp.ndarray  # [B] bool — env reached a terminal state
+    truncated: jnp.ndarray   # [B] bool — episode cut by time limit
+
+
+class Transition(NamedTuple):
+    """One (possibly n-step) transition as stored in replay.
+
+    ``discount`` already folds in termination and gamma**n:
+    target = reward + discount * bootstrap(next_obs).
+    """
+
+    obs: PyTree
+    action: jnp.ndarray    # [B] int32
+    reward: jnp.ndarray    # [B] float32 — n-step return
+    discount: jnp.ndarray  # [B] float32 — gamma**n * (1 - terminated)
+    next_obs: PyTree
+
+
+class SequenceSample(NamedTuple):
+    """A batch of fixed-length sequences for R2D2 (BASELINE.json:10).
+
+    Time-major inner layout: arrays are [B, T, ...] with
+    T = burn_in + unroll_length. ``start_state`` is the recurrent state at the
+    first burn-in step, as stored by the actor that generated the sequence.
+    """
+
+    obs: PyTree            # [B, T, ...]
+    action: jnp.ndarray    # [B, T]
+    reward: jnp.ndarray    # [B, T]
+    discount: jnp.ndarray  # [B, T]
+    start_state: PyTree    # recurrent state, leaves [B, ...]
+    mask: jnp.ndarray      # [B, T] float32 — 1 where loss is valid

@@ -1,0 +1,89 @@
+"""Env tests: CartPole dynamics vs gymnasium; PixelPong contract checks."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.envs.cartpole import CartPole, CartPoleState
+from dist_dqn_tpu.envs.pixel_pong import PixelPong
+
+
+def test_cartpole_matches_gymnasium():
+    gymnasium = pytest.importorskip("gymnasium")
+    ref = gymnasium.make("CartPole-v1").unwrapped
+    ref.reset(seed=0)
+    env = CartPole()
+    # Force identical physical state.
+    phys = np.array([0.01, -0.02, 0.03, 0.04], np.float32)
+    ref.state = tuple(phys)
+    state = CartPoleState(phys=jnp.asarray(phys), t=jnp.int32(0),
+                          rng=jax.random.PRNGKey(0))
+    actions = [0, 1, 1, 0, 1, 0, 0, 1, 1, 1]
+    for a in actions:
+        ref_obs, ref_r, ref_term, _, _ = ref.step(a)
+        state, obs, r, term, trunc = env.env_step(state, jnp.int32(a))
+        np.testing.assert_allclose(np.asarray(obs), ref_obs, rtol=1e-5,
+                                   atol=1e-6)
+        assert float(r) == ref_r
+        assert bool(term) == ref_term
+        if ref_term:
+            break
+
+
+def test_cartpole_truncates_at_500():
+    env = CartPole()
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    state = state._replace(t=jnp.int32(499),
+                           phys=jnp.zeros(4))  # balanced: won't terminate
+    state, _, _, term, trunc = env.env_step(state, jnp.int32(0))
+    assert not bool(term)
+    assert bool(trunc)
+
+
+def test_cartpole_autoreset_vector_step():
+    env = CartPole()
+    step = jax.jit(env.v_step)
+    state, obs = env.v_reset(jax.random.PRNGKey(0), 4)
+    for _ in range(600):  # long enough that every env resets at least once
+        state, out = step(state, jnp.zeros((4,), jnp.int32))
+    assert out.obs.shape == (4, 4)
+    # All envs keep valid (non-terminal) current obs thanks to auto-reset.
+    assert np.all(np.abs(np.asarray(out.obs)[:, 0]) <= 2.4)
+
+
+def test_pixel_pong_contract():
+    env = PixelPong()
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    assert obs.shape == (84, 84, 4)
+    assert obs.dtype == jnp.uint8
+    assert np.asarray(obs).max() == 255  # ball rendered
+    total_r = []
+    step = jax.jit(env.step)
+    for i in range(500):
+        state, out = step(state, jnp.int32(i % 6))
+        total_r.append(float(out.reward))
+    rs = set(np.unique(np.asarray(total_r)))
+    assert rs <= {-1.0, 0.0, 1.0}
+    assert -1.0 in rs or 1.0 in rs  # someone scored within 500 steps
+
+
+def test_pixel_pong_episode_ends():
+    env = PixelPong(max_steps=300)
+    state, _ = env.reset(jax.random.PRNGKey(1))
+    step = jax.jit(env.env_step)
+    done = False
+    for _ in range(301):
+        state, _, _, term, trunc = step(state, jnp.int32(0))
+        if bool(term) or bool(trunc):
+            done = True
+            break
+    assert done
+
+
+def test_pixel_pong_framestack_shifts():
+    env = PixelPong()
+    state, obs = env.reset(jax.random.PRNGKey(2))
+    state2, out = env.step(state, jnp.int32(2))
+    # New stack's first 3 frames == old stack's last 3.
+    np.testing.assert_array_equal(np.asarray(out.obs)[:, :, :3],
+                                  np.asarray(obs)[:, :, 1:])

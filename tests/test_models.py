@@ -1,0 +1,91 @@
+"""Shape/behavior tests for the feed-forward Q-networks."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_dqn_tpu.models.qnets import NoisyDense, QNetwork
+
+
+def _init_and_apply(net, obs, add_noise=False, seed=0):
+    rngs = {"params": jax.random.PRNGKey(seed),
+            "noise": jax.random.PRNGKey(seed + 1)}
+    params = net.init(rngs, obs, add_noise=add_noise)
+    return params
+
+
+def test_mlp_qnet_shape():
+    net = QNetwork(num_actions=2, torso="mlp", mlp_features=(32, 32),
+                   hidden=0)
+    obs = jnp.zeros((5, 4))
+    params = _init_and_apply(net, obs)
+    q = net.apply(params, obs)
+    assert q.shape == (5, 2)
+
+
+def test_nature_cnn_shape_uint8():
+    net = QNetwork(num_actions=6, torso="nature", hidden=64)
+    obs = jnp.zeros((3, 84, 84, 4), jnp.uint8)
+    params = _init_and_apply(net, obs)
+    q = net.apply(params, obs)
+    assert q.shape == (3, 6)
+    assert q.dtype == jnp.float32
+
+
+def test_dueling_advantage_centering():
+    """In a dueling head, mean advantage over actions cancels: Q - V has
+    zero action-mean."""
+    net = QNetwork(num_actions=4, torso="mlp", mlp_features=(16,), hidden=8,
+                   dueling=True)
+    obs = jax.random.normal(jax.random.PRNGKey(2), (7, 5))
+    params = _init_and_apply(net, obs)
+    q = net.apply(params, obs)
+    assert q.shape == (7, 4)
+    # Dueling => identifiable decomposition: subtracting per-state max-mean
+    # cannot be tested directly, but action-mean equals the value stream.
+    # Check instead that Q varies across actions (advantage alive).
+    assert np.asarray(jnp.std(q, axis=1)).max() > 0
+
+
+def test_c51_head_shapes_and_q_values():
+    net = QNetwork(num_actions=3, torso="mlp", mlp_features=(16,), hidden=8,
+                   num_atoms=11, v_min=-2.0, v_max=2.0)
+    obs = jax.random.normal(jax.random.PRNGKey(3), (4, 6))
+    params = _init_and_apply(net, obs)
+    logits = net.apply(params, obs)
+    assert logits.shape == (4, 3, 11)
+    q = net.apply(params, obs, method=net.q_values)
+    assert q.shape == (4, 3)
+    # Expected value of a distribution on [-2, 2] stays in [-2, 2].
+    assert np.abs(np.asarray(q)).max() <= 2.0 + 1e-5
+
+
+def test_noisy_dense_determinism_and_noise():
+    layer = NoisyDense(8)
+    x = jnp.ones((2, 4))
+    params = layer.init({"params": jax.random.PRNGKey(0),
+                         "noise": jax.random.PRNGKey(1)}, x, add_noise=True)
+    # No-noise mode is deterministic and needs no rng.
+    y0 = layer.apply(params, x, add_noise=False)
+    y1 = layer.apply(params, x, add_noise=False)
+    np.testing.assert_allclose(y0, y1)
+    # Same noise key => same output; different keys => different output.
+    n0 = layer.apply(params, x, add_noise=True,
+                     rngs={"noise": jax.random.PRNGKey(7)})
+    n1 = layer.apply(params, x, add_noise=True,
+                     rngs={"noise": jax.random.PRNGKey(7)})
+    n2 = layer.apply(params, x, add_noise=True,
+                     rngs={"noise": jax.random.PRNGKey(8)})
+    np.testing.assert_allclose(n0, n1)
+    assert np.abs(np.asarray(n0 - n2)).max() > 1e-6
+    assert np.abs(np.asarray(n0 - y0)).max() > 1e-6
+
+
+def test_noisy_qnet_end_to_end():
+    net = QNetwork(num_actions=2, torso="mlp", mlp_features=(16,), hidden=8,
+                   noisy=True, dueling=True)
+    obs = jnp.ones((2, 4))
+    params = net.init({"params": jax.random.PRNGKey(0),
+                       "noise": jax.random.PRNGKey(1)}, obs, add_noise=True)
+    q = net.apply(params, obs, add_noise=True,
+                  rngs={"noise": jax.random.PRNGKey(2)})
+    assert q.shape == (2, 2)

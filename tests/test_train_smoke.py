@@ -1,0 +1,14 @@
+"""End-to-end: the fused CartPole config must learn (SURVEY.md §4 — the
+driver's CPU-reference config exists precisely for this, BASELINE.json:7)."""
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.train import train
+
+
+def test_cartpole_learns():
+    cfg = CONFIGS["cartpole"]
+    carry, history = train(cfg, total_env_steps=64_000, chunk_iters=1000,
+                           log_fn=lambda s: None)
+    evals = [row["eval_return"] for row in history if "eval_return" in row]
+    returns = [row["episode_return"] for row in history]
+    assert max(evals + returns) >= 150.0, (evals, returns)
+    assert all(abs(r["loss"]) < 1e3 for r in history)
