@@ -1,3 +1,8 @@
 from dist_dqn_tpu.replay.device import (  # noqa: F401
-    TimeRingState, time_ring_init, time_ring_add, time_ring_sample,
-    time_ring_can_sample)
+    TimeRingState, gather_transitions, time_ring_init, time_ring_add,
+    time_ring_sample, time_ring_can_sample)
+from dist_dqn_tpu.replay.host import (  # noqa: F401
+    PrioritizedHostReplay, SumTree, UniformHostReplay)
+from dist_dqn_tpu.replay.prioritized_device import (  # noqa: F401
+    PrioritizedRingState, prioritized_ring_add, prioritized_ring_init,
+    prioritized_ring_sample, prioritized_ring_update)

@@ -133,21 +133,14 @@ def compute_n_step(reward_w: Array, term_w: Array, trunc_w: Array,
     return returns, discount, kstar
 
 
-def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
-                     n_step: int, gamma: float) -> Transition:
-    """Uniformly sample ``batch_size`` n-step transitions.
+def gather_transitions(state: TimeRingState, t_idx: Array, b_idx: Array,
+                       n_step: int, gamma: float) -> Transition:
+    """Window-gather + n-step fold for explicit (t_idx, b_idx) pairs.
 
-    Valid window starts are the oldest ``size - n_step`` slots, so the
-    bootstrap slot (start + k* + 1 <= start + n_step) is always a stored,
-    in-order step of the same env.
+    Shared by the uniform and prioritized samplers so the episode-boundary
+    semantics live in exactly one place.
     """
-    num_slots, num_envs = state.action.shape
-    k_t, k_b = jax.random.split(rng)
-    num_valid = state.size - n_step  # traced; callers gate on can_sample
-    u = jax.random.randint(k_t, (batch_size,), 0, jnp.maximum(num_valid, 1))
-    t_idx = (state.pos - state.size + u) % num_slots
-    b_idx = jax.random.randint(k_b, (batch_size,), 0, num_envs)
-
+    num_slots = state.action.shape[0]
     reward_w = _gather_window(state.reward, t_idx, b_idx, n_step, num_slots)
     term_w = _gather_window(state.terminated, t_idx, b_idx, n_step, num_slots)
     trunc_w = _gather_window(state.truncated, t_idx, b_idx, n_step, num_slots)
@@ -171,3 +164,20 @@ def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
         next_obs = jax.tree.map(lambda x: x[boot_t, b_idx], state.obs)
     return Transition(obs=obs, action=action, reward=returns,
                       discount=discount, next_obs=next_obs)
+
+
+def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
+                     n_step: int, gamma: float) -> Transition:
+    """Uniformly sample ``batch_size`` n-step transitions.
+
+    Valid window starts are the oldest ``size - n_step`` slots, so the
+    bootstrap slot (start + k* + 1 <= start + n_step) is always a stored,
+    in-order step of the same env.
+    """
+    num_slots, num_envs = state.action.shape
+    k_t, k_b = jax.random.split(rng)
+    num_valid = state.size - n_step  # traced; callers gate on can_sample
+    u = jax.random.randint(k_t, (batch_size,), 0, jnp.maximum(num_valid, 1))
+    t_idx = (state.pos - state.size + u) % num_slots
+    b_idx = jax.random.randint(k_b, (batch_size,), 0, num_envs)
+    return gather_transitions(state, t_idx, b_idx, n_step, gamma)

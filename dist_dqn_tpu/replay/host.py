@@ -1,0 +1,170 @@
+"""Host-DRAM replay shard: vectorized numpy sum-tree + ring storage.
+
+This is the Ape-X side of the replay story (BASELINE.json:5): each TPU-VM
+host holds one replay *shard* in host DRAM, fed by CPU actors over the DCN
+transport (actors/). The learner samples batches here and ships them to the
+device; priorities flow back after each update.
+
+Unlike the sequential CUDA/host sum-trees the reference family uses, every
+operation is vectorized numpy: batched leaf writes propagate level-by-level
+(log2(cap) passes over *unique* parents), and sampling descends all queries
+through the tree in lockstep. No Python-per-item loops anywhere.
+
+The device-side sampler (replay/prioritized_device.py) is the fused-loop
+equivalent; both implement the same P(i) ~ p_i^alpha contract, tested against
+each other and against brute-force references.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class SumTree:
+    """Flat-array binary sum-tree with vectorized batch set/sample."""
+
+    def __init__(self, capacity: int):
+        self.capacity = 1
+        while self.capacity < capacity:
+            self.capacity *= 2
+        self.depth = self.capacity.bit_length() - 1
+        self.tree = np.zeros(2 * self.capacity, np.float64)
+
+    @property
+    def total(self) -> float:
+        return float(self.tree[1])
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        return self.tree[np.asarray(idx) + self.capacity]
+
+    def set(self, idx: np.ndarray, values: np.ndarray) -> None:
+        """Vectorized leaf write + upward propagation."""
+        leaf = np.asarray(idx, np.int64) + self.capacity
+        self.tree[leaf] = values
+        pos = np.unique(leaf >> 1)
+        while pos[0] >= 1:
+            self.tree[pos] = self.tree[2 * pos] + self.tree[2 * pos + 1]
+            if pos[0] == 1:
+                break
+            pos = np.unique(pos >> 1)
+
+    def sample(self, mass: np.ndarray) -> np.ndarray:
+        """Map mass values in [0, total) to leaf indices, all in lockstep."""
+        u = np.asarray(mass, np.float64).copy()
+        idx = np.ones(u.shape[0], np.int64)
+        for _ in range(self.depth):
+            left = 2 * idx
+            lmass = self.tree[left]
+            go_right = u >= lmass
+            u -= lmass * go_right
+            idx = left + go_right
+        return idx - self.capacity
+
+
+class PrioritizedHostReplay:
+    """One prioritized replay shard over host DRAM.
+
+    Items are dicts of numpy arrays (already n-step-folded transitions, or
+    R2D2 sequences); storage is allocated lazily from the first batch's
+    dtypes/shapes. ``alpha`` is folded into stored leaf mass at write time
+    (hosts rewrite leaves cheaply, unlike the device path).
+    """
+
+    def __init__(self, capacity: int, alpha: float = 0.6,
+                 priority_eps: float = 1e-6, seed: int = 0):
+        self.capacity = capacity
+        self.alpha = alpha
+        self.priority_eps = priority_eps
+        self.tree = SumTree(capacity)
+        self._data: Optional[Dict[str, np.ndarray]] = None
+        self._pos = 0
+        self._size = 0
+        self._max_priority = 1.0
+        self._rng = np.random.default_rng(seed)
+        # Cumulative counters for metrics (BASELINE.json:2 throughput).
+        self.added = 0
+        self.sampled = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def _ensure_storage(self, items: Dict[str, np.ndarray]) -> None:
+        if self._data is None:
+            self._data = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in items.items()
+            }
+
+    def add(self, items: Dict[str, np.ndarray],
+            priorities: Optional[np.ndarray] = None) -> None:
+        """Ring-write a batch; new items default to the running max priority."""
+        batch = next(iter(items.values())).shape[0]
+        self._ensure_storage(items)
+        idx = (self._pos + np.arange(batch)) % self.capacity
+        for k, v in items.items():
+            self._data[k][idx] = v
+        if priorities is None:
+            p = np.full(batch, self._max_priority)
+        else:
+            p = np.abs(np.asarray(priorities, np.float64)) \
+                + self.priority_eps
+            self._max_priority = max(self._max_priority, float(p.max()))
+        self.tree.set(idx, p ** self.alpha)
+        self._pos = int((self._pos + batch) % self.capacity)
+        self._size = int(min(self._size + batch, self.capacity))
+        self.added += batch
+
+    def sample(self, batch_size: int, beta: float
+               ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+        """Stratified prioritized sample -> (items, indices, IS weights)."""
+        if self._size == 0:
+            raise ValueError("sample() on an empty replay shard")
+        total = self.tree.total
+        strata = (np.arange(batch_size)
+                  + self._rng.uniform(size=batch_size)) / batch_size
+        idx = self.tree.sample(strata * total)
+        idx = np.minimum(idx, self._size - 1)
+        p_sel = self.tree.get(idx) / total
+        weights = (self._size * np.maximum(p_sel, 1e-12)) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        items = {k: v[idx] for k, v in self._data.items()}
+        self.sampled += batch_size
+        return items, idx, weights
+
+    def update_priorities(self, idx: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        p = np.abs(np.asarray(priorities, np.float64)) + self.priority_eps
+        self._max_priority = max(self._max_priority, float(p.max()))
+        self.tree.set(np.asarray(idx, np.int64), p ** self.alpha)
+
+
+class UniformHostReplay:
+    """Uniform ring-buffer shard with the same item interface."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._data: Optional[Dict[str, np.ndarray]] = None
+        self._pos = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, items: Dict[str, np.ndarray]) -> None:
+        batch = next(iter(items.values())).shape[0]
+        if self._data is None:
+            self._data = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in items.items()
+            }
+        idx = (self._pos + np.arange(batch)) % self.capacity
+        for k, v in items.items():
+            self._data[k][idx] = v
+        self._pos = int((self._pos + batch) % self.capacity)
+        self._size = int(min(self._size + batch, self.capacity))
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._data.items()}

@@ -1,0 +1,115 @@
+"""On-device prioritized replay over the time-ring (Ape-X, BASELINE.json:5,9).
+
+The reference keeps a host/GPU sum-tree; a sum-tree's sequential root-to-leaf
+descent is hostile to a TPU's vector units, so the TPU-native design samples
+by *stratified inverse-CDF*: mask invalid slots, cumsum the priority mass
+(one memory-bound pass XLA vectorizes well), and binary-search stratified
+uniforms into the CDF. O(N) per sample batch, but N floats of cumsum is
+microseconds in HBM at our sizes, it lives entirely on device, and the same
+pass yields the total mass needed for importance weights for free.
+
+Priorities are stored raw (|TD|); the alpha exponent is applied at sample
+time so alpha can anneal without rewriting the buffer.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dist_dqn_tpu.replay import device as ring
+from dist_dqn_tpu.types import PyTree, Transition
+
+Array = jnp.ndarray
+
+
+class PrioritizedRingState(NamedTuple):
+    ring: ring.TimeRingState
+    priorities: Array    # [T, B] float32, raw |TD| (+eps), 0 = never written
+    max_priority: Array  # scalar float32 running max — seed for new items
+
+
+class PrioritizedSample(NamedTuple):
+    batch: Transition
+    weights: Array  # [S] importance-sampling weights, batch-max normalized
+    t_idx: Array    # [S] ring slot of each sampled transition
+    b_idx: Array    # [S] env lane of each sampled transition
+
+
+def prioritized_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
+                          store_final_obs: bool = False
+                          ) -> PrioritizedRingState:
+    return PrioritizedRingState(
+        ring=ring.time_ring_init(num_slots, num_envs, obs_example,
+                                 store_final_obs=store_final_obs),
+        priorities=jnp.zeros((num_slots, num_envs), jnp.float32),
+        max_priority=jnp.float32(1.0),
+    )
+
+
+def prioritized_ring_add(state: PrioritizedRingState, obs: PyTree,
+                         action: Array, reward: Array, terminated: Array,
+                         truncated: Array, final_obs: PyTree = None
+                         ) -> PrioritizedRingState:
+    """Append a time slice; fresh transitions get the running max priority
+    so every new experience is sampled at least once with high probability
+    (standard Ape-X seeding)."""
+    p = state.ring.pos
+    new_ring = ring.time_ring_add(state.ring, obs, action, reward,
+                                  terminated, truncated, final_obs=final_obs)
+    priorities = state.priorities.at[p].set(
+        jnp.full((state.priorities.shape[1],), state.max_priority))
+    return PrioritizedRingState(ring=new_ring, priorities=priorities,
+                                max_priority=state.max_priority)
+
+
+def _valid_start_mask(state: ring.TimeRingState, n_step: int) -> Array:
+    """[T] bool — slots that are valid n-step window starts (same region the
+    uniform sampler draws from: the oldest size - n_step slots)."""
+    num_slots = state.action.shape[0]
+    t = jnp.arange(num_slots, dtype=jnp.int32)
+    oldest = (state.pos - state.size) % num_slots
+    offset = (t - oldest) % num_slots
+    return offset < (state.size - n_step)
+
+
+def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
+                            batch_size: int, n_step: int, gamma: float,
+                            alpha: float, beta: Array
+                            ) -> PrioritizedSample:
+    """Stratified sample ~ P(i) = p_i^alpha / sum p^alpha over valid slots."""
+    num_slots, num_envs = state.priorities.shape
+    mask = _valid_start_mask(state.ring, n_step)                  # [T]
+    w = jnp.where(mask[:, None], state.priorities ** alpha, 0.0)  # [T, B]
+    flat = w.reshape(-1)
+    cdf = jnp.cumsum(flat)
+    total = cdf[-1]
+
+    # Stratified uniforms: one per equal mass bucket => low-variance sample.
+    u = (jnp.arange(batch_size, dtype=jnp.float32)
+         + jax.random.uniform(rng, (batch_size,))) / batch_size * total
+    idx = jnp.clip(jnp.searchsorted(cdf, u), 0, flat.shape[0] - 1)
+    t_idx = (idx // num_envs).astype(jnp.int32)
+    b_idx = (idx % num_envs).astype(jnp.int32)
+
+    # Importance weights: (N * P(i))^-beta, normalized by the batch max.
+    n_valid = (jnp.sum(mask.astype(jnp.float32)) * num_envs)
+    p_sel = jnp.maximum(flat[idx], 1e-12) / jnp.maximum(total, 1e-12)
+    weights = (n_valid * p_sel) ** (-beta)
+    weights = weights / jnp.maximum(jnp.max(weights), 1e-12)
+
+    batch = ring.gather_transitions(state.ring, t_idx, b_idx, n_step, gamma)
+    return PrioritizedSample(batch=batch, weights=weights, t_idx=t_idx,
+                             b_idx=b_idx)
+
+
+def prioritized_ring_update(state: PrioritizedRingState, t_idx: Array,
+                            b_idx: Array, new_priorities: Array,
+                            eps: float = 1e-6) -> PrioritizedRingState:
+    """Write back learner TD magnitudes for the sampled transitions."""
+    p = jnp.abs(new_priorities) + eps
+    priorities = state.priorities.at[t_idx, b_idx].set(p)
+    return PrioritizedRingState(
+        ring=state.ring, priorities=priorities,
+        max_priority=jnp.maximum(state.max_priority, jnp.max(p)))

@@ -20,6 +20,7 @@ from dist_dqn_tpu.agents.dqn import LearnerState, make_actor_step, \
 from dist_dqn_tpu.config import ExperimentConfig
 from dist_dqn_tpu.envs.base import JaxEnv
 from dist_dqn_tpu.replay import device as ring
+from dist_dqn_tpu.replay import prioritized_device as pring
 from dist_dqn_tpu.types import PyTree
 
 Array = jnp.ndarray
@@ -28,7 +29,7 @@ Array = jnp.ndarray
 class TrainCarry(NamedTuple):
     env_state: PyTree
     obs: PyTree
-    replay: ring.TimeRingState
+    replay: PyTree         # TimeRingState or PrioritizedRingState
     learner: LearnerState
     rng: Array
     iteration: Array       # scalar int32 — env vector steps taken
@@ -43,10 +44,7 @@ class TrainCarry(NamedTuple):
 def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
     """Returns (init, run_chunk): ``run_chunk(carry, num_iters)`` executes
     ``num_iters`` fused iterations and reports aggregated metrics."""
-    if cfg.replay.prioritized:
-        raise NotImplementedError(
-            "prioritized replay in the fused loop lands with "
-            "replay/prioritized_device.py; not wired in this build yet")
+    prioritized = cfg.replay.prioritized
     init_learner, train_step = make_learner(net, cfg.learner)
     act = make_actor_step(net)
     B = cfg.actor.num_envs
@@ -58,13 +56,23 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
     epsilon = optax.linear_schedule(
         cfg.actor.epsilon_start, cfg.actor.epsilon_end,
         max(cfg.actor.epsilon_decay_steps // B, 1))
+    # PER importance exponent anneals beta0 -> 1 over the configured run.
+    total_iters = max(cfg.total_env_steps // B, 1)
+    beta0 = cfg.replay.importance_exponent
 
-    def can_train(replay: ring.TimeRingState, iteration: Array) -> Array:
-        filled = replay.size * B >= cfg.replay.min_fill
+    def beta_at(iteration: Array) -> Array:
+        frac = jnp.minimum(iteration.astype(jnp.float32) / total_iters, 1.0)
+        return beta0 + (1.0 - beta0) * frac
+
+    def _ring_of(replay) -> ring.TimeRingState:
+        return replay.ring if prioritized else replay
+
+    def can_train(replay, iteration: Array) -> Array:
+        r = _ring_of(replay)
+        filled = r.size * B >= cfg.replay.min_fill
         return jnp.logical_and(
             jnp.logical_and(filled,
-                            ring.time_ring_can_sample(replay,
-                                                      cfg.learner.n_step)),
+                            ring.time_ring_can_sample(r, cfg.learner.n_step)),
             iteration % cfg.train_every == 0)
 
     def init(rng: Array) -> TrainCarry:
@@ -74,8 +82,12 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
         # phys vector); the carry is donated, so every leaf must be distinct.
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
-        replay = ring.time_ring_init(num_slots, B, obs_example,
-                                     store_final_obs=store_final)
+        if prioritized:
+            replay = pring.prioritized_ring_init(
+                num_slots, B, obs_example, store_final_obs=store_final)
+        else:
+            replay = ring.time_ring_init(num_slots, B, obs_example,
+                                         store_final_obs=store_final)
         learner = init_learner(k_learn, obs_example)
         zero = jnp.float32(0.0)
         return TrainCarry(env_state=env_state, obs=obs, replay=replay,
@@ -91,31 +103,48 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
         actions = act(carry.learner.params, carry.obs,
                       k_act, eps)
         env_state, out = env.v_step(carry.env_state, actions)
-        replay = ring.time_ring_add(
-            carry.replay, carry.obs, actions, out.reward, out.terminated,
-            out.truncated,
-            final_obs=out.next_obs if store_final else None)
+        add = (pring.prioritized_ring_add if prioritized
+               else ring.time_ring_add)
+        replay = add(carry.replay, carry.obs, actions, out.reward,
+                     out.terminated, out.truncated,
+                     final_obs=out.next_obs if store_final else None)
+        beta = beta_at(carry.iteration)
 
-        def do_train(learner: LearnerState):
-            def one_update(l, key):
-                batch = ring.time_ring_sample(replay, key,
-                                              cfg.learner.batch_size,
-                                              cfg.learner.n_step,
-                                              cfg.learner.gamma)
-                l, metrics = train_step(l, batch)
-                return l, metrics["loss"]
+        def do_train(operand):
+            learner, rep = operand
+
+            def one_update(c, key):
+                l, rep = c
+                if prioritized:
+                    s = pring.prioritized_ring_sample(
+                        rep, key, cfg.learner.batch_size, cfg.learner.n_step,
+                        cfg.learner.gamma, cfg.replay.priority_exponent,
+                        beta)
+                    l, metrics = train_step(l, s.batch, s.weights)
+                    rep = pring.prioritized_ring_update(
+                        rep, s.t_idx, s.b_idx, metrics["priorities"],
+                        eps=cfg.replay.priority_eps)
+                else:
+                    batch = ring.time_ring_sample(rep, key,
+                                                  cfg.learner.batch_size,
+                                                  cfg.learner.n_step,
+                                                  cfg.learner.gamma)
+                    l, metrics = train_step(l, batch)
+                return (l, rep), metrics["loss"]
 
             keys = jax.random.split(k_sample, cfg.updates_per_train)
-            learner, losses_u = jax.lax.scan(one_update, learner, keys)
-            return (learner, jnp.sum(losses_u),
+            (learner, rep), losses_u = jax.lax.scan(one_update,
+                                                    (learner, rep), keys)
+            return (learner, rep, jnp.sum(losses_u),
                     jnp.float32(cfg.updates_per_train))
 
-        def no_train(learner: LearnerState):
-            return learner, jnp.float32(0.0), jnp.float32(0.0)
+        def no_train(operand):
+            learner, rep = operand
+            return learner, rep, jnp.float32(0.0), jnp.float32(0.0)
 
-        learner, loss, trained = jax.lax.cond(
+        learner, replay, loss, trained = jax.lax.cond(
             can_train(replay, carry.iteration), do_train, no_train,
-            carry.learner)
+            (carry.learner, replay))
 
         done = jnp.logical_or(out.terminated, out.truncated)
         ep_return = carry.ep_return + out.reward
