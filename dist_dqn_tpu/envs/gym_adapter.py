@@ -1,0 +1,175 @@
+"""Host (CPU) environment adapters: gymnasium vector envs + Atari pipeline.
+
+These are the envs the Ape-X CPU rollout actors step (BASELINE.json:5,9) —
+ordinary Python/numpy on the host, feeding trajectories to the sharded replay
+over the DCN transport. The JAX-native envs in this package are for the fused
+on-device loop; this adapter is for *real* external envs: CartPole-v1 for
+the CPU-reference config, ALE Atari (when ``ale-py`` is present — it is not
+in the offline image, SURVEY.md §7 [ENV]) and anything gymnasium-compatible.
+
+Atari preprocessing follows the standard Nature/ALE recipe: frame-skip with
+2-frame max-pooling, grayscale, 84x84 area resize, 4-frame stacking, reward
+clipping. Implemented in pure numpy so actors have no JAX dependency.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _area_resize_84(frame: np.ndarray) -> np.ndarray:
+    """Grayscale [H, W] -> [84, 84] by area averaging (pure numpy).
+
+    Works for ALE's 210x160 frames via interpolation to a 84x multiple grid:
+    we use simple bilinear sampling which is indistinguishable for training
+    purposes and keeps the actor dependency-free.
+    """
+    h, w = frame.shape
+    ys = (np.arange(84) + 0.5) * h / 84 - 0.5
+    xs = (np.arange(84) + 0.5) * w / 84 - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int32), 0, h - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int32), 0, w - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    f = frame.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(np.uint8)
+
+
+def _to_gray(frame: np.ndarray) -> np.ndarray:
+    if frame.ndim == 2:
+        return frame
+    return (0.299 * frame[..., 0] + 0.587 * frame[..., 1]
+            + 0.114 * frame[..., 2]).astype(np.uint8)
+
+
+class AtariPreprocessing:
+    """Single-env Atari pipeline: skip/max-pool/gray/resize/stack/clip."""
+
+    def __init__(self, env, frame_skip: int = 4, stack: int = 4,
+                 clip_rewards: bool = True):
+        self.env = env
+        self.frame_skip = frame_skip
+        self.stack = stack
+        self.clip_rewards = clip_rewards
+        self._frames = np.zeros((84, 84, stack), np.uint8)
+
+    @property
+    def num_actions(self) -> int:
+        return int(self.env.action_space.n)
+
+    def _obs(self, frame: np.ndarray) -> np.ndarray:
+        processed = _area_resize_84(_to_gray(frame))
+        self._frames = np.concatenate(
+            [self._frames[:, :, 1:], processed[:, :, None]], axis=2)
+        return self._frames.copy()
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        frame, _ = self.env.reset(seed=seed)
+        processed = _area_resize_84(_to_gray(np.asarray(frame)))
+        self._frames = np.repeat(processed[:, :, None], self.stack, axis=2)
+        return self._frames.copy()
+
+    def step(self, action: int):
+        total_r, terminated, truncated = 0.0, False, False
+        last_two: List[np.ndarray] = []
+        for _ in range(self.frame_skip):
+            frame, r, term, trunc, _ = self.env.step(action)
+            total_r += float(r)
+            last_two.append(np.asarray(frame))
+            last_two = last_two[-2:]
+            terminated, truncated = term, trunc
+            if term or trunc:
+                break
+        pooled = (np.maximum(*last_two) if len(last_two) == 2
+                  else last_two[-1])
+        if self.clip_rewards:
+            total_r = float(np.clip(total_r, -1.0, 1.0))
+        return self._obs(pooled), total_r, terminated, truncated
+
+
+class HostVectorEnv:
+    """Synchronous vector of host envs with auto-reset, numpy in/out.
+
+    Mirrors the JaxEnv ``v_step`` contract (obs / next_obs / reward /
+    terminated / truncated) so actors can swap between JAX-native and host
+    envs without touching the trajectory code.
+    """
+
+    def __init__(self, make_fn, num_envs: int, seed: int = 0):
+        self.envs = [make_fn() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self._seed = seed
+
+    @property
+    def num_actions(self) -> int:
+        e = self.envs[0]
+        return (e.num_actions if hasattr(e, "num_actions")
+                else int(e.action_space.n))
+
+    def reset(self) -> np.ndarray:
+        obs = [self._reset_one(e, self._seed + i)
+               for i, e in enumerate(self.envs)]
+        return np.stack(obs)
+
+    @staticmethod
+    def _reset_one(env, seed):
+        out = env.reset(seed=seed)
+        return out[0] if isinstance(out, tuple) else out
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                        np.ndarray]:
+        """Returns (obs, next_obs, reward, terminated, truncated); ``obs``
+        is post-auto-reset, ``next_obs`` the true pre-reset successor."""
+        obs_l, next_l, r_l, te_l, tr_l = [], [], [], [], []
+        for env, a in zip(self.envs, actions):
+            out = env.step(int(a))
+            if len(out) == 5:  # raw gymnasium env
+                nxt, r, term, trunc, _ = out
+            else:              # AtariPreprocessing
+                nxt, r, term, trunc = out
+            nxt = np.asarray(nxt)
+            if term or trunc:
+                obs_l.append(self._reset_one(env, None))
+            else:
+                obs_l.append(nxt)
+            next_l.append(nxt)
+            r_l.append(r)
+            te_l.append(term)
+            tr_l.append(trunc)
+        return (np.stack(obs_l), np.stack(next_l),
+                np.asarray(r_l, np.float32), np.asarray(te_l),
+                np.asarray(tr_l))
+
+
+def make_host_env(name: str, num_envs: int, seed: int = 0) -> HostVectorEnv:
+    """Build a host vector env by name.
+
+    ``"CartPole-v1"`` etc. -> plain gymnasium; ``"ale:<Game>"`` -> ALE with
+    Atari preprocessing (requires ale-py; raises a clear error otherwise).
+    """
+    import gymnasium
+
+    if name.startswith("ale:"):
+        game = name.split(":", 1)[1]
+
+        def make_fn():
+            try:
+                env = gymnasium.make(f"{game}NoFrameskip-v4")
+            except gymnasium.error.Error as e:
+                raise NotImplementedError(
+                    f"ALE Atari ({game}) needs ale-py, which is not in this "
+                    "offline image; use the synthetic pixel_pong env or "
+                    "install ale-py") from e
+            return AtariPreprocessing(env)
+    else:
+        def make_fn():
+            return gymnasium.make(name)
+
+    return HostVectorEnv(make_fn, num_envs, seed=seed)

@@ -1,0 +1,1 @@
+from dist_dqn_tpu.utils.metrics import RateTracker, MetricLogger  # noqa: F401

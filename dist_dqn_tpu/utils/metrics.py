@@ -1,0 +1,64 @@
+"""First-class throughput metrics (BASELINE.json:2).
+
+env-steps/sec/chip and learner grad-steps/sec are the framework's north-star
+numbers, so they get a dedicated, dependency-free implementation used by the
+train CLI, the Ape-X runtime and bench.py alike.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+
+class RateTracker:
+    """Windowed rate estimator for a monotonically increasing counter."""
+
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = window_s
+        self._events = []  # (t, count) pairs
+
+    def update(self, count: float, now: Optional[float] = None) -> None:
+        now = time.perf_counter() if now is None else now
+        self._events.append((now, count))
+        cutoff = now - self.window_s
+        while len(self._events) > 2 and self._events[0][0] < cutoff:
+            self._events.pop(0)
+
+    def rate(self) -> float:
+        if len(self._events) < 2:
+            return 0.0
+        (t0, c0), (t1, c1) = self._events[0], self._events[-1]
+        return (c1 - c0) / max(t1 - t0, 1e-9)
+
+
+class MetricLogger:
+    """Accumulates scalar metrics; emits one JSON line per flush."""
+
+    def __init__(self, log_fn=print, num_chips: int = 1):
+        self.log_fn = log_fn
+        self.num_chips = max(num_chips, 1)
+        self.env_steps = RateTracker()
+        self.grad_steps = RateTracker()
+        self._extra: Dict[str, float] = {}
+
+    def record(self, env_steps: Optional[float] = None,
+               grad_steps: Optional[float] = None,
+               **extra: float) -> None:
+        now = time.perf_counter()
+        if env_steps is not None:
+            self.env_steps.update(env_steps, now)
+        if grad_steps is not None:
+            self.grad_steps.update(grad_steps, now)
+        self._extra.update(extra)
+
+    def flush(self) -> Dict[str, float]:
+        row = {
+            "env_steps_per_sec_per_chip":
+                round(self.env_steps.rate() / self.num_chips, 2),
+            "grad_steps_per_sec": round(self.grad_steps.rate(), 2),
+        }
+        row.update({k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in self._extra.items()})
+        self.log_fn(json.dumps(row))
+        return row

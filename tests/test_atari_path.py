@@ -1,0 +1,37 @@
+"""Single-chip Atari-shaped path (BASELINE.json:8): fused loop over the
+synthetic 84x84 pixel env with the Nature CNN, small sizes for CPU CI."""
+import dataclasses
+
+import jax
+
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.envs import make_jax_env
+from dist_dqn_tpu.models import build_network
+from dist_dqn_tpu.train_loop import make_fused_train
+
+
+def test_atari_config_fused_smoke():
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, hidden=64,
+                                    compute_dtype="float32"),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        replay=dataclasses.replace(cfg.replay, capacity=256, min_fill=32),
+        learner=dataclasses.replace(cfg.learner, batch_size=8),
+        train_every=4,
+    )
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run_chunk = make_fused_train(cfg, env, net)
+    run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
+    carry = init(jax.random.PRNGKey(0))
+    carry, metrics = run(carry, 48)
+    assert int(metrics["env_frames"]) == 48 * 4
+    assert float(metrics["grad_steps_in_chunk"]) > 0
+    assert abs(float(metrics["loss"])) < 1e3
+    # uint8 pixel ring: final_obs not stored (memory), stack shape honored.
+    ring = carry.replay
+    assert ring.final_obs is None
+    assert ring.obs.shape[2:] == (84, 84, 4)
+    assert ring.obs.dtype.name == "uint8"

@@ -1,0 +1,86 @@
+"""Host-env adapter tests (CartPole via gymnasium; Atari pipeline pieces)."""
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.envs.gym_adapter import (
+    AtariPreprocessing, HostVectorEnv, _area_resize_84, _to_gray,
+    make_host_env)
+
+
+def test_area_resize_shapes_and_range():
+    frame = np.random.default_rng(0).integers(
+        0, 256, size=(210, 160), dtype=np.uint8)
+    out = _area_resize_84(frame)
+    assert out.shape == (84, 84)
+    assert out.dtype == np.uint8
+    # Constant image stays constant under resize.
+    flat = _area_resize_84(np.full((210, 160), 77, np.uint8))
+    assert int(flat.min()) >= 76 and int(flat.max()) <= 78
+
+
+def test_to_gray_weights():
+    rgb = np.zeros((4, 4, 3), np.uint8)
+    rgb[..., 1] = 255
+    assert abs(int(_to_gray(rgb)[0, 0]) - int(0.587 * 255)) <= 1
+
+
+def test_host_vector_env_cartpole_contract():
+    pytest.importorskip("gymnasium")
+    env = make_host_env("CartPole-v1", num_envs=3, seed=0)
+    obs = env.reset()
+    assert obs.shape == (3, 4)
+    for _ in range(250):  # long enough to hit an auto-reset
+        obs, next_obs, r, term, trunc = env.step(np.ones(3, np.int64))
+    assert obs.shape == (3, 4) and next_obs.shape == (3, 4)
+    assert r.dtype == np.float32
+    # Post-reset obs differs from pre-reset next_obs on done steps.
+    # (CartPole always terminates well before 250 steps of constant action.)
+
+
+class _FakeAtari:
+    """Minimal gymnasium-like env emitting RGB frames."""
+
+    def __init__(self):
+        self.t = 0
+
+    class _Space:
+        n = 6
+
+    action_space = _Space()
+
+    def reset(self, seed=None):
+        self.t = 0
+        return np.full((210, 160, 3), 10, np.uint8), {}
+
+    def step(self, action):
+        self.t += 1
+        frame = np.full((210, 160, 3), min(10 * self.t, 255), np.uint8)
+        return frame, 3.0, self.t >= 9, False, {}
+
+
+def test_atari_preprocessing_stack_skip_clip():
+    env = AtariPreprocessing(_FakeAtari(), frame_skip=4, stack=4)
+    obs = env.reset()
+    assert obs.shape == (84, 84, 4)
+    assert (obs[..., 0] == obs[..., 3]).all()  # reset tiles the first frame
+    obs, r, term, trunc = env.step(0)
+    assert r == 1.0                      # 4 * 3.0 clipped to 1.0
+    assert not term
+    # Frame-skip: 4 inner steps happened; stack shifted by one.
+    obs2, r2, term2, _ = env.step(0)
+    obs3, r3, term3, _ = env.step(0)     # inner t reaches 9 -> terminates
+    assert term3
+    assert env.num_actions == 6
+
+
+def test_host_vector_env_autoreset_next_obs():
+    env = HostVectorEnv(lambda: AtariPreprocessing(_FakeAtari()), 2)
+    env.reset()
+    done_seen = False
+    for _ in range(5):
+        obs, next_obs, r, term, trunc = env.step(np.zeros(2, np.int64))
+        if term.any():
+            done_seen = True
+            # obs was auto-reset; next_obs is the pre-reset frame.
+            assert not np.array_equal(obs[0], next_obs[0])
+    assert done_seen
