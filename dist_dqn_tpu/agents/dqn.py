@@ -41,11 +41,19 @@ def _apply(net: nn.Module, params: PyTree, obs: Array, rng: Optional[Array],
     return net.apply(params, obs, add_noise=add_noise, rngs=rngs)
 
 
-def make_learner(net: nn.Module, cfg: LearnerConfig):
+def make_learner(net: nn.Module, cfg: LearnerConfig,
+                 axis_name: Optional[str] = None):
     """Build (init, train_step) for a feed-forward Q-network.
 
     train_step(state, batch, weights) -> (state, metrics); metrics includes
     ``priorities`` [B] for replay priority updates.
+
+    With ``axis_name`` set, the step is a *distributed data-parallel learner*
+    meant to run under ``shard_map`` over that mesh axis: gradients (and the
+    scalar loss) are ``pmean``-ed across learners — the TPU-native
+    equivalent of the reference's multi-learner NCCL allreduce
+    (BASELINE.json:5) — so replicated params stay bit-identical while each
+    learner consumes its own replay shard's batch.
     """
     tx_parts = []
     if cfg.max_grad_norm:
@@ -127,6 +135,11 @@ def make_learner(net: nn.Module, cfg: LearnerConfig):
         (loss, (priorities, raw_loss)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params, state.target_params, batch,
                                    weights, k_loss)
+        if axis_name is not None:
+            # Gradient allreduce over the learner mesh axis (ICI collective).
+            grads = jax.lax.pmean(grads, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
+            raw_loss = jax.lax.pmean(raw_loss, axis_name)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         steps = state.steps + 1

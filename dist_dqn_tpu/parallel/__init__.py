@@ -1,0 +1,2 @@
+from dist_dqn_tpu.parallel.mesh import make_mesh  # noqa: F401
+from dist_dqn_tpu.parallel.learner import make_mesh_fused_train  # noqa: F401

@@ -1,0 +1,89 @@
+"""Multi-chip fused training: shard_map over the ICI mesh.
+
+Composition of the per-device fused loop (train_loop.py) into the pod-scale
+program the driver describes (BASELINE.json:5):
+
+  * envs + replay shard over the ``dp`` mesh axis — each device rolls out
+    its own env lanes and owns one replay shard in its HBM (the TPU-native
+    reading of "replay shards across TPU-VM host DRAM"; the host-DRAM
+    variant for external envs is replay/host.py + actors/),
+  * learner state is replicated; gradients cross the ICI once per update
+    via ``pmean`` inside the learner (agents/dqn.py) — the NCCL-allreduce
+    replacement,
+  * chunk metrics are psum-reduced so the host sees global numbers.
+
+Everything below is spec plumbing: which TrainCarry leaves live on which
+mesh axis. The actual math is unchanged single-device code — that's the
+point of SPMD.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_dqn_tpu.agents.dqn import LearnerState
+from dist_dqn_tpu.config import ExperimentConfig
+from dist_dqn_tpu.envs.base import JaxEnv
+from dist_dqn_tpu.replay.device import TimeRingState
+from dist_dqn_tpu.replay.prioritized_device import PrioritizedRingState
+from dist_dqn_tpu.train_loop import TrainCarry, make_fused_train
+
+
+def _carry_specs(prioritized: bool, axis: str) -> TrainCarry:
+    """Pytree-prefix PartitionSpecs for every TrainCarry field.
+
+    Env-batched leaves shard their env axis; ring leaves are [slots, envs,
+    ...] so they shard axis 1; learner state and scalar counters are
+    replicated (kept consistent by pmean/psum inside the body).
+    """
+    shard0 = P(axis)            # leading env axis
+    shard1 = P(None, axis)      # ring layout [T, B, ...]
+    repl = P()
+    ring_spec = TimeRingState(
+        obs=shard1, action=shard1, reward=shard1, terminated=shard1,
+        truncated=shard1, final_obs=shard1, pos=repl, size=repl)
+    replay_spec = (PrioritizedRingState(ring=ring_spec, priorities=shard1,
+                                        max_priority=repl)
+                   if prioritized else ring_spec)
+    learner_spec = LearnerState(params=repl, target_params=repl,
+                                opt_state=repl, steps=repl, rng=repl)
+    return TrainCarry(
+        env_state=shard0, obs=shard0, replay=replay_spec,
+        learner=learner_spec, rng=shard0, iteration=repl,
+        ep_return=shard0, completed_return=repl, completed_count=repl,
+        loss_sum=repl, train_count=repl)
+
+
+def make_mesh_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
+                          mesh: Mesh, axis: str = "dp"):
+    """Returns (init, run) on GLOBAL arrays: ``init(key)`` builds the pod-
+    wide carry; ``run(carry, num_iters)`` executes a fused chunk across the
+    mesh and reports global metrics. Both are jit-compiled; the carry is
+    donated so replay shards update in place in each device's HBM.
+    """
+    ndp = mesh.shape[axis]
+    init_local, run_local = make_fused_train(cfg, env, net, axis_name=axis,
+                                             num_shards=ndp)
+    specs = _carry_specs(cfg.replay.prioritized, axis)
+
+    init = jax.jit(
+        jax.shard_map(init_local, mesh=mesh, in_specs=P(),
+                      out_specs=specs, check_vma=False))
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def run(carry: TrainCarry, num_iters: int):
+        body = jax.shard_map(
+            lambda c: run_local(c, num_iters), mesh=mesh,
+            in_specs=(specs,), out_specs=(specs, P()), check_vma=False)
+        return body(carry)
+
+    return init, run
+
+
+def global_metrics(metrics: Dict) -> Dict:
+    """Device-get + float-cast a metrics dict for logging."""
+    got = jax.device_get(metrics)
+    return {k: float(v) for k, v in got.items()}

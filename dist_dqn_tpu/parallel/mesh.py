@@ -1,0 +1,34 @@
+"""Device-mesh construction helpers.
+
+One place decides how chips become a ``jax.sharding.Mesh``: the fused
+multi-chip trainer uses a 1-D ``dp`` learner axis (the only parallelism the
+DQN workload needs — networks are Nature-CNN sized, SURVEY.md §2), but the
+helper accepts arbitrary axis layouts so future shardings (e.g. an ``ep``
+axis for population-based sweeps) reuse it.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: Optional[Sequence[int]] = None,
+              axis_names: Tuple[str, ...] = ("dp",),
+              devices=None) -> Mesh:
+    """Build a mesh over the given (or all) devices.
+
+    ``axis_sizes=None`` puts every device on the first axis. Multi-host note:
+    ``jax.devices()`` is the *global* device list under a multi-host runtime,
+    so the same call shapes the pod-wide mesh with ICI-contiguous ordering.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if axis_sizes is None:
+        axis_sizes = [len(devices)] + [1] * (len(axis_names) - 1)
+    if int(np.prod(axis_sizes)) != len(devices):
+        raise ValueError(f"axis sizes {axis_sizes} don't cover "
+                         f"{len(devices)} devices")
+    grid = np.asarray(devices, dtype=object).reshape(tuple(axis_sizes))
+    return Mesh(grid, axis_names)

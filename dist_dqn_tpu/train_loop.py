@@ -6,10 +6,16 @@ zero host round-trips in steady state, which is what a TPU needs to hit the
 driver's env-steps/sec/chip north star (BASELINE.json:2). Host envs (real
 Atari / DM-Control) instead use the Ape-X actor/learner split in
 ``actors/`` — same learner, different feeding mechanism.
+
+The loop is SPMD-parameterizable: with ``axis_name``/``num_shards`` set it
+becomes the *per-device* body of the multi-chip program (see
+``parallel/learner.py``): envs, replay shard and sampling are local to each
+device, and only the learner's gradients cross the ICI via ``pmean``
+(BASELINE.json:5 — sharded replay, allreduced learners, replicated params).
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +37,7 @@ class TrainCarry(NamedTuple):
     obs: PyTree
     replay: PyTree         # TimeRingState or PrioritizedRingState
     learner: LearnerState
-    rng: Array
+    rng: Array             # single key; shape [1] key array in SPMD mode
     iteration: Array       # scalar int32 — env vector steps taken
     # Per-env episode trackers and chunk-level accumulators.
     ep_return: Array       # [B]
@@ -41,42 +47,72 @@ class TrainCarry(NamedTuple):
     train_count: Array
 
 
-def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
+def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
+                     axis_name: Optional[str] = None, num_shards: int = 1):
     """Returns (init, run_chunk): ``run_chunk(carry, num_iters)`` executes
-    ``num_iters`` fused iterations and reports aggregated metrics."""
+    ``num_iters`` fused iterations and reports aggregated metrics.
+
+    With ``axis_name`` set the returned functions are per-device bodies to be
+    wrapped in ``shard_map`` (parallel/learner.py); all sizes below become
+    per-shard sizes and chunk metrics are psum-reduced to global values.
+    """
     prioritized = cfg.replay.prioritized
-    init_learner, train_step = make_learner(net, cfg.learner)
+    spmd = axis_name is not None
+    init_learner, train_step = make_learner(net, cfg.learner,
+                                            axis_name=axis_name)
     act = make_actor_step(net)
-    B = cfg.actor.num_envs
-    num_slots = max(cfg.replay.capacity // B, cfg.learner.n_step + 2)
+    for name, total in (("num_envs", cfg.actor.num_envs),
+                        ("batch_size", cfg.learner.batch_size)):
+        if total % num_shards:
+            raise ValueError(f"{name}={total} not divisible by "
+                             f"num_shards={num_shards}")
+    B = cfg.actor.num_envs // num_shards
+    batch_size = cfg.learner.batch_size // num_shards
+    min_fill = max(cfg.replay.min_fill // num_shards, 1)
+    num_slots = max(cfg.replay.capacity // (B * num_shards),
+                    cfg.learner.n_step + 2)
     # Exact truncation bootstrap for cheap (non-pixel) observations; pixel
     # rings skip final_obs to halve HBM use (truncation treated as terminal).
     store_final = env.observation_dtype != jnp.uint8
 
     epsilon = optax.linear_schedule(
         cfg.actor.epsilon_start, cfg.actor.epsilon_end,
-        max(cfg.actor.epsilon_decay_steps // B, 1))
+        max(cfg.actor.epsilon_decay_steps // (B * num_shards), 1))
     # PER importance exponent anneals beta0 -> 1 over the configured run.
-    total_iters = max(cfg.total_env_steps // B, 1)
+    total_iters = max(cfg.total_env_steps // (B * num_shards), 1)
     beta0 = cfg.replay.importance_exponent
 
     def beta_at(iteration: Array) -> Array:
         frac = jnp.minimum(iteration.astype(jnp.float32) / total_iters, 1.0)
         return beta0 + (1.0 - beta0) * frac
 
+    def _split_rng(carry_rng: Array, n: int):
+        base = carry_rng[0] if spmd else carry_rng
+        keys = jax.random.split(base, n + 1)
+        new = keys[:1] if spmd else keys[0]
+        return new, keys[1:]
+
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay
 
     def can_train(replay, iteration: Array) -> Array:
         r = _ring_of(replay)
-        filled = r.size * B >= cfg.replay.min_fill
+        filled = r.size * B >= min_fill
         return jnp.logical_and(
             jnp.logical_and(filled,
                             ring.time_ring_can_sample(r, cfg.learner.n_step)),
             iteration % cfg.train_every == 0)
 
     def init(rng: Array) -> TrainCarry:
+        base = rng
+        if spmd:
+            # Per-device rng stream for envs/exploration; the learner init
+            # below must stay identical across devices, so its key comes
+            # from the unfolded base key.
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
         k_env, k_learn, k_run = jax.random.split(rng, 3)
+        if spmd:
+            k_learn = jax.random.fold_in(base, 7)
         env_state, obs = env.v_reset(k_env, B)
         # Envs may return obs aliasing their own state (e.g. CartPole's
         # phys vector); the carry is donated, so every leaf must be distinct.
@@ -91,17 +127,17 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
         learner = init_learner(k_learn, obs_example)
         zero = jnp.float32(0.0)
         return TrainCarry(env_state=env_state, obs=obs, replay=replay,
-                          learner=learner, rng=k_run,
+                          learner=learner,
+                          rng=k_run[None] if spmd else k_run,
                           iteration=jnp.int32(0),
                           ep_return=jnp.zeros((B,), jnp.float32),
                           completed_return=zero, completed_count=zero,
                           loss_sum=zero, train_count=zero)
 
     def one_iteration(carry: TrainCarry, _) -> Tuple[TrainCarry, None]:
-        rng, k_act, k_sample = jax.random.split(carry.rng, 3)
+        rng, (k_act, k_sample) = _split_rng(carry.rng, 2)
         eps = epsilon(carry.iteration)
-        actions = act(carry.learner.params, carry.obs,
-                      k_act, eps)
+        actions = act(carry.learner.params, carry.obs, k_act, eps)
         env_state, out = env.v_step(carry.env_state, actions)
         add = (pring.prioritized_ring_add if prioritized
                else ring.time_ring_add)
@@ -117,7 +153,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
                 l, rep = c
                 if prioritized:
                     s = pring.prioritized_ring_sample(
-                        rep, key, cfg.learner.batch_size, cfg.learner.n_step,
+                        rep, key, batch_size, cfg.learner.n_step,
                         cfg.learner.gamma, cfg.replay.priority_exponent,
                         beta)
                     l, metrics = train_step(l, s.batch, s.weights)
@@ -125,8 +161,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
                         rep, s.t_idx, s.b_idx, metrics["priorities"],
                         eps=cfg.replay.priority_eps)
                 else:
-                    batch = ring.time_ring_sample(rep, key,
-                                                  cfg.learner.batch_size,
+                    batch = ring.time_ring_sample(rep, key, batch_size,
                                                   cfg.learner.n_step,
                                                   cfg.learner.gamma)
                     l, metrics = train_step(l, batch)
@@ -163,20 +198,41 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net):
             train_count=carry.train_count + trained), None
 
     def run_chunk(carry: TrainCarry, num_iters: int):
-        """Run ``num_iters`` iterations; returns (carry, summary metrics)."""
-        carry = carry._replace(completed_return=jnp.float32(0.0),
-                               completed_count=jnp.float32(0.0),
-                               loss_sum=jnp.float32(0.0),
-                               train_count=jnp.float32(0.0))
+        """Run ``num_iters`` iterations; returns (carry, summary metrics).
+
+        Chunk accumulators are zeroed on entry and (in SPMD mode) psum-
+        reduced into the reported metrics, then zeroed in the returned carry
+        so every accumulator leaf stays replicated across devices.
+        """
+        zero = jnp.float32(0.0)
+        carry = carry._replace(completed_return=zero, completed_count=zero,
+                               loss_sum=zero, train_count=zero)
         carry, _ = jax.lax.scan(one_iteration, carry, None, length=num_iters)
+
+        completed_return = carry.completed_return
+        completed_count = carry.completed_count
+        loss_sum = carry.loss_sum
+        train_count = carry.train_count
+        if spmd:
+            completed_return = jax.lax.psum(completed_return, axis_name)
+            completed_count = jax.lax.psum(completed_count, axis_name)
+            loss_sum = jax.lax.pmean(loss_sum, axis_name)
+            train_count = jax.lax.pmean(train_count, axis_name)
+            carry = carry._replace(completed_return=zero,
+                                   completed_count=zero, loss_sum=zero,
+                                   train_count=zero)
+            if prioritized:
+                # Keep the new-item priority seed replicated (global max).
+                carry = carry._replace(replay=carry.replay._replace(
+                    max_priority=jax.lax.pmax(carry.replay.max_priority,
+                                              axis_name)))
         metrics = {
-            "env_frames": carry.iteration * B,
+            "env_frames": carry.iteration * B * num_shards,
             "episode_return":
-                carry.completed_return / jnp.maximum(carry.completed_count,
-                                                     1.0),
-            "episodes": carry.completed_count,
-            "loss": carry.loss_sum / jnp.maximum(carry.train_count, 1.0),
-            "grad_steps_in_chunk": carry.train_count,
+                completed_return / jnp.maximum(completed_count, 1.0),
+            "episodes": completed_count,
+            "loss": loss_sum / jnp.maximum(train_count, 1.0),
+            "grad_steps_in_chunk": train_count,
         }
         return carry, metrics
 
