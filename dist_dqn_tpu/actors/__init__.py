@@ -1,0 +1,4 @@
+from dist_dqn_tpu.actors.assembler import NStepAssembler  # noqa: F401
+from dist_dqn_tpu.actors.transport import (  # noqa: F401
+    ShmMailbox, ShmRing, TcpRecordClient, TcpRecordServer, decode_arrays,
+    encode_arrays)

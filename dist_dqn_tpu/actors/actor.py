@@ -1,0 +1,63 @@
+"""Ape-X rollout actor process: env stepping only, no neural network.
+
+TPU-first division of labor (Sebulba, PAPERS.md:5): the reference's actors
+run Q-net inference on their own CPUs and need constant parameter refreshes;
+here *all* inference runs batched on the TPU inside the learner service, so
+actors never see parameters (zero staleness, no param distribution on the
+hot path) and stay dependency-free: numpy + gymnasium + the shm transport.
+An actor sends its current observations, waits for its action mailbox, steps
+its vector env, and streams the step results back — the learner service does
+assembly, priorities and replay.
+
+This module must not import jax (actors are plain CPU processes).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
+                                           decode_arrays, encode_arrays)
+from dist_dqn_tpu.envs.gym_adapter import make_host_env
+
+
+def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
+              req_ring: str, act_box: str, stop_path: str,
+              max_env_steps: int = 10 ** 12) -> None:
+    """Entry point for one actor process (multiprocessing 'spawn' target)."""
+    env = make_host_env(env_name, num_envs, seed=seed)
+    ring = ShmRing(req_ring)
+    box = ShmMailbox(act_box)
+
+    obs = env.reset()
+    t = 0
+    payload = encode_arrays({"obs": obs},
+                            {"kind": "hello", "actor": actor_id, "t": t})
+    while not ring.push(payload):
+        time.sleep(0.001)
+
+    steps = 0
+    while steps < max_env_steps and not os.path.exists(stop_path):
+        # Wait for the actions computed for our step-t observations.
+        data, ver = box.read()
+        if data is None or ver != t + 1:
+            time.sleep(0.0002)
+            continue
+        arrays, _ = decode_arrays(data)
+        actions = arrays["action"]
+
+        obs, next_obs, reward, terminated, truncated = env.step(actions)
+        t += 1
+        steps += num_envs
+        payload = encode_arrays(
+            {"obs": obs, "reward": reward,
+             "terminated": terminated.astype(np.uint8),
+             "truncated": truncated.astype(np.uint8),
+             "next_obs": next_obs},
+            {"kind": "step", "actor": actor_id, "t": t})
+        while not ring.push(payload):
+            if os.path.exists(stop_path):
+                return
+            time.sleep(0.001)

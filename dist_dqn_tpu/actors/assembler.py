@@ -1,0 +1,97 @@
+"""Server-side trajectory assembly: env-step streams -> n-step transitions.
+
+Actors stream raw per-step results (they run no NN and know nothing about
+n-step math); the learner service assembles each (actor, env-lane) stream
+into Ape-X-style n-step transitions here, with the same episode-boundary
+semantics as the on-device sampler (replay/device.py):
+
+  * windows never span episodes — at a done, every open suffix window is
+    flushed with its shrunken horizon;
+  * terminal flushes carry discount 0; truncation flushes bootstrap from the
+    actor-provided pre-reset final observation with discount gamma**h.
+
+Pure numpy; per-lane Python state with O(n) work per step. (A C++ port of
+this assembly is the designated optimization if host-side assembly ever
+bottlenecks a saturated DCN link — the transport layer is already native.)
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+
+class _Lane:
+    __slots__ = ("obs", "action", "reward")
+
+    def __init__(self):
+        self.obs: Deque[np.ndarray] = deque()
+        self.action: Deque[int] = deque()
+        self.reward: Deque[float] = deque()
+
+
+class NStepAssembler:
+    """One assembler per actor; lanes = that actor's vector envs."""
+
+    def __init__(self, num_lanes: int, n_step: int, gamma: float):
+        self.n = n_step
+        self.gamma = gamma
+        self.lanes = [_Lane() for _ in range(num_lanes)]
+        self._out: Dict[str, List] = self._empty_out()
+
+    @staticmethod
+    def _empty_out() -> Dict[str, List]:
+        return {"obs": [], "action": [], "reward": [], "discount": [],
+                "next_obs": []}
+
+    def _emit(self, lane: _Lane, horizon: int, bootstrap: np.ndarray,
+              terminal: bool) -> None:
+        r, g = 0.0, 1.0
+        for k in range(horizon):
+            r += g * lane.reward[k]
+            g *= self.gamma
+        self._out["obs"].append(lane.obs[0])
+        self._out["action"].append(lane.action[0])
+        self._out["reward"].append(np.float32(r))
+        self._out["discount"].append(np.float32(0.0 if terminal else g))
+        self._out["next_obs"].append(bootstrap)
+
+    def step(self, obs: np.ndarray, action: np.ndarray, reward: np.ndarray,
+             terminated: np.ndarray, truncated: np.ndarray,
+             next_obs: np.ndarray) -> None:
+        """Feed one completed env step for every lane.
+
+        ``obs``/``action`` are what the actor acted on/with; ``next_obs`` is
+        the pre-reset successor (HostVectorEnv contract), used both as the
+        within-episode bootstrap and the truncation bootstrap.
+        """
+        for i, lane in enumerate(self.lanes):
+            lane.obs.append(obs[i])
+            lane.action.append(int(action[i]))
+            lane.reward.append(float(reward[i]))
+            done = bool(terminated[i]) or bool(truncated[i])
+            if done:
+                # Flush every suffix window at the episode end.
+                while lane.obs:
+                    self._emit(lane, len(lane.reward), next_obs[i],
+                               terminal=bool(terminated[i]))
+                    lane.obs.popleft()
+                    lane.action.popleft()
+                    lane.reward.popleft()
+            elif len(lane.obs) == self.n:
+                self._emit(lane, self.n, next_obs[i], terminal=False)
+                lane.obs.popleft()
+                lane.action.popleft()
+                lane.reward.popleft()
+
+    def drain(self) -> Optional[Dict[str, np.ndarray]]:
+        """Collect emitted transitions as stacked arrays (None if empty)."""
+        if not self._out["obs"]:
+            return None
+        out = {k: np.stack(v) if k in ("obs", "next_obs")
+               else np.asarray(v)
+               for k, v in self._out.items()}
+        out["action"] = out["action"].astype(np.int32)
+        self._out = self._empty_out()
+        return out

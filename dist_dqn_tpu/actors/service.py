@@ -1,0 +1,295 @@
+"""The Ape-X learner service: TPU inference + assembly + replay + training.
+
+One process owns the accelerator and runs four roles in one loop
+(BASELINE.json:5,9):
+
+  * inference server — drains actor observation records from the shm ring,
+    runs the jitted epsilon-greedy policy (per-actor epsilon ladder) and
+    posts actions to each actor's mailbox; params never leave the device;
+  * assembler — folds per-lane step streams into n-step transitions
+    (actors/assembler.py);
+  * priority bootstrapper — computes initial |TD| for new transitions in
+    fixed-size padded chunks on the device (Ape-X inserts with real
+    priorities, not max-seeding);
+  * learner — samples the host PER shard, one jitted train step per
+    ``grad_batch_per_env_step`` inserted transitions, writes priorities
+    back.
+
+Throughput counters (env-steps/sec/chip, grad-steps/sec) are the
+north-star metrics (BASELINE.json:2) and are reported every flush.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dist_dqn_tpu.actors.assembler import NStepAssembler
+from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing, shm_dir,
+                                           decode_arrays, encode_arrays)
+from dist_dqn_tpu.config import ExperimentConfig
+from dist_dqn_tpu.utils.metrics import MetricLogger
+
+_PRIO_CHUNK = 256
+
+
+@dataclasses.dataclass
+class ApexRuntimeConfig:
+    """Host-side knobs for the actor/learner split."""
+
+    host_env: str = "CartPole-v1"   # host env actors step (ale:<Game> for ALE)
+    num_actors: int = 2
+    envs_per_actor: int = 4
+    total_env_steps: int = 10_000
+    # Learner cadence: one grad step per this many inserted transitions,
+    # scaled by the learner batch size (Ape-X trains ~batch/8 per insert).
+    inserts_per_grad_step: int = 64
+    ring_mb: int = 64
+    log_every_s: float = 5.0
+
+
+class ApexLearnerService:
+    def __init__(self, cfg: ExperimentConfig, rt: ApexRuntimeConfig,
+                 log_fn=print):
+        import jax  # deferred: this process owns the accelerator
+        import jax.numpy as jnp
+
+        from dist_dqn_tpu.agents.dqn import make_actor_step, make_learner
+        from dist_dqn_tpu.models import build_network
+        from dist_dqn_tpu.replay.host import PrioritizedHostReplay
+
+        self.jax, self.jnp = jax, jnp
+        self.cfg, self.rt = cfg, rt
+        self.run_id = uuid.uuid4().hex[:8]
+        self.log = MetricLogger(log_fn=log_fn)
+
+        # Transport endpoints (created before actors spawn).
+        self.req_ring = ShmRing(f"req_{self.run_id}",
+                                capacity=rt.ring_mb * 1024 * 1024,
+                                create=True)
+        self.act_boxes = [
+            ShmMailbox(f"act_{self.run_id}_{i}", max_size=1 << 20,
+                       create=True)
+            for i in range(rt.num_actors)
+        ]
+        self.stop_path = str(shm_dir() / f"stop_{self.run_id}")
+
+        # Probe the env for action count (host-side, cheap).
+        from dist_dqn_tpu.envs.gym_adapter import make_host_env
+        probe = make_host_env(rt.host_env, 1)
+        self.num_actions = probe.num_actions
+        del probe
+
+        net = build_network(cfg.network, self.num_actions)
+        self.net = net
+        init, train_step = make_learner(net, cfg.learner)
+        self.state = None
+        self._init_learner = init
+        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._act = jax.jit(make_actor_step(net))
+
+        def prio_fn(params, target_params, obs, action, reward, discount,
+                    next_obs):
+            q = net.apply(params, obs)
+            qa = jnp.take_along_axis(q, action[:, None], axis=-1)[:, 0]
+            boot = jnp.max(net.apply(target_params, next_obs), axis=-1)
+            return jnp.abs(qa - (reward + discount * boot))
+
+        self._prio_fn = jax.jit(prio_fn)
+
+        self.replay = PrioritizedHostReplay(
+            cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
+            priority_eps=cfg.replay.priority_eps)
+        self.assemblers = [
+            NStepAssembler(rt.envs_per_actor, cfg.learner.n_step,
+                           cfg.learner.gamma)
+            for _ in range(rt.num_actors)
+        ]
+        # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1)*alpha).
+        n_act = max(rt.num_actors - 1, 1)
+        self.actor_eps = np.array([
+            cfg.actor.apex_epsilon_base
+            ** (1 + i / n_act * cfg.actor.apex_epsilon_alpha)
+            for i in range(rt.num_actors)
+        ], np.float32)
+
+        self._prev_obs: List[Optional[np.ndarray]] = \
+            [None] * rt.num_actors
+        self._prev_actions: List[Optional[np.ndarray]] = \
+            [None] * rt.num_actors
+        self._pending: List[Dict[str, np.ndarray]] = []
+        self._pending_count = 0
+        self.env_steps = 0
+        self.grad_steps = 0
+        self._rng = None
+
+    # -- actor lifecycle ----------------------------------------------------
+    def spawn_actors(self):
+        import multiprocessing as mp
+
+        from dist_dqn_tpu.actors.actor import run_actor
+        ctx = mp.get_context("spawn")
+        self.procs = []
+        for i in range(self.rt.num_actors):
+            p = ctx.Process(
+                target=run_actor,
+                args=(i, self.rt.host_env, self.rt.envs_per_actor,
+                      1000 + 7 * i, f"req_{self.run_id}",
+                      f"act_{self.run_id}_{i}", self.stop_path),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+
+    def shutdown(self):
+        with open(self.stop_path, "w") as f:
+            f.write("stop")
+        for p in getattr(self, "procs", []):
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+        self.req_ring.unlink()
+        for b in self.act_boxes:
+            b.unlink()
+        try:
+            os.unlink(self.stop_path)
+        except OSError:
+            pass
+
+    # -- core loop ----------------------------------------------------------
+    def _ensure_learner(self, obs_example: np.ndarray):
+        if self.state is None:
+            jax = self.jax
+            self._rng = jax.random.PRNGKey(self.cfg.seed)
+            self._rng, k = jax.random.split(self._rng)
+            self.state = self._init_learner(k, self.jnp.asarray(obs_example))
+
+    def _reply_actions(self, actor: int, obs: np.ndarray, t: int):
+        jax = self.jax
+        self._rng, k = jax.random.split(self._rng)
+        actions = self._act(self.state.params, self.jnp.asarray(obs), k,
+                            self.jnp.float32(self.actor_eps[actor]))
+        actions = np.asarray(actions, np.int32)
+        self._prev_actions[actor] = actions
+        self._prev_obs[actor] = obs
+        self.act_boxes[actor].write(
+            encode_arrays({"action": actions}), version=t + 1)
+
+    def _handle_record(self, payload: bytes):
+        arrays, meta = decode_arrays(payload)
+        actor, t = meta["actor"], meta["t"]
+        if meta["kind"] == "hello":
+            self._ensure_learner(arrays["obs"][0])
+            self._reply_actions(actor, arrays["obs"], t)
+            return
+        # step record: completes (prev_obs, prev_action) -> transition.
+        self.assemblers[actor].step(
+            self._prev_obs[actor], self._prev_actions[actor],
+            arrays["reward"], arrays["terminated"].astype(bool),
+            arrays["truncated"].astype(bool), arrays["next_obs"])
+        self.env_steps += arrays["reward"].shape[0]
+        emitted = self.assemblers[actor].drain()
+        if emitted is not None:
+            self._pending.append(emitted)
+            self._pending_count += emitted["action"].shape[0]
+        self._reply_actions(actor, arrays["obs"], t)
+
+    def _flush_pending(self, force: bool = False):
+        """Compute initial priorities on-device and insert into the shard."""
+        if self._pending_count == 0:
+            return
+        if not force and self._pending_count < _PRIO_CHUNK:
+            return
+        cat = {k: np.concatenate([p[k] for p in self._pending])
+               for k in self._pending[0]}
+        self._pending, self._pending_count = [], 0
+        jnp = self.jnp
+        n = cat["action"].shape[0]
+        for lo in range(0, n, _PRIO_CHUNK):
+            hi = min(lo + _PRIO_CHUNK, n)
+            pad = _PRIO_CHUNK - (hi - lo)
+
+            def pad_to(x):
+                return np.concatenate([x[lo:hi], np.repeat(x[hi - 1:hi],
+                                                           pad, axis=0)]) \
+                    if pad else x[lo:hi]
+
+            prios = self._prio_fn(
+                self.state.params, self.state.target_params,
+                jnp.asarray(pad_to(cat["obs"])),
+                jnp.asarray(pad_to(cat["action"])),
+                jnp.asarray(pad_to(cat["reward"])),
+                jnp.asarray(pad_to(cat["discount"])),
+                jnp.asarray(pad_to(cat["next_obs"])))
+            prios = np.asarray(prios)[:hi - lo]
+            self.replay.add({k: v[lo:hi] for k, v in cat.items()},
+                            priorities=prios)
+
+    def _maybe_train(self):
+        cfg = self.cfg
+        if len(self.replay) < cfg.replay.min_fill:
+            return
+        target_grad_steps = self.replay.added // self.rt.inserts_per_grad_step
+        jnp = self.jnp
+        while self.grad_steps < target_grad_steps:
+            beta = min(1.0, cfg.replay.importance_exponent
+                       + (1 - cfg.replay.importance_exponent)
+                       * self.env_steps / max(self.rt.total_env_steps, 1))
+            items, idx, weights = self.replay.sample(cfg.learner.batch_size,
+                                                     beta)
+            from dist_dqn_tpu.types import Transition
+            batch = Transition(
+                obs=jnp.asarray(items["obs"]),
+                action=jnp.asarray(items["action"]),
+                reward=jnp.asarray(items["reward"]),
+                discount=jnp.asarray(items["discount"]),
+                next_obs=jnp.asarray(items["next_obs"]))
+            self.state, metrics = self._train_step(self.state, batch,
+                                                   jnp.asarray(weights))
+            self.replay.update_priorities(
+                idx, np.asarray(metrics["priorities"]))
+            self.grad_steps += 1
+            self._last_loss = float(metrics["loss"])
+
+    def run(self):
+        """Main service loop until total_env_steps processed."""
+        self.spawn_actors()
+        last_log = time.perf_counter()
+        try:
+            while self.env_steps < self.rt.total_env_steps:
+                drained = False
+                for _ in range(256):
+                    rec = self.req_ring.pop()
+                    if rec is None:
+                        break
+                    drained = True
+                    self._handle_record(rec)
+                self._flush_pending()
+                self._maybe_train()
+                if not drained:
+                    time.sleep(0.0002)
+                now = time.perf_counter()
+                if now - last_log > self.rt.log_every_s:
+                    self.log.record(env_steps=self.env_steps,
+                                    grad_steps=self.grad_steps,
+                                    replay_size=float(len(self.replay)),
+                                    loss=getattr(self, "_last_loss", 0.0),
+                                    ring_dropped=float(
+                                        self.req_ring.dropped))
+                    self.log.flush()
+                    last_log = now
+            self._flush_pending(force=True)
+        finally:
+            self.shutdown()
+        return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
+                "replay_size": len(self.replay),
+                "ring_dropped": self.req_ring.dropped}
+
+
+def run_apex(cfg: ExperimentConfig, rt: ApexRuntimeConfig, log_fn=print):
+    """Convenience entry: build the service, run to completion."""
+    service = ApexLearnerService(cfg, rt, log_fn=log_fn)
+    return service.run()

@@ -1,0 +1,299 @@
+"""Python bindings for the native transport + array codec + TCP (DCN) path.
+
+Three layers:
+  * build/bind the C++ shared-memory primitives (ShmRing, ShmMailbox) —
+    the intra-host hot path between actor processes and the learner service
+    (actors/_native/transport.cc; built on demand with g++, cached);
+  * a zero-copy-ish numpy array codec (tiny JSON header + raw buffers) so
+    trajectory batches cross process boundaries without pickle overhead;
+  * TcpRecordTransport — the same length-prefixed record stream over a
+    socket for actors on *other* hosts (the true-DCN path). One consumer
+    thread drains TCP records into the same queue interface as the ring.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import socket
+import struct
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).parent / "_native"
+_LIB_PATH = _NATIVE_DIR / "libdqntransport.so"
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_native() -> Path:
+    src = _NATIVE_DIR / "transport.cc"
+    if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime:
+        return _LIB_PATH
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           str(src), "-o", str(_LIB_PATH)]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def native_lib() -> ctypes.CDLL:
+    """Build (if needed) and load the C++ transport library."""
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(str(_build_native()))
+            lib.dqn_ring_create.restype = ctypes.c_void_p
+            lib.dqn_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.dqn_ring_attach.restype = ctypes.c_void_p
+            lib.dqn_ring_attach.argtypes = [ctypes.c_char_p]
+            lib.dqn_ring_push.restype = ctypes.c_int
+            lib.dqn_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint32]
+            lib.dqn_ring_pop.restype = ctypes.c_long
+            lib.dqn_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_uint64]
+            lib.dqn_ring_peek_len.restype = ctypes.c_long
+            lib.dqn_ring_peek_len.argtypes = [ctypes.c_void_p]
+            lib.dqn_ring_dropped.restype = ctypes.c_uint64
+            lib.dqn_ring_dropped.argtypes = [ctypes.c_void_p]
+            lib.dqn_ring_pending.restype = ctypes.c_uint64
+            lib.dqn_ring_pending.argtypes = [ctypes.c_void_p]
+            lib.dqn_box_create.restype = ctypes.c_void_p
+            lib.dqn_box_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.dqn_box_attach.restype = ctypes.c_void_p
+            lib.dqn_box_attach.argtypes = [ctypes.c_char_p]
+            lib.dqn_box_write.restype = ctypes.c_int
+            lib.dqn_box_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint64, ctypes.c_uint64]
+            lib.dqn_box_read.restype = ctypes.c_long
+            lib.dqn_box_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_uint64,
+                                         ctypes.POINTER(ctypes.c_uint64)]
+            _lib = lib
+    return _lib
+
+
+def shm_dir() -> Path:
+    d = Path("/dev/shm") if Path("/dev/shm").is_dir() else Path("/tmp")
+    p = d / "dqn_tpu"
+    p.mkdir(exist_ok=True)
+    return p
+
+
+class ShmRing:
+    """MPSC byte-record ring over shared memory (see transport.cc)."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        self.path = str(shm_dir() / name).encode()
+        lib = native_lib()
+        if create:
+            self._h = lib.dqn_ring_create(self.path, capacity)
+        else:
+            self._h = lib.dqn_ring_attach(self.path)
+        if not self._h:
+            raise OSError(f"ring {'create' if create else 'attach'} failed: "
+                          f"{self.path.decode()}")
+        self._lib = lib
+
+    def push(self, payload: bytes) -> bool:
+        rc = self._lib.dqn_ring_push(self._h, payload, len(payload))
+        return rc == 0
+
+    def pop(self) -> Optional[bytes]:
+        n = self._lib.dqn_ring_peek_len(self._h)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.dqn_ring_pop(self._h, buf, int(n))
+        if got < 0:
+            return None
+        return buf.raw[:got]
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.dqn_ring_dropped(self._h))
+
+    @property
+    def pending_bytes(self) -> int:
+        return int(self._lib.dqn_ring_pending(self._h))
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ShmMailbox:
+    """Single-writer / many-reader versioned broadcast slot."""
+
+    def __init__(self, name: str, max_size: int = 0, create: bool = False):
+        self.path = str(shm_dir() / name).encode()
+        lib = native_lib()
+        self._h = (lib.dqn_box_create(self.path, max_size) if create
+                   else lib.dqn_box_attach(self.path))
+        if not self._h:
+            raise OSError(f"mailbox {'create' if create else 'attach'} "
+                          f"failed: {self.path.decode()}")
+        self._lib = lib
+        self._cap = max_size
+
+    def write(self, payload: bytes, version: int) -> None:
+        rc = self._lib.dqn_box_write(self._h, payload, len(payload), version)
+        if rc != 0:
+            raise ValueError("payload exceeds mailbox size")
+
+    def read(self, max_size: int = 1 << 20) -> Tuple[Optional[bytes], int]:
+        buf = ctypes.create_string_buffer(max_size)
+        ver = ctypes.c_uint64(0)
+        n = self._lib.dqn_box_read(self._h, buf, max_size,
+                                   ctypes.byref(ver))
+        if n < 0:
+            raise ValueError("mailbox read buffer too small")
+        if n == 0:
+            return None, 0
+        return buf.raw[:n], int(ver.value)
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Array codec: dict[str, np.ndarray] <-> bytes
+# ---------------------------------------------------------------------------
+
+def encode_arrays(arrays: Dict[str, np.ndarray],
+                  meta: Optional[Dict] = None) -> bytes:
+    header = {
+        "meta": meta or {},
+        "arrays": [[k, v.dtype.str, list(v.shape)]
+                   for k, v in arrays.items()],
+    }
+    hb = json.dumps(header).encode()
+    parts = [struct.pack("<I", len(hb)), hb]
+    for _, v in arrays.items():
+        parts.append(np.ascontiguousarray(v).tobytes())
+    return b"".join(parts)
+
+
+def decode_arrays(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict]:
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    header = json.loads(buf[4:4 + hlen].decode())
+    out: Dict[str, np.ndarray] = {}
+    off = 4 + hlen
+    for name, dtype, shape in header["arrays"]:
+        dt = np.dtype(dtype)
+        count = int(np.prod(shape, dtype=np.int64))
+        arr = np.frombuffer(buf, dtype=dt, count=count, offset=off)
+        out[name] = arr.reshape(shape).copy()
+        off += count * dt.itemsize
+    return out, header["meta"]
+
+
+# ---------------------------------------------------------------------------
+# TCP record transport (cross-host DCN path)
+# ---------------------------------------------------------------------------
+
+class TcpRecordServer:
+    """Accepts length-prefixed records from remote actors; same ``pop()``
+    interface as ShmRing so the learner service is transport-agnostic."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_backlog: int = 4096):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = self._sock.getsockname()
+        self._records: List[bytes] = []
+        self._lock = threading.Lock()
+        self._max_backlog = max_backlog
+        self.dropped = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                hdr = self._recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                (n,) = struct.unpack("<I", hdr)
+                payload = self._recv_exact(conn, n)
+                if payload is None:
+                    return
+                with self._lock:
+                    if len(self._records) >= self._max_backlog:
+                        self.dropped += 1
+                    else:
+                        self._records.append(payload)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn, n) -> Optional[bytes]:
+        chunks = []
+        while n:
+            try:
+                b = conn.recv(n)
+            except OSError:
+                return None
+            if not b:
+                return None
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def pop(self) -> Optional[bytes]:
+        with self._lock:
+            return self._records.pop(0) if self._records else None
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpRecordClient:
+    """Actor-side sender for the TCP path."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self._sock = socket.create_connection(address)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def push(self, payload: bytes) -> bool:
+        try:
+            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+            return True
+        except OSError:
+            return False
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -74,10 +74,37 @@ def main():
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
+    parser.add_argument("--runtime", choices=("fused", "apex"),
+                        default="fused",
+                        help="fused: on-device Anakin loop (JAX envs); "
+                             "apex: CPU actor processes + learner service "
+                             "over the shm/DCN transport (host envs)")
+    parser.add_argument("--host-env", default="CartPole-v1",
+                        help="apex runtime: host env actors step "
+                             "(e.g. CartPole-v1, ale:Pong)")
+    parser.add_argument("--num-actors", type=int, default=4)
+    parser.add_argument("--envs-per-actor", type=int, default=8)
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     cfg = CONFIGS[args.config]
+    if args.runtime == "apex":
+        import dataclasses
+
+        from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+        if not args.host_env.startswith("ale:"):
+            # Non-pixel host env: the config's Nature-CNN torso can't eat
+            # flat observations — swap in the MLP torso, keep the rest.
+            print(f"# host env {args.host_env} is non-pixel: using MLP torso")
+            cfg = dataclasses.replace(
+                cfg, network=dataclasses.replace(
+                    cfg.network, torso="mlp", compute_dtype="float32"))
+        rt = ApexRuntimeConfig(
+            host_env=args.host_env, num_actors=args.num_actors,
+            envs_per_actor=args.envs_per_actor,
+            total_env_steps=args.total_env_steps or cfg.total_env_steps)
+        print(json.dumps(run_apex(cfg, rt)))
+        return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
           chunk_iters=args.chunk_iters)
 

@@ -1,0 +1,30 @@
+"""End-to-end Ape-X split: 2 actor processes stream CartPole trajectories
+over the shm transport; the learner service does TPU-side (here: CPU-side)
+inference, assembly, prioritized insertion and training."""
+import dataclasses
+
+import numpy as np
+
+from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+from dist_dqn_tpu.config import CONFIGS
+
+
+def test_apex_split_end_to_end():
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=200),
+        learner=dataclasses.replace(cfg.learner, batch_size=32, n_step=3),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=2,
+                           envs_per_actor=4, total_env_steps=1200,
+                           inserts_per_grad_step=64)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 1200
+    assert result["replay_size"] > 500
+    assert result["grad_steps"] >= 10
+    assert result["ring_dropped"] == 0

@@ -1,0 +1,92 @@
+"""NStepAssembler vs brute force, with terminations and truncations."""
+import numpy as np
+
+from dist_dqn_tpu.actors.assembler import NStepAssembler
+
+
+def _feed(assembler, T, num_lanes, rewards, term, trunc, obs_of):
+    """Feed T steps; obs index = step, next_obs = step + 0.5."""
+    for t in range(T):
+        assembler.step(
+            obs=np.stack([obs_of(t, i) for i in range(num_lanes)]),
+            action=np.full(num_lanes, t % 4),
+            reward=np.full(num_lanes, rewards[t], np.float32),
+            terminated=np.full(num_lanes, term[t]),
+            truncated=np.full(num_lanes, trunc[t]),
+            next_obs=np.stack([obs_of(t, i) + 0.5
+                               for i in range(num_lanes)]))
+
+
+def test_sliding_window_within_episode():
+    n, gamma = 3, 0.9
+    a = NStepAssembler(num_lanes=1, n_step=n, gamma=gamma)
+    rewards = np.arange(1.0, 7.0)  # steps 0..5
+    T = 6
+    _feed(a, T, 1, rewards, np.zeros(T, bool), np.zeros(T, bool),
+          lambda t, i: np.array([float(t)]))
+    out = a.drain()
+    # Full windows emitted at steps 2..5 -> starts 0..3.
+    assert out["action"].shape[0] == 4
+    for j, start in enumerate(range(4)):
+        want_r = sum(gamma ** k * rewards[start + k] for k in range(n))
+        np.testing.assert_allclose(out["reward"][j], want_r, rtol=1e-6)
+        np.testing.assert_allclose(out["discount"][j], gamma ** n)
+        assert out["obs"][j][0] == float(start)
+        # Bootstrap = pre-reset successor of the window's last step.
+        assert out["next_obs"][j][0] == float(start + n - 1) + 0.5
+        assert out["action"][j] == start % 4
+
+
+def test_termination_flushes_all_suffixes():
+    n, gamma = 3, 0.5
+    a = NStepAssembler(1, n, gamma)
+    T = 4
+    term = np.array([False, False, False, True])
+    rewards = np.array([1.0, 2.0, 4.0, 8.0])
+    _feed(a, T, 1, rewards, term, np.zeros(T, bool),
+          lambda t, i: np.array([float(t)]))
+    out = a.drain()
+    # Step 2 completes window [0..2]; at step-3 done, suffixes [1..3],
+    # [2..3], [3] flush with discount 0.
+    assert out["action"].shape[0] == 4
+    np.testing.assert_allclose(out["reward"][0], 1 + 0.5 * 2 + 0.25 * 4)
+    np.testing.assert_allclose(out["discount"][0], 0.125)
+    np.testing.assert_allclose(out["reward"][1], 2 + 0.5 * 4 + 0.25 * 8)
+    np.testing.assert_allclose(out["reward"][2], 4 + 0.5 * 8)
+    np.testing.assert_allclose(out["reward"][3], 8.0)
+    np.testing.assert_allclose(out["discount"][1:], 0.0)
+
+
+def test_truncation_bootstraps_with_final_obs():
+    n, gamma = 2, 0.9
+    a = NStepAssembler(1, n, gamma)
+    T = 3
+    trunc = np.array([False, False, True])
+    rewards = np.array([1.0, 1.0, 1.0])
+    _feed(a, T, 1, rewards, np.zeros(T, bool), trunc,
+          lambda t, i: np.array([float(t)]))
+    out = a.drain()
+    # Window [0..1] full at step 1; truncation at step 2 flushes [1..2], [2].
+    assert out["action"].shape[0] == 3
+    np.testing.assert_allclose(out["discount"][0], gamma ** 2)
+    # Truncated flushes keep their gamma**h bootstrap on the final obs.
+    np.testing.assert_allclose(out["discount"][1], gamma ** 2)
+    np.testing.assert_allclose(out["discount"][2], gamma ** 1)
+    assert out["next_obs"][1][0] == 2.5 and out["next_obs"][2][0] == 2.5
+
+
+def test_lanes_are_independent():
+    a = NStepAssembler(2, 2, 1.0)
+    for t in range(3):
+        a.step(obs=np.array([[float(t)], [10.0 + t]]),
+               action=np.array([0, 1]),
+               reward=np.array([1.0, 5.0], np.float32),
+               terminated=np.array([False, t == 1]),
+               truncated=np.array([False, False]),
+               next_obs=np.array([[t + 0.5], [10.5 + t]]))
+    out = a.drain()
+    lane1 = out["obs"][:, 0] >= 10.0
+    # Lane 1 flushed at its step-1 termination (2 suffixes) and then
+    # restarted; lane 0 emitted its full windows.
+    assert lane1.sum() == 2
+    np.testing.assert_allclose(out["discount"][lane1], 0.0)
