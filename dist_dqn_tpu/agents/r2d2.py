@@ -1,0 +1,180 @@
+"""R2D2 sequence learner: LSTM unroll with burn-in, one jit program.
+
+The recurrent half of the driver's capability list (BASELINE.json:10):
+sequence replay batches flow through stored-state burn-in, an unrolled
+double-Q n-step loss with value rescaling, and the eta-mixed per-sequence
+priorities of Kapturowski et al. (2019) — all traced, with the optimizer
+update and target sync, into one XLA program like the feed-forward learner
+(agents/dqn.py, BASELINE.json:5).
+
+Burn-in: the first ``burn_in`` steps are unrolled from the stored actor
+carry purely to refresh the hidden state (stop-gradient, online and target
+nets each with their own parameters); the loss covers the next
+``unroll_length`` steps; the final ``n_step`` steps exist only as the
+within-window bootstrap region. Episode boundaries inside a window are
+handled exactly: the cell re-zeroes its carry on the stored reset flags and
+n-step returns stop at dones (truncation treated as terminal, matching the
+pixel ring's bootstrap semantics — replay/device.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dist_dqn_tpu.agents.dqn import LearnerState
+from dist_dqn_tpu.config import LearnerConfig, ReplayConfig
+from dist_dqn_tpu.ops import losses
+from dist_dqn_tpu.types import PyTree, SequenceSample
+
+Array = jnp.ndarray
+
+
+def make_r2d2_learner(net, cfg: LearnerConfig, rcfg: ReplayConfig,
+                      axis_name: Optional[str] = None):
+    """Build (init, train_step) for a RecurrentQNetwork over sequences.
+
+    train_step(state, sample: SequenceSample) -> (state, metrics); metrics
+    includes per-sequence ``priorities`` [S]. With ``axis_name`` set,
+    gradients are pmean-ed across the learner mesh axis (the NCCL-allreduce
+    replacement, BASELINE.json:5).
+    """
+    burn = rcfg.burn_in
+    unroll = rcfg.unroll_length
+    n = cfg.n_step
+    eta = rcfg.priority_mix
+    if unroll <= 0:
+        raise ValueError("R2D2 learner needs replay.unroll_length > 0")
+
+    tx_parts = []
+    if cfg.max_grad_norm:
+        tx_parts.append(optax.clip_by_global_norm(cfg.max_grad_norm))
+    tx_parts.append(optax.adam(cfg.learning_rate, eps=cfg.adam_eps))
+    tx = optax.chain(*tx_parts)
+
+    def init(rng: Array, obs_example: Array) -> LearnerState:
+        rng, k_param = jax.random.split(rng)
+        carry = net.initial_state(1)
+        obs_tb = obs_example[None, None]            # [T=1, B=1, ...]
+        params = net.init(k_param, carry, obs_tb, method=net.unroll)
+        return LearnerState(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=tx.init(params),
+            steps=jnp.int32(0),
+            rng=rng,
+        )
+
+    def _unrolled_q(params: PyTree, sample: SequenceSample) -> Array:
+        """Burn in (stop-grad) then unroll the loss+bootstrap region.
+
+        Returns q over steps [burn, burn+unroll+n): [unroll+n, S, A].
+        """
+        carry = sample.start_state
+        if burn:
+            carry, _ = net.apply(params, carry, sample.obs[:burn],
+                                 sample.reset[:burn], method=net.unroll)
+            carry = jax.lax.stop_gradient(carry)
+        _, q = net.apply(params, carry, sample.obs[burn:],
+                         sample.reset[burn:], method=net.unroll)
+        return q
+
+    def loss_fn(params: PyTree, target_params: PyTree,
+                sample: SequenceSample) -> Tuple[Array, Tuple]:
+        q_online = _unrolled_q(params, sample)          # [unroll+n, S, A]
+        q_target = _unrolled_q(target_params, sample)   # [unroll+n, S, A]
+
+        # Per-step n-step returns inside the window; d_t = gamma*(1 - done_t)
+        # zeroes everything past an episode end (and the bootstrap with it).
+        r = sample.reward[burn:]                        # [unroll+n, S]
+        d = cfg.gamma * (1.0 - sample.done[burn:].astype(jnp.float32))
+        acc_r = jnp.zeros_like(r[:unroll])
+        acc_d = jnp.ones_like(acc_r)
+        for j in range(n):
+            acc_r = acc_r + acc_d * r[j:j + unroll]
+            acc_d = acc_d * d[j:j + unroll]
+
+        boot_online = q_online[n:n + unroll]            # q at step k+n
+        boot_target = q_target[n:n + unroll]
+        selector = boot_online if cfg.double_dqn else boot_target
+        a_star = jnp.argmax(selector, axis=-1)
+        boot = jnp.take_along_axis(boot_target, a_star[..., None],
+                                   axis=-1)[..., 0]
+        if cfg.value_rescale:
+            boot = losses.inv_value_rescale(boot)
+        target = acc_r + acc_d * boot
+        if cfg.value_rescale:
+            target = losses.value_rescale(target)
+
+        qa = jnp.take_along_axis(
+            q_online[:unroll],
+            sample.action[burn:burn + unroll, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        td = qa - jax.lax.stop_gradient(target)         # [unroll, S]
+        per_step = losses.huber(td, cfg.huber_delta)
+        per_seq = jnp.mean(per_step, axis=0)            # [S]
+        loss = jnp.mean(sample.weights * per_seq)
+
+        abs_td = jnp.abs(td)
+        priorities = (eta * jnp.max(abs_td, axis=0)
+                      + (1.0 - eta) * jnp.mean(abs_td, axis=0))
+        aux = (jax.lax.stop_gradient(priorities),
+               jax.lax.stop_gradient(jnp.mean(per_seq)))
+        return loss, aux
+
+    def train_step(state: LearnerState, sample: SequenceSample
+                   ) -> Tuple[LearnerState, dict]:
+        rng, _ = jax.random.split(state.rng)
+        (loss, (priorities, raw_loss)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, state.target_params, sample)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
+            raw_loss = jax.lax.pmean(raw_loss, axis_name)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        steps = state.steps + 1
+
+        if cfg.target_tau > 0.0:
+            target_params = jax.tree.map(
+                lambda t, p: t + cfg.target_tau * (p - t),
+                state.target_params, params)
+        else:
+            do_sync = (steps % cfg.target_update_period) == 0
+            target_params = jax.tree.map(
+                lambda t, p: jnp.where(do_sync, p, t),
+                state.target_params, params)
+
+        new_state = LearnerState(params=params, target_params=target_params,
+                                 opt_state=opt_state, steps=steps, rng=rng)
+        metrics = {
+            "loss": loss,
+            "raw_loss": raw_loss,
+            "priorities": priorities,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    return init, train_step
+
+
+def make_recurrent_actor_step(net):
+    """Epsilon-greedy acting for the recurrent net, carry threaded by caller.
+
+    act(params, carry, obs, rng, epsilon) -> (new_carry, actions [B]).
+    The caller zeroes the carry on episode ends before the next call (the
+    fused loop does this right after env.step), so no reset flags here.
+    """
+
+    def act(params: PyTree, carry, obs: Array, rng: Array, epsilon: Array):
+        k_eps, k_rand = jax.random.split(rng)
+        carry, q = net.apply(params, carry, obs)
+        greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+        random_a = jax.random.randint(k_rand, greedy.shape, 0,
+                                      net.num_actions)
+        explore = jax.random.uniform(k_eps, greedy.shape) < epsilon
+        return carry, jnp.where(explore, random_a, greedy)
+
+    return act

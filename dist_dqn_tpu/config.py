@@ -40,6 +40,7 @@ class ReplayConfig:
     burn_in: int = 0
     unroll_length: int = 0
     sequence_stride: int = 0           # overlap between stored sequences
+    priority_mix: float = 0.9          # eta: p = eta*max|td| + (1-eta)*mean
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,109 @@
+"""Scaffolding shared by the fused training loops (train_loop.py, r2d2_loop.py).
+
+Both loops are the same Anakin-style SPMD program — per-device env lanes +
+replay shard, pmean-allreduced learner — differing only in what the carry
+threads (feed-forward vs LSTM state) and which replay/learner pair they
+drive. The schedule construction, per-device rng handling and chunk-metric
+reduction live here exactly once so a fix (e.g. to beta annealing or the
+psum block) cannot silently diverge between the two.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dist_dqn_tpu.config import ExperimentConfig
+
+Array = jnp.ndarray
+
+
+def shard_sizes(cfg: ExperimentConfig, num_shards: int) -> Tuple[int, int]:
+    """Validate divisibility and return per-shard (num_envs, batch_size)."""
+    for name, total in (("num_envs", cfg.actor.num_envs),
+                        ("batch_size", cfg.learner.batch_size)):
+        if total % num_shards:
+            raise ValueError(f"{name}={total} not divisible by "
+                             f"num_shards={num_shards}")
+    return (cfg.actor.num_envs // num_shards,
+            cfg.learner.batch_size // num_shards)
+
+
+def make_schedules(cfg: ExperimentConfig, B: int, num_shards: int
+                   ) -> Tuple[Callable, Callable]:
+    """(epsilon(iteration), beta(iteration)): exploration decay and the PER
+    importance exponent annealing beta0 -> 1 over the configured run, both
+    in per-shard iteration units."""
+    epsilon = optax.linear_schedule(
+        cfg.actor.epsilon_start, cfg.actor.epsilon_end,
+        max(cfg.actor.epsilon_decay_steps // (B * num_shards), 1))
+    total_iters = max(cfg.total_env_steps // (B * num_shards), 1)
+    beta0 = cfg.replay.importance_exponent
+
+    def beta_at(iteration: Array) -> Array:
+        frac = jnp.minimum(iteration.astype(jnp.float32) / total_iters, 1.0)
+        return beta0 + (1.0 - beta0) * frac
+
+    return epsilon, beta_at
+
+
+def make_rng_splitter(spmd: bool) -> Callable:
+    """split(carry_rng, n) -> (new_carry_rng, [n] keys); in SPMD mode the
+    carry rng is a [1] key array (per-device stream) and stays that shape."""
+
+    def split(carry_rng: Array, n: int):
+        base = carry_rng[0] if spmd else carry_rng
+        keys = jax.random.split(base, n + 1)
+        new = keys[:1] if spmd else keys[0]
+        return new, keys[1:]
+
+    return split
+
+
+def reduce_chunk_metrics(carry, axis_name: Optional[str], B: int,
+                         num_shards: int) -> Tuple[Dict, Dict]:
+    """Reduce the chunk accumulators carried by either loop into the global
+    metrics dict; returns (metrics, zeroed accumulator replacements).
+
+    In SPMD mode episode stats are psum-ed (global counts), loss/train
+    counters pmean-ed (identical across devices anyway), and the returned
+    replacements keep every accumulator leaf replicated for the next chunk.
+    """
+    completed_return = carry.completed_return
+    completed_count = carry.completed_count
+    loss_sum = carry.loss_sum
+    train_count = carry.train_count
+    zero = jnp.float32(0.0)
+    replace = {}
+    if axis_name is not None:
+        completed_return = jax.lax.psum(completed_return, axis_name)
+        completed_count = jax.lax.psum(completed_count, axis_name)
+        loss_sum = jax.lax.pmean(loss_sum, axis_name)
+        train_count = jax.lax.pmean(train_count, axis_name)
+        replace = dict(completed_return=zero, completed_count=zero,
+                       loss_sum=zero, train_count=zero)
+    metrics = {
+        "env_frames": carry.iteration * B * num_shards,
+        "episode_return":
+            completed_return / jnp.maximum(completed_count, 1.0),
+        "episodes": completed_count,
+        "loss": loss_sum / jnp.maximum(train_count, 1.0),
+        "grad_steps_in_chunk": train_count,
+    }
+    return metrics, replace
+
+
+def episode_stats_update(carry, reward: Array, done: Array):
+    """Fold one step's rewards/dones into the per-env episode trackers.
+
+    Returns (ep_return, completed_return, completed_count) updates.
+    """
+    ep_return = carry.ep_return + reward
+    completed_return = carry.completed_return + jnp.sum(
+        jnp.where(done, ep_return, 0.0))
+    completed_count = carry.completed_count + jnp.sum(
+        done.astype(jnp.float32))
+    ep_return = jnp.where(done, 0.0, ep_return)
+    return ep_return, completed_return, completed_count

@@ -1,1 +1,2 @@
 from dist_dqn_tpu.models.qnets import QNetwork, NoisyDense, build_network  # noqa: F401
+from dist_dqn_tpu.models.recurrent import RecurrentQNetwork  # noqa: F401

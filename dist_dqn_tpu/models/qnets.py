@@ -168,12 +168,12 @@ def build_network(cfg: NetworkConfig, num_actions: int) -> nn.Module:
     """Build the Q-network for a config; recurrent if cfg.lstm_size > 0."""
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     if cfg.lstm_size:
-        try:
-            from dist_dqn_tpu.models.recurrent import RecurrentQNetwork
-        except ImportError as e:
-            raise NotImplementedError(
-                "recurrent (R2D2) networks land in models/recurrent.py; "
-                "this build does not include them yet") from e
+        if cfg.noisy or cfg.num_atoms > 1:
+            raise ValueError(
+                "noisy/distributional heads are not supported on the "
+                "recurrent (R2D2) network; unset noisy/num_atoms or "
+                "lstm_size")
+        from dist_dqn_tpu.models.recurrent import RecurrentQNetwork
         return RecurrentQNetwork(
             num_actions=num_actions, torso=cfg.torso,
             mlp_features=cfg.mlp_features, hidden=cfg.hidden,

@@ -1,0 +1,127 @@
+"""Recurrent (R2D2) Q-network: torso -> LSTM core -> dueling head.
+
+Covers the driver's R2D2 config (BASELINE.json:10): an LSTM Q-network whose
+single-step form drives acting (carry threaded through the fused loop) and
+whose unrolled form drives sequence learning with burn-in.
+
+TPU notes: the torso (convs — where the FLOPs are) runs in ``compute_dtype``
+(bfloat16) on the MXU; the LSTM core and heads run in float32 — the cell is
+a [B, H] x [H+E, 4H] matmul, small next to the torso, and a float32 carry
+keeps the scan numerically stable and its dtype invariant. The unrolled form
+embeds all T*B frames in ONE batched conv call (maximal MXU tiling) and only
+the tiny cell recurrence runs under ``nn.scan``.
+
+Episode boundaries: both forms accept per-step reset flags and zero the
+carry *before* consuming a post-reset observation, so a learner unroll that
+crosses an episode boundary recomputes exactly the hidden states the actor
+saw — no stale state leaks across resets.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+LSTMCarry = Tuple[Array, Array]  # (c, h), each [B, lstm_size] float32
+
+
+class _ResetCell(nn.Module):
+    """LSTM cell that zeroes its carry where ``reset`` is set.
+
+    Scanned over time by ``RecurrentQNetwork.unroll``; the single-step path
+    is a length-1 unroll of the same instance, so acting and learning share
+    parameters by construction.
+    """
+
+    lstm_size: int
+
+    @nn.compact
+    def __call__(self, carry: LSTMCarry, inputs):
+        x, reset = inputs  # x: [B, E] float32; reset: [B] bool
+        keep = (~reset).astype(jnp.float32)[:, None]
+        carry = (carry[0] * keep, carry[1] * keep)
+        new_carry, h = nn.OptimizedLSTMCell(self.lstm_size, name="lstm")(
+            carry, x)
+        return new_carry, h
+
+
+class RecurrentQNetwork(nn.Module):
+    """LSTM Q-network with optional dueling head (R2D2, BASELINE.json:10).
+
+    Two entry points sharing one parameter set (``unroll`` is the single
+    compact method; ``__call__`` is a length-1 unroll):
+      * ``apply(params, carry, obs, reset)``                  — one step
+      * ``apply(params, carry, obs, reset, method='unroll')`` — [T, B, ...]
+    Both return ``(new_carry, q)`` with q float32 ([B, A] / [T, B, A]).
+    """
+
+    num_actions: int
+    torso: str = "nature"
+    mlp_features: Tuple[int, ...] = (256, 256)
+    hidden: int = 512
+    lstm_size: int = 512
+    dueling: bool = True
+    compute_dtype: jnp.dtype = jnp.float32
+    # Present for API parity with QNetwork (scalar-Q head only).
+    num_atoms: int = 1
+    noisy: bool = False
+
+    def initial_state(self, batch_size: int) -> LSTMCarry:
+        shape = (batch_size, self.lstm_size)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+    def _embed(self, obs: Array) -> Array:
+        """[N, ...obs] -> [N, E] float32 embedding (torso + pre-LSTM dense)."""
+        from dist_dqn_tpu.models.qnets import MLPTorso, NatureCNN
+
+        x = obs
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        if self.torso == "nature":
+            x = NatureCNN(dtype=self.compute_dtype)(x)
+        elif self.torso == "mlp":
+            x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
+        else:
+            raise ValueError(f"unknown torso {self.torso!r}")
+        if self.hidden:
+            x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype,
+                                 name="embed")(x))
+        return x.astype(jnp.float32)
+
+    def _q_head(self, h: Array) -> Array:
+        """[N, H] -> [N, A] float32 (dueling combine when configured)."""
+        adv = nn.Dense(self.num_actions, name="advantage")(h)
+        if not self.dueling:
+            return adv
+        val = nn.Dense(1, name="value")(h)
+        return val + adv - jnp.mean(adv, axis=-1, keepdims=True)
+
+    def __call__(self, carry: LSTMCarry, obs: Array,
+                 reset: Optional[Array] = None
+                 ) -> Tuple[LSTMCarry, Array]:
+        """One step: obs [B, ...], reset [B] bool (None = no resets)."""
+        carry, q = self.unroll(carry, obs[None],
+                               None if reset is None else reset[None])
+        return carry, q[0]
+
+    @nn.compact
+    def unroll(self, carry: LSTMCarry, obs: Array,
+               reset: Optional[Array] = None) -> Tuple[LSTMCarry, Array]:
+        """Unrolled: obs [T, B, ...], reset [T, B]; returns q [T, B, A].
+
+        reset[t] zeroes the carry before step t (i.e. obs[t] opens a new
+        episode). The torso runs once over the flattened [T*B] batch.
+        """
+        T, B = obs.shape[:2]
+        if reset is None:
+            reset = jnp.zeros((T, B), jnp.bool_)
+        x = self._embed(obs.reshape((T * B,) + obs.shape[2:]))
+        x = x.reshape((T, B, -1))
+        core = nn.scan(_ResetCell, variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=0, out_axes=0)(self.lstm_size, name="core")
+        carry, hs = core(carry, (x, reset))
+        q = self._q_head(hs.reshape((T * B, -1)))
+        return carry, q.reshape((T, B, self.num_actions))

@@ -1,2 +1,3 @@
 from dist_dqn_tpu.parallel.mesh import make_mesh  # noqa: F401
-from dist_dqn_tpu.parallel.learner import make_mesh_fused_train  # noqa: F401
+from dist_dqn_tpu.parallel.learner import (  # noqa: F401
+    make_mesh_fused_train, make_mesh_r2d2_train)

@@ -1,20 +1,20 @@
 """Multi-chip fused training: shard_map over the ICI mesh.
 
-Composition of the per-device fused loop (train_loop.py) into the pod-scale
-program the driver describes (BASELINE.json:5):
+Composition of the per-device fused loops (train_loop.py, r2d2_loop.py) into
+the pod-scale program the driver describes (BASELINE.json:5):
 
   * envs + replay shard over the ``dp`` mesh axis — each device rolls out
     its own env lanes and owns one replay shard in its HBM (the TPU-native
     reading of "replay shards across TPU-VM host DRAM"; the host-DRAM
     variant for external envs is replay/host.py + actors/),
   * learner state is replicated; gradients cross the ICI once per update
-    via ``pmean`` inside the learner (agents/dqn.py) — the NCCL-allreduce
-    replacement,
+    via ``pmean`` inside the learner (agents/dqn.py, agents/r2d2.py) — the
+    NCCL-allreduce replacement,
   * chunk metrics are psum-reduced so the host sees global numbers.
 
-Everything below is spec plumbing: which TrainCarry leaves live on which
-mesh axis. The actual math is unchanged single-device code — that's the
-point of SPMD.
+Everything below is spec plumbing: which carry leaves live on which mesh
+axis. The actual math is unchanged single-device code — that's the point
+of SPMD.
 """
 from __future__ import annotations
 
@@ -32,55 +32,102 @@ from dist_dqn_tpu.replay.prioritized_device import PrioritizedRingState
 from dist_dqn_tpu.train_loop import TrainCarry, make_fused_train
 
 
+def _ring_spec(axis: str) -> TimeRingState:
+    """Ring leaves are [slots, envs, ...]: env axis 1 sharded."""
+    shard1 = P(None, axis)
+    repl = P()
+    return TimeRingState(
+        obs=shard1, action=shard1, reward=shard1, terminated=shard1,
+        truncated=shard1, final_obs=shard1, pos=repl, size=repl)
+
+
+def _learner_spec() -> LearnerState:
+    repl = P()
+    return LearnerState(params=repl, target_params=repl, opt_state=repl,
+                        steps=repl, rng=repl)
+
+
 def _carry_specs(prioritized: bool, axis: str) -> TrainCarry:
     """Pytree-prefix PartitionSpecs for every TrainCarry field.
 
-    Env-batched leaves shard their env axis; ring leaves are [slots, envs,
-    ...] so they shard axis 1; learner state and scalar counters are
-    replicated (kept consistent by pmean/psum inside the body).
+    Env-batched leaves shard their env axis; learner state and scalar
+    counters are replicated (kept consistent by pmean/psum inside the body).
     """
     shard0 = P(axis)            # leading env axis
-    shard1 = P(None, axis)      # ring layout [T, B, ...]
+    shard1 = P(None, axis)
     repl = P()
-    ring_spec = TimeRingState(
-        obs=shard1, action=shard1, reward=shard1, terminated=shard1,
-        truncated=shard1, final_obs=shard1, pos=repl, size=repl)
+    ring_spec = _ring_spec(axis)
     replay_spec = (PrioritizedRingState(ring=ring_spec, priorities=shard1,
                                         max_priority=repl)
                    if prioritized else ring_spec)
-    learner_spec = LearnerState(params=repl, target_params=repl,
-                                opt_state=repl, steps=repl, rng=repl)
     return TrainCarry(
         env_state=shard0, obs=shard0, replay=replay_spec,
-        learner=learner_spec, rng=shard0, iteration=repl,
+        learner=_learner_spec(), rng=shard0, iteration=repl,
         ep_return=shard0, completed_return=repl, completed_count=repl,
         loss_sum=repl, train_count=repl)
 
 
-def make_mesh_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
-                          mesh: Mesh, axis: str = "dp"):
-    """Returns (init, run) on GLOBAL arrays: ``init(key)`` builds the pod-
-    wide carry; ``run(carry, num_iters)`` executes a fused chunk across the
-    mesh and reports global metrics. Both are jit-compiled; the carry is
-    donated so replay shards update in place in each device's HBM.
-    """
-    ndp = mesh.shape[axis]
-    init_local, run_local = make_fused_train(cfg, env, net, axis_name=axis,
-                                             num_shards=ndp)
-    specs = _carry_specs(cfg.replay.prioritized, axis)
+def _r2d2_carry_specs(axis: str) -> "R2D2Carry":
+    """R2D2 carry: same layout story plus the actor LSTM carry ([B, lstm] —
+    env axis sharded) and the stored per-step recurrent-state planes
+    ([T, B, lstm] — env axis 1 sharded)."""
+    from dist_dqn_tpu.r2d2_loop import R2D2Carry
+    from dist_dqn_tpu.replay.sequence_device import SequenceRingState
 
+    shard0 = P(axis)
+    shard1 = P(None, axis)
+    repl = P()
+    replay_spec = SequenceRingState(
+        ring=_ring_spec(axis), state_c=shard1, state_h=shard1,
+        priorities=shard1, max_priority=repl, writes=repl)
+    return R2D2Carry(
+        env_state=shard0, obs=shard0, actor_carry=(shard0, shard0),
+        replay=replay_spec, learner=_learner_spec(), rng=shard0,
+        iteration=repl, ep_return=shard0, completed_return=repl,
+        completed_count=repl, loss_sum=repl, train_count=repl)
+
+
+def _mesh_wrap(mesh: Mesh, specs, init_local, run_local):
+    """Lift per-device (init, run_chunk) bodies to jit-compiled functions on
+    GLOBAL arrays; the carry is donated so replay shards update in place in
+    each device's HBM."""
     init = jax.jit(
         jax.shard_map(init_local, mesh=mesh, in_specs=P(),
                       out_specs=specs, check_vma=False))
 
     @partial(jax.jit, static_argnums=1, donate_argnums=0)
-    def run(carry: TrainCarry, num_iters: int):
+    def run(carry, num_iters: int):
         body = jax.shard_map(
             lambda c: run_local(c, num_iters), mesh=mesh,
             in_specs=(specs,), out_specs=(specs, P()), check_vma=False)
         return body(carry)
 
     return init, run
+
+
+def make_mesh_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
+                          mesh: Mesh, axis: str = "dp"):
+    """Returns (init, run) on GLOBAL arrays: ``init(key)`` builds the pod-
+    wide carry; ``run(carry, num_iters)`` executes a fused chunk across the
+    mesh and reports global metrics."""
+    ndp = mesh.shape[axis]
+    init_local, run_local = make_fused_train(cfg, env, net, axis_name=axis,
+                                             num_shards=ndp)
+    return _mesh_wrap(mesh, _carry_specs(cfg.replay.prioritized, axis),
+                      init_local, run_local)
+
+
+def make_mesh_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
+                         mesh: Mesh, axis: str = "dp"):
+    """R2D2 across the mesh: env lanes + sequence-replay shard per device,
+    sequence learner pmean-allreduced — same contract as
+    ``make_mesh_fused_train`` (BASELINE.json:5,10)."""
+    from dist_dqn_tpu.r2d2_loop import make_r2d2_train
+
+    ndp = mesh.shape[axis]
+    init_local, run_local = make_r2d2_train(cfg, env, net, axis_name=axis,
+                                            num_shards=ndp)
+    return _mesh_wrap(mesh, _r2d2_carry_specs(axis), init_local, run_local)
 
 
 def global_metrics(metrics: Dict) -> Dict:

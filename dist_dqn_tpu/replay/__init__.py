@@ -6,3 +6,6 @@ from dist_dqn_tpu.replay.host import (  # noqa: F401
 from dist_dqn_tpu.replay.prioritized_device import (  # noqa: F401
     PrioritizedRingState, prioritized_ring_add, prioritized_ring_init,
     prioritized_ring_sample, prioritized_ring_update)
+from dist_dqn_tpu.replay.sequence_device import (  # noqa: F401
+    SequenceRingState, sequence_ring_add, sequence_ring_can_sample,
+    sequence_ring_init, sequence_ring_sample, sequence_ring_update)

@@ -1,0 +1,162 @@
+"""On-device R2D2 sequence replay over the time-ring (BASELINE.json:10).
+
+The reference's sequence replay stores fixed-length (burn-in + unroll)
+trajectory slices with the recurrent state at the slice start. The TPU-native
+layout reuses the time-ring (replay/device.py): every step is stored exactly
+once as a [T, B] slice together with the actor's LSTM carry *entering* that
+step, and a "sequence" is just a length-L window gather at sample time —
+overlapping sequences (stride < L) therefore cost zero extra HBM, where the
+reference's per-sequence storage pays length/stride x duplication.
+
+Window starts are seeded into the priority plane only every
+``sequence_stride`` writes (classic R2D2 overlap control): a slot's row gets
+the running max priority the moment its full window lands in the ring, and
+is cleared when the ring overwrites it — so ``priorities > 0`` is exactly
+the valid-start set, and the same stratified inverse-CDF sampler as the
+transition path (replay/prioritized_device.py) draws from it.
+
+Priorities are per-sequence (eta-mix of max/mean |TD| is computed by the
+learner, agents/r2d2.py); stored raw with alpha applied at sample time.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dist_dqn_tpu.replay import device as ring
+from dist_dqn_tpu.types import PyTree, SequenceSample
+
+Array = jnp.ndarray
+
+
+class SequenceRingState(NamedTuple):
+    ring: ring.TimeRingState
+    state_c: Array       # [T, B, lstm] float32 — carry entering each step
+    state_h: Array       # [T, B, lstm] float32
+    priorities: Array    # [T, B] float32; >0 exactly at valid window starts
+    max_priority: Array  # scalar float32 — seed for fresh windows
+    writes: Array        # scalar int32 — total time slices ever written
+
+
+def sequence_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
+                       lstm_size: int) -> SequenceRingState:
+    return SequenceRingState(
+        ring=ring.time_ring_init(num_slots, num_envs, obs_example,
+                                 store_final_obs=False),
+        state_c=jnp.zeros((num_slots, num_envs, lstm_size), jnp.float32),
+        state_h=jnp.zeros((num_slots, num_envs, lstm_size), jnp.float32),
+        priorities=jnp.zeros((num_slots, num_envs), jnp.float32),
+        max_priority=jnp.float32(1.0),
+        writes=jnp.int32(0),
+    )
+
+
+def sequence_ring_add(state: SequenceRingState, obs: PyTree, action: Array,
+                      reward: Array, terminated: Array, truncated: Array,
+                      carry: Tuple[Array, Array], seq_len: int,
+                      stride: int) -> SequenceRingState:
+    """Append one time slice plus the actor carry that produced ``action``.
+
+    ``seq_len`` (L) and ``stride`` are static. Overwriting slot ``p``
+    invalidates the window starting at ``p`` (it is the oldest slot of any
+    window containing it), so its priority row is cleared; the newest slot
+    whose full window just completed — write index ``writes + 1 - L`` — is
+    seeded with the running max priority when stride-aligned.
+    """
+    num_slots = state.priorities.shape[0]
+    p = state.ring.pos
+    new_ring = ring.time_ring_add(state.ring, obs, action, reward,
+                                  terminated, truncated)
+    writes = state.writes + 1
+
+    priorities = state.priorities.at[p].set(0.0)
+    start_write = writes - seq_len                 # write index of new start
+    s = (p - (seq_len - 1)) % num_slots
+    seed = jnp.logical_and(start_write >= 0, (start_write % stride) == 0)
+    row = jnp.where(seed, state.max_priority, priorities[s])
+    priorities = priorities.at[s].set(row)
+
+    return SequenceRingState(
+        ring=new_ring,
+        state_c=state.state_c.at[p].set(carry[0].astype(jnp.float32)),
+        state_h=state.state_h.at[p].set(carry[1].astype(jnp.float32)),
+        priorities=priorities,
+        max_priority=state.max_priority,
+        writes=writes,
+    )
+
+
+def sequence_ring_can_sample(state: SequenceRingState, seq_len: int) -> Array:
+    """True once the first full window has been seeded."""
+    return state.writes >= seq_len
+
+
+def _gather_seq(field: Array, t_idx: Array, b_idx: Array, L: int,
+                num_slots: int) -> Array:
+    """[T, B, ...] field -> [L, S, ...] windows (time-major)."""
+    offs = jnp.arange(L, dtype=jnp.int32)
+    tt = (t_idx[None, :] + offs[:, None]) % num_slots   # [L, S]
+    return field[tt, b_idx[None, :]]
+
+
+def sequence_ring_sample(state: SequenceRingState, rng: Array,
+                         batch_size: int, seq_len: int, alpha: float,
+                         beta: Array) -> SequenceSample:
+    """Stratified-CDF sample of ``batch_size`` length-``seq_len`` sequences.
+
+    Same inverse-CDF machinery as the transition sampler: the priority plane
+    is already masked (zero = invalid start), so one cumsum + searchsorted
+    draws ~ p^alpha and yields the total mass for importance weights free.
+    """
+    num_slots, num_envs = state.priorities.shape
+    flat = (state.priorities ** alpha).reshape(-1)
+    flat = jnp.where(state.priorities.reshape(-1) > 0.0, flat, 0.0)
+    cdf = jnp.cumsum(flat)
+    total = cdf[-1]
+
+    u = (jnp.arange(batch_size, dtype=jnp.float32)
+         + jax.random.uniform(rng, (batch_size,))) / batch_size * total
+    idx = jnp.clip(jnp.searchsorted(cdf, u), 0, flat.shape[0] - 1)
+    t_idx = (idx // num_envs).astype(jnp.int32)
+    b_idx = (idx % num_envs).astype(jnp.int32)
+
+    n_valid = jnp.sum((flat > 0.0).astype(jnp.float32))
+    p_sel = jnp.maximum(flat[idx], 1e-12) / jnp.maximum(total, 1e-12)
+    weights = (jnp.maximum(n_valid, 1.0) * p_sel) ** (-beta)
+    weights = weights / jnp.maximum(jnp.max(weights), 1e-12)
+
+    r = state.ring
+    obs = jax.tree.map(
+        lambda x: _gather_seq(x, t_idx, b_idx, seq_len, num_slots), r.obs)
+    action = _gather_seq(r.action, t_idx, b_idx, seq_len, num_slots)
+    reward = _gather_seq(r.reward, t_idx, b_idx, seq_len, num_slots)
+    term = _gather_seq(r.terminated, t_idx, b_idx, seq_len, num_slots)
+    trunc = _gather_seq(r.truncated, t_idx, b_idx, seq_len, num_slots)
+    done = jnp.logical_or(term, trunc)
+    # obs[t] opens a new episode iff the previous stored step ended one. The
+    # first step never resets: its stored carry is already episode-correct.
+    reset = jnp.concatenate(
+        [jnp.zeros((1, batch_size), jnp.bool_), done[:-1]], axis=0)
+    start_state = (state.state_c[t_idx, b_idx], state.state_h[t_idx, b_idx])
+    return SequenceSample(obs=obs, action=action, reward=reward, done=done,
+                          reset=reset, start_state=start_state,
+                          weights=weights, t_idx=t_idx, b_idx=b_idx)
+
+
+def sequence_ring_update(state: SequenceRingState, t_idx: Array,
+                         b_idx: Array, new_priorities: Array,
+                         eps: float = 1e-6) -> SequenceRingState:
+    """Write back learner per-sequence priorities for the sampled windows.
+
+    Guarded by ``priorities > 0`` at the written cell so a start that was
+    overwritten (cleared) between sample and update cannot be resurrected.
+    """
+    p = jnp.abs(new_priorities) + eps
+    still_valid = state.priorities[t_idx, b_idx] > 0.0
+    p = jnp.where(still_valid, p, 0.0)
+    priorities = state.priorities.at[t_idx, b_idx].set(p)
+    return state._replace(
+        priorities=priorities,
+        max_priority=jnp.maximum(state.max_priority, jnp.max(p)))

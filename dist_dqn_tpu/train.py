@@ -29,9 +29,16 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     env = make_jax_env(cfg.env_name)
     net = build_network(cfg.network, env.num_actions)
 
-    init, run_chunk = make_fused_train(cfg, env, net)
-    evaluate = jax.jit(make_evaluator(cfg, env, net,
-                                      num_episodes=cfg.eval_episodes))
+    if cfg.network.lstm_size:
+        from dist_dqn_tpu.r2d2_loop import make_r2d2_evaluator, \
+            make_r2d2_train
+        init, run_chunk = make_r2d2_train(cfg, env, net)
+        evaluate = jax.jit(make_r2d2_evaluator(
+            cfg, env, net, num_episodes=cfg.eval_episodes))
+    else:
+        init, run_chunk = make_fused_train(cfg, env, net)
+        evaluate = jax.jit(make_evaluator(cfg, env, net,
+                                          num_episodes=cfg.eval_episodes))
     run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
 
     rng = jax.random.PRNGKey(seed)

@@ -19,8 +19,8 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
+from dist_dqn_tpu import loop_common
 from dist_dqn_tpu.agents.dqn import LearnerState, make_actor_step, \
     make_learner
 from dist_dqn_tpu.config import ExperimentConfig
@@ -61,13 +61,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     init_learner, train_step = make_learner(net, cfg.learner,
                                             axis_name=axis_name)
     act = make_actor_step(net)
-    for name, total in (("num_envs", cfg.actor.num_envs),
-                        ("batch_size", cfg.learner.batch_size)):
-        if total % num_shards:
-            raise ValueError(f"{name}={total} not divisible by "
-                             f"num_shards={num_shards}")
-    B = cfg.actor.num_envs // num_shards
-    batch_size = cfg.learner.batch_size // num_shards
+    B, batch_size = loop_common.shard_sizes(cfg, num_shards)
     min_fill = max(cfg.replay.min_fill // num_shards, 1)
     num_slots = max(cfg.replay.capacity // (B * num_shards),
                     cfg.learner.n_step + 2)
@@ -75,22 +69,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     # rings skip final_obs to halve HBM use (truncation treated as terminal).
     store_final = env.observation_dtype != jnp.uint8
 
-    epsilon = optax.linear_schedule(
-        cfg.actor.epsilon_start, cfg.actor.epsilon_end,
-        max(cfg.actor.epsilon_decay_steps // (B * num_shards), 1))
-    # PER importance exponent anneals beta0 -> 1 over the configured run.
-    total_iters = max(cfg.total_env_steps // (B * num_shards), 1)
-    beta0 = cfg.replay.importance_exponent
-
-    def beta_at(iteration: Array) -> Array:
-        frac = jnp.minimum(iteration.astype(jnp.float32) / total_iters, 1.0)
-        return beta0 + (1.0 - beta0) * frac
-
-    def _split_rng(carry_rng: Array, n: int):
-        base = carry_rng[0] if spmd else carry_rng
-        keys = jax.random.split(base, n + 1)
-        new = keys[:1] if spmd else keys[0]
-        return new, keys[1:]
+    epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
+    _split_rng = loop_common.make_rng_splitter(spmd)
 
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay
@@ -182,12 +162,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
             (carry.learner, replay))
 
         done = jnp.logical_or(out.terminated, out.truncated)
-        ep_return = carry.ep_return + out.reward
-        completed_return = carry.completed_return + jnp.sum(
-            jnp.where(done, ep_return, 0.0))
-        completed_count = carry.completed_count + jnp.sum(
-            done.astype(jnp.float32))
-        ep_return = jnp.where(done, 0.0, ep_return)
+        ep_return, completed_return, completed_count = \
+            loop_common.episode_stats_update(carry, out.reward, done)
 
         return TrainCarry(
             env_state=env_state, obs=out.obs, replay=replay, learner=learner,
@@ -208,32 +184,15 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         carry = carry._replace(completed_return=zero, completed_count=zero,
                                loss_sum=zero, train_count=zero)
         carry, _ = jax.lax.scan(one_iteration, carry, None, length=num_iters)
-
-        completed_return = carry.completed_return
-        completed_count = carry.completed_count
-        loss_sum = carry.loss_sum
-        train_count = carry.train_count
-        if spmd:
-            completed_return = jax.lax.psum(completed_return, axis_name)
-            completed_count = jax.lax.psum(completed_count, axis_name)
-            loss_sum = jax.lax.pmean(loss_sum, axis_name)
-            train_count = jax.lax.pmean(train_count, axis_name)
-            carry = carry._replace(completed_return=zero,
-                                   completed_count=zero, loss_sum=zero,
-                                   train_count=zero)
-            if prioritized:
-                # Keep the new-item priority seed replicated (global max).
-                carry = carry._replace(replay=carry.replay._replace(
-                    max_priority=jax.lax.pmax(carry.replay.max_priority,
-                                              axis_name)))
-        metrics = {
-            "env_frames": carry.iteration * B * num_shards,
-            "episode_return":
-                completed_return / jnp.maximum(completed_count, 1.0),
-            "episodes": completed_count,
-            "loss": loss_sum / jnp.maximum(train_count, 1.0),
-            "grad_steps_in_chunk": train_count,
-        }
+        metrics, replace = loop_common.reduce_chunk_metrics(
+            carry, axis_name, B, num_shards)
+        if spmd and prioritized:
+            # Keep the new-item priority seed replicated (global max).
+            replace["replay"] = carry.replay._replace(
+                max_priority=jax.lax.pmax(carry.replay.max_priority,
+                                          axis_name))
+        if replace:
+            carry = carry._replace(**replace)
         return carry, metrics
 
     return init, run_chunk

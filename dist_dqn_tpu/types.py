@@ -46,14 +46,19 @@ class Transition(NamedTuple):
 class SequenceSample(NamedTuple):
     """A batch of fixed-length sequences for R2D2 (BASELINE.json:10).
 
-    Time-major inner layout: arrays are [B, T, ...] with
-    T = burn_in + unroll_length. ``start_state`` is the recurrent state at the
-    first burn-in step, as stored by the actor that generated the sequence.
+    Time-major layout (what an LSTM unroll consumes): arrays are [T, S, ...]
+    with T = burn_in + unroll_length + n_step (the trailing n_step slots are
+    the within-window bootstrap region) and S sequences. ``start_state`` is
+    the recurrent state the actor held *entering* the first step, so a
+    learner unroll from it reproduces the actor's hidden states exactly.
     """
 
-    obs: PyTree            # [B, T, ...]
-    action: jnp.ndarray    # [B, T]
-    reward: jnp.ndarray    # [B, T]
-    discount: jnp.ndarray  # [B, T]
-    start_state: PyTree    # recurrent state, leaves [B, ...]
-    mask: jnp.ndarray      # [B, T] float32 — 1 where loss is valid
+    obs: PyTree            # [T, S, ...]
+    action: jnp.ndarray    # [T, S] int32
+    reward: jnp.ndarray    # [T, S] float32
+    done: jnp.ndarray      # [T, S] bool — terminated|truncated at that step
+    reset: jnp.ndarray     # [T, S] bool — obs[t] opens a new episode
+    start_state: PyTree    # recurrent state, leaves [S, ...]
+    weights: jnp.ndarray   # [S] importance-sampling weights
+    t_idx: jnp.ndarray     # [S] ring slot of each sequence start
+    b_idx: jnp.ndarray     # [S] env lane of each sequence

@@ -87,6 +87,38 @@ def _tiny_cartpole_cfg(prioritized: bool):
     )
 
 
+def test_mesh_r2d2_train_runs(mesh):
+    """R2D2 across the mesh: sequence replay sharded, learner allreduced."""
+    from dist_dqn_tpu.parallel import make_mesh_r2d2_train
+
+    cfg = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="cartpole",
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    lstm_size=8, compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=64,
+                                   burn_in=2, unroll_length=4,
+                                   sequence_stride=2),
+        learner=dataclasses.replace(cfg.learner, n_step=2, batch_size=32),
+        actor=dataclasses.replace(cfg.actor, num_envs=16),
+        total_env_steps=4000,
+    )
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run = make_mesh_r2d2_train(cfg, env, net, mesh)
+    carry = init(jax.random.PRNGKey(0))
+    carry, metrics = run(carry, 40)
+    carry, metrics = run(carry, 40)
+    assert int(metrics["env_frames"]) == 80 * 16
+    assert float(metrics["grad_steps_in_chunk"]) > 0
+    assert np.isfinite(float(metrics["loss"]))
+    p0 = jax.tree.leaves(carry.learner.params)[0]
+    assert np.all(np.isfinite(np.asarray(p0)))
+    assert len(carry.ep_return.sharding.device_set) == 8
+
+
 @pytest.mark.parametrize("prioritized", [False, True])
 def test_mesh_fused_train_runs(mesh, prioritized):
     cfg = _tiny_cartpole_cfg(prioritized)
