@@ -19,14 +19,9 @@ def make_jax_env(name: str, **kwargs):
     if name == "pixel_pong":
         return PixelPong(**kwargs)
     if name == "dmc_pixels":
-        # Offline stand-in: the DM-Control config runs on the synthetic pixel
-        # env when MuJoCo rendering is unavailable (no network / headless).
-        try:
-            from dist_dqn_tpu.envs.pixel_reacher import PixelReacher
-        except ImportError as e:
-            raise NotImplementedError(
-                "the DM-Control pixel env (and its synthetic stand-in) "
-                "lands in envs/pixel_reacher.py; not in this build yet"
-            ) from e
+        # The fused on-device loop cannot host MuJoCo; it runs the synthetic
+        # DMC-shaped reacher. Real dm_control pixels go through the host
+        # adapter (envs/dmc_adapter.py) behind the Ape-X actors.
+        from dist_dqn_tpu.envs.pixel_reacher import PixelReacher
         return PixelReacher(**kwargs)
     raise KeyError(f"unknown JAX env {name!r}")

@@ -152,8 +152,24 @@ def make_host_env(name: str, num_envs: int, seed: int = 0) -> HostVectorEnv:
     """Build a host vector env by name.
 
     ``"CartPole-v1"`` etc. -> plain gymnasium; ``"ale:<Game>"`` -> ALE with
-    Atari preprocessing (requires ale-py; raises a clear error otherwise).
+    Atari preprocessing (requires ale-py; raises a clear error otherwise);
+    ``"dmc:<domain>:<task>"`` -> DM-Control pixels with discretized torques
+    (envs/dmc_adapter.py, BASELINE.json:11).
     """
+    if name.startswith("dmc:"):
+        from dist_dqn_tpu.envs.dmc_adapter import DMCPixelEnv
+
+        parts = name.split(":", 2)
+        if len(parts) != 3 or not all(parts[1:]):
+            raise ValueError(
+                f"DMC env name must be 'dmc:<domain>:<task>', got {name!r}")
+        _, domain, task = parts
+
+        def make_fn():
+            return DMCPixelEnv(domain, task)
+
+        return HostVectorEnv(make_fn, num_envs, seed=seed)
+
     import gymnasium
 
     if name.startswith("ale:"):
