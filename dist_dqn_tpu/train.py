@@ -22,8 +22,15 @@ from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
 
 
 def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
-          chunk_iters: int = 2000, log_fn=print):
-    """Run training; returns (final_carry, history list of metric dicts)."""
+          chunk_iters: int = 2000, log_fn=print,
+          checkpoint_dir: str = None, save_every_frames: int = 0):
+    """Run training; returns (final_carry, history list of metric dicts).
+
+    With ``checkpoint_dir`` set, the learner state is checkpointed every
+    ``save_every_frames`` env frames (default: every eval period) and the
+    newest checkpoint is restored on startup — actors/replay are stateless
+    and refill, per the failure model in SURVEY.md §5.
+    """
     seed = cfg.seed if seed is None else seed
     total = total_env_steps or cfg.total_env_steps
     env = make_jax_env(cfg.env_name)
@@ -45,16 +52,33 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     rng, k_init = jax.random.split(rng)
     carry = init(k_init)
 
+    ckpt = None
+    frame_offset = 0
+    if checkpoint_dir:
+        from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+        ckpt = TrainCheckpointer(
+            checkpoint_dir,
+            save_every_frames=save_every_frames or cfg.eval_every_steps)
+        restored = ckpt.restore_latest(carry.learner)
+        if restored is not None:
+            # Resume continues toward the SAME total: the frame cursor picks
+            # up at the checkpoint step so relaunching the identical command
+            # finishes the remaining frames (and later saves land at
+            # monotonically increasing orbax steps).
+            frame_offset, learner = restored
+            carry = carry._replace(learner=learner)
+            log_fn(json.dumps({"resumed_at_frames": frame_offset}))
+
     B = cfg.actor.num_envs
     history = []
-    frames = 0
-    next_eval = 0
+    frames = frame_offset
+    next_eval = frames
     while frames < total:
         t0 = time.perf_counter()
         carry, metrics = run(carry, chunk_iters)
         metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
         dt = time.perf_counter() - t0
-        frames = int(metrics["env_frames"])
+        frames = frame_offset + int(metrics["env_frames"])
         row = {
             "env_frames": frames,
             "episode_return": float(metrics["episode_return"]),
@@ -69,6 +93,11 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
         history.append(row)
         log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
                            for k, v in row.items()}))
+        if ckpt is not None:
+            ckpt.maybe_save(frames, carry.learner)
+    if ckpt is not None:
+        ckpt.save(frames, carry.learner)
+        ckpt.close()
     return carry, history
 
 
@@ -78,6 +107,12 @@ def main():
     parser.add_argument("--total-env-steps", type=int, default=0)
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--chunk-iters", type=int, default=2000)
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="enable learner checkpoint/resume under this "
+                             "directory (orbax; restores newest on start)")
+    parser.add_argument("--save-every-frames", type=int, default=0,
+                        help="checkpoint period in env frames "
+                             "(default: eval_every_steps)")
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
@@ -99,7 +134,7 @@ def main():
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
-        if not args.host_env.startswith("ale:"):
+        if not args.host_env.startswith(("ale:", "dmc:")):
             # Non-pixel host env: the config's Nature-CNN torso can't eat
             # flat observations — swap in the MLP torso, keep the rest.
             print(f"# host env {args.host_env} is non-pixel: using MLP torso")
@@ -113,7 +148,8 @@ def main():
         print(json.dumps(run_apex(cfg, rt)))
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
-          chunk_iters=args.chunk_iters)
+          chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
+          save_every_frames=args.save_every_frames)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,101 @@
+"""Checkpoint/resume via orbax (SURVEY.md §5).
+
+Failure model of the actor/learner architecture: actors are stateless
+workers (they re-pull params after a restart), replay refills from live
+experience, so the *learner state* — params, target params, optimizer
+moments, step counters — is the recovery point. Checkpoints therefore hold
+the learner pytree plus the host-side training cursor (env frames), not the
+replay ring: a pixel ring is GBs of HBM that regenerates in minutes, and
+skipping it keeps checkpoints small enough to write frequently.
+
+Orbax handles the pytree IO (async-capable, atomic renames, works with
+sharded jax.Arrays on a mesh — global arrays are saved/restored with their
+shardings, so a pod checkpoint restores onto the same mesh layout).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dist_dqn_tpu.types import PyTree
+
+
+@dataclasses.dataclass
+class TrainCheckpointer:
+    """Periodic learner-state checkpoints with retention + resume.
+
+    Usage:
+      ckpt = TrainCheckpointer(dir, save_every_frames=100_000)
+      start = ckpt.restore_latest(learner)   # (frames, learner) or None
+      ...
+      ckpt.maybe_save(frames, learner)       # inside the training loop
+    """
+
+    directory: str
+    save_every_frames: int = 100_000
+    max_to_keep: int = 3
+
+    def __post_init__(self):
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self.max_to_keep, create=True),
+        )
+        self._next_save = 0
+
+    def maybe_save(self, frames: int, learner: PyTree) -> bool:
+        """Save when the frame cursor crosses the next save boundary."""
+        if frames < self._next_save:
+            return False
+        self.save(frames, learner)
+        self._next_save = frames + self.save_every_frames
+        return True
+
+    def save(self, frames: int, learner: PyTree) -> None:
+        self._mgr.save(frames, args=ocp.args.StandardSave(learner))
+
+    def wait(self) -> None:
+        """Block until any async save landed (call before process exit)."""
+        self._mgr.wait_until_finished()
+
+    def restore_latest(self, example: PyTree
+                       ) -> Optional[Tuple[int, PyTree]]:
+        """Restore the newest checkpoint as (frames, learner), or None.
+
+        ``example`` is a live learner pytree of the target structure; its
+        shapes/dtypes/shardings template the restore, so restoring onto a
+        different mesh layout re-shards on load.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(x), x.dtype,
+                sharding=getattr(x, "sharding", None)),
+            example)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        self._next_save = step + self.save_every_frames
+        return int(step), restored
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def save_pytree(path: str, tree: PyTree) -> None:
+    """One-shot pytree save (e.g. final params export)."""
+    ocp.StandardCheckpointer().save(path, tree, force=True)
+
+
+def restore_pytree(path: str, example: PyTree) -> Any:
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                       sharding=getattr(x, "sharding", None)),
+        example)
+    return ocp.StandardCheckpointer().restore(path, abstract)
