@@ -36,6 +36,7 @@ class ReplayConfig:
     importance_exponent: float = 0.4   # beta (annealed -> 1.0 over training)
     priority_eps: float = 1e-6
     min_fill: int = 1_000              # learning starts after this many items
+    pallas_sampler: bool = False       # Pallas kernel for priority sampling
     # R2D2 sequence replay (>0 enables sequence mode):
     burn_in: int = 0
     unroll_length: int = 0
@@ -135,7 +136,10 @@ APEX = ExperimentConfig(
                           compute_dtype="bfloat16"),
     replay=ReplayConfig(capacity=1_000_000, prioritized=True,
                         priority_exponent=0.6, importance_exponent=0.4,
-                        min_fill=50_000),
+                        min_fill=50_000,
+                        # ~1M-cell shard: above the Pallas kernel's
+                        # crossover (ops/pallas_sampler.py).
+                        pallas_sampler=True),
     learner=LearnerConfig(
         learning_rate=1e-4, adam_eps=1.5e-4, gamma=0.99, n_step=3,
         batch_size=512, double_dqn=True, target_update_period=2_500,

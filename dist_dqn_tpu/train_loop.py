@@ -71,6 +71,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
 
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
     _split_rng = loop_common.make_rng_splitter(spmd)
+    # Pallas kernels compile only on real TPU backends; anywhere else the
+    # kernel runs in the (slow) interpreter, so tests keep sizes tiny.
+    use_pallas = prioritized and cfg.replay.pallas_sampler
+    pallas_interpret = jax.default_backend() != "tpu"
 
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay
@@ -135,7 +139,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                     s = pring.prioritized_ring_sample(
                         rep, key, batch_size, cfg.learner.n_step,
                         cfg.learner.gamma, cfg.replay.priority_exponent,
-                        beta)
+                        beta, use_pallas=use_pallas,
+                        pallas_interpret=pallas_interpret)
                     l, metrics = train_step(l, s.batch, s.weights)
                     rep = pring.prioritized_ring_update(
                         rep, s.t_idx, s.b_idx, metrics["priorities"],
