@@ -23,13 +23,16 @@ from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
 
 def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
-          checkpoint_dir: str = None, save_every_frames: int = 0):
+          checkpoint_dir: str = None, save_every_frames: int = 0,
+          profile_dir: str = None):
     """Run training; returns (final_carry, history list of metric dicts).
 
     With ``checkpoint_dir`` set, the learner state is checkpointed every
     ``save_every_frames`` env frames (default: every eval period) and the
     newest checkpoint is restored on startup — actors/replay are stateless
-    and refill, per the failure model in SURVEY.md §5.
+    and refill, per the failure model in SURVEY.md §5. With ``profile_dir``
+    set, the second chunk (first post-compile) is captured as a
+    ``jax.profiler`` trace for TensorBoard/xprof (SURVEY.md §5).
     """
     seed = cfg.seed if seed is None else seed
     total = total_env_steps or cfg.total_env_steps
@@ -73,11 +76,22 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     history = []
     frames = frame_offset
     next_eval = frames
+    chunk_index = 0
+    # Trace the second chunk (the first is compile+warmup noise) — unless
+    # the whole run fits in one chunk, then trace that one rather than none.
+    profile_chunk = 1 if total > frames + chunk_iters * B else 0
     while frames < total:
+        profiling = profile_dir is not None and chunk_index == profile_chunk
+        if profiling:
+            jax.profiler.start_trace(profile_dir)
         t0 = time.perf_counter()
         carry, metrics = run(carry, chunk_iters)
         metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
         dt = time.perf_counter() - t0
+        if profiling:
+            jax.profiler.stop_trace()
+            log_fn(json.dumps({"profile_trace": profile_dir}))
+        chunk_index += 1
         frames = frame_offset + int(metrics["env_frames"])
         row = {
             "env_frames": frames,
@@ -113,6 +127,10 @@ def main():
     parser.add_argument("--save-every-frames", type=int, default=0,
                         help="checkpoint period in env frames "
                              "(default: eval_every_steps)")
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace of the first "
+                             "post-warmup chunk into this directory "
+                             "(view with TensorBoard / xprof)")
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
@@ -131,6 +149,9 @@ def main():
         jax.config.update("jax_platforms", args.platform)
     cfg = CONFIGS[args.config]
     if args.runtime == "apex":
+        if args.profile_dir:
+            print("# --profile-dir applies to the fused runtime only; "
+                  "ignored under --runtime apex")
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
@@ -149,7 +170,8 @@ def main():
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
           chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
-          save_every_frames=args.save_every_frames)
+          save_every_frames=args.save_every_frames,
+          profile_dir=args.profile_dir)
 
 
 if __name__ == "__main__":

@@ -15,6 +15,7 @@ device, and only the learner's gradients cross the ICI via ``pmean``
 """
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -76,7 +77,6 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     # level interpreter inside a scanned hot loop would look like a hang at
     # real buffer sizes. DIST_DQN_PALLAS_INTERPRET=1 opts back in for
     # tiny-size integration tests of the kernel routing.
-    import os
     on_tpu = jax.default_backend() == "tpu"
     pallas_interpret = (not on_tpu
                         and os.environ.get("DIST_DQN_PALLAS_INTERPRET")
