@@ -95,3 +95,91 @@ class NStepAssembler:
         out["action"] = out["action"].astype(np.int32)
         self._out = self._empty_out()
         return out
+
+
+class _SeqLane:
+    __slots__ = ("obs", "action", "reward", "done", "opens", "carry_c",
+                 "carry_h", "count")
+
+    def __init__(self):
+        self.obs: Deque[np.ndarray] = deque()
+        self.action: Deque[int] = deque()
+        self.reward: Deque[float] = deque()
+        self.done: Deque[bool] = deque()
+        self.opens: Deque[bool] = deque()   # step's obs opened a new episode
+        self.carry_c: Deque[np.ndarray] = deque()
+        self.carry_h: Deque[np.ndarray] = deque()
+        self.count = 0                      # total steps ever appended
+
+
+class SequenceAssembler:
+    """Per-actor assembly of step streams into fixed-length R2D2 sequences.
+
+    Mirrors the on-device sequence ring (replay/sequence_device.py):
+    windows of length L = burn_in + unroll + n_step start every ``stride``
+    steps and may cross episode boundaries — each step carries an
+    "opens episode" flag (the previous step ended one) so the learner
+    re-zeroes the LSTM carry mid-window, and the emitted start state is the
+    carry the inference server held *entering* the window's first step.
+    Overlapping windows duplicate storage here (host DRAM is cheap and
+    plentiful relative to HBM); the device ring instead stores once and
+    gathers at sample time.
+    """
+
+    def __init__(self, num_lanes: int, seq_len: int, stride: int):
+        self.L = seq_len
+        self.stride = max(stride, 1)
+        self.lanes = [_SeqLane() for _ in range(num_lanes)]
+        self._prev_done = [False] * num_lanes
+        self._out: List[Dict[str, np.ndarray]] = []
+
+    def step(self, obs: np.ndarray, action: np.ndarray, reward: np.ndarray,
+             terminated: np.ndarray, truncated: np.ndarray,
+             carry_c: np.ndarray, carry_h: np.ndarray) -> None:
+        """Feed one completed env step for every lane.
+
+        ``carry_c``/``carry_h`` are [lanes, lstm] — the recurrent state the
+        server used to act on ``obs`` (pre-step carry).
+        """
+        for i, lane in enumerate(self.lanes):
+            done = bool(terminated[i]) or bool(truncated[i])
+            lane.obs.append(obs[i])
+            lane.action.append(int(action[i]))
+            lane.reward.append(float(reward[i]))
+            lane.done.append(done)
+            lane.opens.append(self._prev_done[i])
+            lane.carry_c.append(carry_c[i])
+            lane.carry_h.append(carry_h[i])
+            self._prev_done[i] = done
+            lane.count += 1
+            # Same seeding rule as the device ring: the window whose last
+            # step just landed starts at stream index count - L; emit when
+            # that start is stride-aligned.
+            if len(lane.obs) == self.L:
+                if (lane.count - self.L) % self.stride == 0:
+                    self._emit(lane)
+                for q in (lane.obs, lane.action, lane.reward, lane.done,
+                          lane.opens, lane.carry_c, lane.carry_h):
+                    q.popleft()
+
+    def _emit(self, lane: _SeqLane) -> None:
+        reset = np.asarray(lane.opens, bool)
+        reset[0] = False  # start state is already episode-correct
+        self._out.append({
+            "obs": np.stack(lane.obs),
+            "action": np.asarray(lane.action, np.int32),
+            "reward": np.asarray(lane.reward, np.float32),
+            "done": np.asarray(lane.done, bool),
+            "reset": reset,
+            "state_c": np.asarray(lane.carry_c[0], np.float32),
+            "state_h": np.asarray(lane.carry_h[0], np.float32),
+        })
+
+    def drain(self) -> Optional[Dict[str, np.ndarray]]:
+        """Collect emitted sequences as stacked [S, L, ...] arrays."""
+        if not self._out:
+            return None
+        out = {k: np.stack([s[k] for s in self._out])
+               for k in self._out[0]}
+        self._out = []
+        return out

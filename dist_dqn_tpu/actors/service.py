@@ -86,29 +86,51 @@ class ApexLearnerService:
 
         net = build_network(cfg.network, self.num_actions)
         self.net = net
-        init, train_step = make_learner(net, cfg.learner)
+        # Recurrent (R2D2) configs swap in the sequence learner, the
+        # carry-threaded policy and the sequence assembler; the transport,
+        # actors and replay shard are shared (BASELINE.json:10).
+        self.recurrent = cfg.network.lstm_size > 0
+        if self.recurrent:
+            from dist_dqn_tpu.actors.assembler import SequenceAssembler
+            from dist_dqn_tpu.agents.r2d2 import (make_r2d2_learner,
+                                                  make_recurrent_actor_step)
+            init, train_step = make_r2d2_learner(net, cfg.learner,
+                                                 cfg.replay)
+            self._act = jax.jit(make_recurrent_actor_step(net))
+            self.seq_len = (cfg.replay.burn_in + cfg.replay.unroll_length
+                            + cfg.learner.n_step)
+            stride = cfg.replay.sequence_stride or cfg.replay.unroll_length
+            self.assemblers = [
+                SequenceAssembler(rt.envs_per_actor, self.seq_len, stride)
+                for _ in range(rt.num_actors)
+            ]
+            self._carry: List = [None] * rt.num_actors
+            self._prev_carry: List = [None] * rt.num_actors
+            self._prio_fn = None
+        else:
+            init, train_step = make_learner(net, cfg.learner)
+            self._act = jax.jit(make_actor_step(net))
+            self.assemblers = [
+                NStepAssembler(rt.envs_per_actor, cfg.learner.n_step,
+                               cfg.learner.gamma)
+                for _ in range(rt.num_actors)
+            ]
+
+            def prio_fn(params, target_params, obs, action, reward,
+                        discount, next_obs):
+                q = net.apply(params, obs)
+                qa = jnp.take_along_axis(q, action[:, None], axis=-1)[:, 0]
+                boot = jnp.max(net.apply(target_params, next_obs), axis=-1)
+                return jnp.abs(qa - (reward + discount * boot))
+
+            self._prio_fn = jax.jit(prio_fn)
         self.state = None
         self._init_learner = init
         self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._act = jax.jit(make_actor_step(net))
-
-        def prio_fn(params, target_params, obs, action, reward, discount,
-                    next_obs):
-            q = net.apply(params, obs)
-            qa = jnp.take_along_axis(q, action[:, None], axis=-1)[:, 0]
-            boot = jnp.max(net.apply(target_params, next_obs), axis=-1)
-            return jnp.abs(qa - (reward + discount * boot))
-
-        self._prio_fn = jax.jit(prio_fn)
 
         self.replay = PrioritizedHostReplay(
             cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
             priority_eps=cfg.replay.priority_eps)
-        self.assemblers = [
-            NStepAssembler(rt.envs_per_actor, cfg.learner.n_step,
-                           cfg.learner.gamma)
-            for _ in range(rt.num_actors)
-        ]
         # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1)*alpha).
         n_act = max(rt.num_actors - 1, 1)
         self.actor_eps = np.array([
@@ -170,8 +192,20 @@ class ApexLearnerService:
     def _reply_actions(self, actor: int, obs: np.ndarray, t: int):
         jax = self.jax
         self._rng, k = jax.random.split(self._rng)
-        actions = self._act(self.state.params, self.jnp.asarray(obs), k,
-                            self.jnp.float32(self.actor_eps[actor]))
+        if self.recurrent:
+            carry = self._carry[actor]
+            if carry is None:
+                carry = self.net.initial_state(obs.shape[0])
+            # The assembler stores the carry ENTERING this step.
+            self._prev_carry[actor] = (np.asarray(carry[0], np.float32),
+                                       np.asarray(carry[1], np.float32))
+            carry, actions = self._act(
+                self.state.params, carry, self.jnp.asarray(obs), k,
+                self.jnp.float32(self.actor_eps[actor]))
+            self._carry[actor] = carry
+        else:
+            actions = self._act(self.state.params, self.jnp.asarray(obs), k,
+                                self.jnp.float32(self.actor_eps[actor]))
         actions = np.asarray(actions, np.int32)
         self._prev_actions[actor] = actions
         self._prev_obs[actor] = obs
@@ -186,15 +220,36 @@ class ApexLearnerService:
             self._reply_actions(actor, arrays["obs"], t)
             return
         # step record: completes (prev_obs, prev_action) -> transition.
-        self.assemblers[actor].step(
-            self._prev_obs[actor], self._prev_actions[actor],
-            arrays["reward"], arrays["terminated"].astype(bool),
-            arrays["truncated"].astype(bool), arrays["next_obs"])
+        terminated = arrays["terminated"].astype(bool)
+        truncated = arrays["truncated"].astype(bool)
+        if self.recurrent:
+            self.assemblers[actor].step(
+                self._prev_obs[actor], self._prev_actions[actor],
+                arrays["reward"], terminated, truncated,
+                *self._prev_carry[actor])
+            # Zero the carry for lanes whose episode just ended, BEFORE the
+            # next act (the incoming obs rows are post-reset there).
+            done = np.logical_or(terminated, truncated)
+            if done.any():
+                keep = self.jnp.asarray(~done, self.jnp.float32)[:, None]
+                c = self._carry[actor]
+                self._carry[actor] = (c[0] * keep, c[1] * keep)
+        else:
+            self.assemblers[actor].step(
+                self._prev_obs[actor], self._prev_actions[actor],
+                arrays["reward"], terminated, truncated, arrays["next_obs"])
         self.env_steps += arrays["reward"].shape[0]
         emitted = self.assemblers[actor].drain()
         if emitted is not None:
-            self._pending.append(emitted)
-            self._pending_count += emitted["action"].shape[0]
+            if self.recurrent:
+                # Fresh sequences enter at the shard's running max priority
+                # (replay.add's default seeding; the feed-forward path
+                # computes real initial TDs on device instead — a full
+                # burn-in unroll per insert is not worth it here).
+                self.replay.add(emitted)
+            else:
+                self._pending.append(emitted)
+                self._pending_count += emitted["action"].shape[0]
         self._reply_actions(actor, arrays["obs"], t)
 
     def _flush_pending(self, force: bool = False):
@@ -228,11 +283,46 @@ class ApexLearnerService:
             self.replay.add({k: v[lo:hi] for k, v in cat.items()},
                             priorities=prios)
 
+    def _sequence_sample(self, items, weights):
+        """Host [S, L, ...] arrays -> time-major SequenceSample."""
+        from dist_dqn_tpu.types import SequenceSample
+        jnp = self.jnp
+
+        def tm(x):  # [S, L, ...] -> [L, S, ...]
+            return jnp.asarray(np.moveaxis(x, 0, 1))
+
+        S = items["action"].shape[0]
+        return SequenceSample(
+            obs=tm(items["obs"]), action=tm(items["action"]),
+            reward=tm(items["reward"]), done=tm(items["done"]),
+            reset=tm(items["reset"]),
+            start_state=(jnp.asarray(items["state_c"]),
+                         jnp.asarray(items["state_h"])),
+            weights=jnp.asarray(weights),
+            t_idx=jnp.zeros((S,), jnp.int32),   # host shard tracks its own
+            b_idx=jnp.zeros((S,), jnp.int32))   # indices (idx from sample())
+
+    def _min_fill_items(self) -> int:
+        """min_fill counts transitions; in sequence mode convert to
+        sequences (each loss region covers unroll_length steps)."""
+        if not self.recurrent:
+            return self.cfg.replay.min_fill
+        per_seq = max(self.cfg.replay.unroll_length, 1)
+        return max(self.cfg.replay.min_fill // per_seq,
+                   2 * self.cfg.learner.batch_size)
+
     def _maybe_train(self):
         cfg = self.cfg
-        if len(self.replay) < cfg.replay.min_fill:
+        if len(self.replay) < self._min_fill_items():
             return
-        target_grad_steps = self.replay.added // self.rt.inserts_per_grad_step
+        # inserts_per_grad_step is defined in TRANSITIONS; in sequence mode
+        # replay.added counts sequences, each covering unroll_length loss
+        # transitions, so convert to keep the configured replay ratio.
+        inserts_per_grad = self.rt.inserts_per_grad_step
+        if self.recurrent:
+            inserts_per_grad = max(
+                inserts_per_grad // max(cfg.replay.unroll_length, 1), 1)
+        target_grad_steps = self.replay.added // inserts_per_grad
         jnp = self.jnp
         while self.grad_steps < target_grad_steps:
             beta = min(1.0, cfg.replay.importance_exponent
@@ -240,17 +330,22 @@ class ApexLearnerService:
                        * self.env_steps / max(self.rt.total_env_steps, 1))
             items, idx, weights = self.replay.sample(cfg.learner.batch_size,
                                                      beta)
-            from dist_dqn_tpu.types import Transition
-            batch = Transition(
-                obs=jnp.asarray(items["obs"]),
-                action=jnp.asarray(items["action"]),
-                reward=jnp.asarray(items["reward"]),
-                discount=jnp.asarray(items["discount"]),
-                next_obs=jnp.asarray(items["next_obs"]))
-            self.state, metrics = self._train_step(self.state, batch,
-                                                   jnp.asarray(weights))
-            self.replay.update_priorities(
-                idx, np.asarray(metrics["priorities"]))
+            if self.recurrent:
+                sample = self._sequence_sample(items, weights)
+                self.state, metrics = self._train_step(self.state, sample)
+                prios = np.asarray(metrics["priorities"])
+            else:
+                from dist_dqn_tpu.types import Transition
+                batch = Transition(
+                    obs=jnp.asarray(items["obs"]),
+                    action=jnp.asarray(items["action"]),
+                    reward=jnp.asarray(items["reward"]),
+                    discount=jnp.asarray(items["discount"]),
+                    next_obs=jnp.asarray(items["next_obs"]))
+                self.state, metrics = self._train_step(self.state, batch,
+                                                       jnp.asarray(weights))
+                prios = np.asarray(metrics["priorities"])
+            self.replay.update_priorities(idx, prios)
             self.grad_steps += 1
             self._last_loss = float(metrics["loss"])
 
