@@ -50,6 +50,13 @@ class ApexRuntimeConfig:
     inserts_per_grad_step: int = 64
     ring_mb: int = 64
     log_every_s: float = 5.0
+    # Learner checkpoint/resume (SURVEY.md §5: the learner state is the
+    # recovery point; actors/replay are stateless and refill).
+    checkpoint_dir: Optional[str] = None
+    save_every_steps: int = 100_000    # env steps between checkpoints
+    # Periodic greedy evaluation on a service-owned env instance.
+    eval_every_steps: int = 0          # 0 disables
+    eval_episodes: int = 5
 
 
 class ApexLearnerService:
@@ -118,9 +125,16 @@ class ApexLearnerService:
 
             def prio_fn(params, target_params, obs, action, reward,
                         discount, next_obs):
-                q = net.apply(params, obs)
+                # Scalar-Q view regardless of head type: with a C51 head,
+                # q_values reduces the distribution to its expectation, so
+                # initial priorities stay a meaningful |TD| for Rainbow
+                # configs too (the learner's cross-entropy priorities take
+                # over after the first update).
+                q = net.apply(params, obs, method=net.q_values)
                 qa = jnp.take_along_axis(q, action[:, None], axis=-1)[:, 0]
-                boot = jnp.max(net.apply(target_params, next_obs), axis=-1)
+                boot = jnp.max(
+                    net.apply(target_params, next_obs, method=net.q_values),
+                    axis=-1)
                 return jnp.abs(qa - (reward + discount * boot))
 
             self._prio_fn = jax.jit(prio_fn)
@@ -148,6 +162,9 @@ class ApexLearnerService:
         self.env_steps = 0
         self.grad_steps = 0
         self._rng = None
+        self._ckpt = None
+        self._eval_env = None
+        self._next_eval = rt.eval_every_steps or float("inf")
 
     # -- actor lifecycle ----------------------------------------------------
     def spawn_actors(self):
@@ -188,6 +205,22 @@ class ApexLearnerService:
             self._rng = jax.random.PRNGKey(self.cfg.seed)
             self._rng, k = jax.random.split(self._rng)
             self.state = self._init_learner(k, self.jnp.asarray(obs_example))
+            if self.rt.checkpoint_dir:
+                from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+                self._ckpt = TrainCheckpointer(
+                    self.rt.checkpoint_dir,
+                    save_every_frames=self.rt.save_every_steps)
+                restored = self._ckpt.restore_latest(self.state)
+                if restored is not None:
+                    # Resume the cursor too: the run continues toward the
+                    # same total_env_steps (replay refills from live actors).
+                    self.env_steps, self.state = restored
+                    if self.rt.eval_every_steps:
+                        # Next eval is one full period out, not immediately.
+                        self._next_eval = (self.env_steps
+                                           + self.rt.eval_every_steps)
+                    self.log.log_fn(
+                        f'{{"resumed_at_env_steps": {self.env_steps}}}')
 
     def _reply_actions(self, actor: int, obs: np.ndarray, t: int):
         jax = self.jax
@@ -349,6 +382,44 @@ class ApexLearnerService:
             self.grad_steps += 1
             self._last_loss = float(metrics["loss"])
 
+    def _evaluate(self) -> float:
+        """Greedy episodes on a service-owned env (mean undiscounted
+        return); the recurrent policy threads its own eval carry."""
+        from dist_dqn_tpu.envs.gym_adapter import make_host_env
+        jnp = self.jnp
+        n = self.rt.eval_episodes
+        if self._eval_env is None:
+            self._eval_env = make_host_env(self.rt.host_env, n,
+                                           seed=10_000 + self.cfg.seed)
+        env = self._eval_env
+        obs = env.reset()
+        carry = self.net.initial_state(n) if self.recurrent else None
+        returns = np.zeros((n,), np.float64)
+        alive = np.ones((n,), bool)
+        eps = jnp.float32(0.001)
+        for _ in range(10_000):
+            self._rng, k = self.jax.random.split(self._rng)
+            if self.recurrent:
+                carry, actions = self._act(self.state.params, carry,
+                                           jnp.asarray(obs), k, eps)
+            else:
+                actions = self._act(self.state.params, jnp.asarray(obs), k,
+                                    eps)
+            obs, _, reward, term, trunc = env.step(np.asarray(actions))
+            returns += np.asarray(reward) * alive
+            done = np.logical_or(term, trunc)
+            if self.recurrent and done.any():
+                keep = jnp.asarray(~done, jnp.float32)[:, None]
+                carry = (carry[0] * keep, carry[1] * keep)
+            alive &= ~done
+            if not alive.any():
+                break
+        if alive.any():
+            # Step-capped: record the truncation so a downward-biased
+            # eval_return is not mistaken for a policy regression.
+            self.log.record(eval_episodes_truncated=float(alive.sum()))
+        return float(returns.mean())
+
     def run(self):
         """Main service loop until total_env_steps processed."""
         self.spawn_actors()
@@ -364,6 +435,15 @@ class ApexLearnerService:
                     self._handle_record(rec)
                 self._flush_pending()
                 self._maybe_train()
+                if self._ckpt is not None:
+                    self._ckpt.maybe_save(self.env_steps, self.state)
+                if self.env_steps >= self._next_eval:
+                    self._next_eval = self.env_steps \
+                        + self.rt.eval_every_steps
+                    self.log.record(env_steps=self.env_steps,
+                                    eval_return=self._evaluate())
+                    self.log.flush()
+                    last_log = time.perf_counter()
                 if not drained:
                     time.sleep(0.0002)
                 now = time.perf_counter()
@@ -377,6 +457,9 @@ class ApexLearnerService:
                     self.log.flush()
                     last_log = now
             self._flush_pending(force=True)
+            if self._ckpt is not None:
+                self._ckpt.save(self.env_steps, self.state)
+                self._ckpt.close()
         finally:
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,

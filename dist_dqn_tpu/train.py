@@ -127,6 +127,11 @@ def main():
     parser.add_argument("--save-every-frames", type=int, default=0,
                         help="checkpoint period in env frames "
                              "(default: eval_every_steps)")
+    parser.add_argument("--eval-every-steps", type=int, default=None,
+                        help="eval period in env steps. Default: config "
+                             "value on the fused runtime; DISABLED on the "
+                             "apex runtime (its eval steps host envs "
+                             "synchronously and stalls the service loop)")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of the first "
                              "post-warmup chunk into this directory "
@@ -148,6 +153,9 @@ def main():
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     cfg = CONFIGS[args.config]
+    if args.eval_every_steps:
+        import dataclasses as _dc
+        cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)
     if args.runtime == "apex":
         if args.profile_dir:
             print("# --profile-dir applies to the fused runtime only; "
@@ -165,7 +173,11 @@ def main():
         rt = ApexRuntimeConfig(
             host_env=args.host_env, num_actors=args.num_actors,
             envs_per_actor=args.envs_per_actor,
-            total_env_steps=args.total_env_steps or cfg.total_env_steps)
+            total_env_steps=args.total_env_steps or cfg.total_env_steps,
+            checkpoint_dir=args.checkpoint_dir,
+            save_every_steps=args.save_every_frames or cfg.eval_every_steps,
+            eval_every_steps=args.eval_every_steps or 0,
+            eval_episodes=cfg.eval_episodes)
         print(json.dumps(run_apex(cfg, rt)))
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,

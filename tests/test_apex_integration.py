@@ -28,3 +28,34 @@ def test_apex_split_end_to_end():
     assert result["replay_size"] > 500
     assert result["grad_steps"] >= 10
     assert result["ring_dropped"] == 0
+
+
+def test_apex_checkpoint_resume_and_eval(tmp_path):
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=100),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2),
+    )
+    rt = dataclasses.replace(
+        ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                          envs_per_actor=4, total_env_steps=600,
+                          inserts_per_grad_step=32),
+        checkpoint_dir=str(tmp_path / "apex_ckpt"),
+        save_every_steps=200, eval_every_steps=300, eval_episodes=2)
+    logs = []
+    result = run_apex(cfg, rt, log_fn=logs.append)
+    assert result["grad_steps"] > 0
+    assert any("eval_return" in s for s in logs)
+
+    # Resume: the cursor picks up past the saved step, replay refills.
+    rt2 = dataclasses.replace(rt, total_env_steps=900)
+    logs2 = []
+    result2 = run_apex(cfg, rt2, log_fn=logs2.append)
+    resumed = [s for s in logs2 if "resumed_at_env_steps" in s]
+    assert resumed, logs2[:3]
+    assert result2["env_steps"] >= 900
