@@ -23,6 +23,22 @@ from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
 from dist_dqn_tpu.envs.gym_adapter import make_host_env
 
 
+def _step_and_encode(env, actions, actor_id: int, t: int):
+    """Step the vector env and build the step record (shared by the shm
+    and TCP transports, so the record schema cannot diverge).
+
+    Returns (obs, t + 1, payload).
+    """
+    obs, next_obs, reward, terminated, truncated = env.step(actions)
+    payload = encode_arrays(
+        {"obs": obs, "reward": reward,
+         "terminated": terminated.astype(np.uint8),
+         "truncated": truncated.astype(np.uint8),
+         "next_obs": next_obs},
+        {"kind": "step", "actor": actor_id, "t": t + 1})
+    return obs, t + 1, payload
+
+
 def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
               req_ring: str, act_box: str, stop_path: str,
               max_env_steps: int = 10 ** 12) -> None:
@@ -46,18 +62,71 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             time.sleep(0.0002)
             continue
         arrays, _ = decode_arrays(data)
-        actions = arrays["action"]
-
-        obs, next_obs, reward, terminated, truncated = env.step(actions)
-        t += 1
+        obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
+                                           t)
         steps += num_envs
-        payload = encode_arrays(
-            {"obs": obs, "reward": reward,
-             "terminated": terminated.astype(np.uint8),
-             "truncated": truncated.astype(np.uint8),
-             "next_obs": next_obs},
-            {"kind": "step", "actor": actor_id, "t": t})
         while not ring.push(payload):
             if os.path.exists(stop_path):
                 return
             time.sleep(0.001)
+
+
+def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
+                     address, stop_path: str,
+                     max_env_steps: int = 10 ** 12,
+                     max_consecutive_failures: int = 60,
+                     reconnect_backoff_s: float = 0.5) -> None:
+    """Actor on another host: same stepping loop, DCN (TCP) transport.
+
+    Lock-step protocol per actor: push an observation record, block on the
+    action reply from the learner service, step the vector env, stream the
+    results back. On a dropped connection the actor reconnects and
+    re-introduces itself with a fresh hello; the service resets that
+    actor's assembly lanes and recurrent carry on the hello, so the gap
+    never leaks into stored experience (actors are stateless workers:
+    losing the partial window is the whole cost of a restart).
+
+    Termination: remote hosts cannot see the service's local stop file, so
+    the worker exits cleanly after ``max_consecutive_failures`` consecutive
+    failed reconnect attempts (the learner is gone, not flaky) — a service
+    restart within ~max_consecutive_failures x backoff seconds is survived.
+    """
+    from dist_dqn_tpu.actors.transport import TcpRecordClient
+
+    env = make_host_env(env_name, num_envs, seed=seed)
+
+    def connect_and_hello(obs, t):
+        client = TcpRecordClient(tuple(address))
+        client.push(encode_arrays(
+            {"obs": obs}, {"kind": "hello", "actor": actor_id, "t": t}))
+        return client
+
+    obs = env.reset()
+    t = 0
+    failures = 0
+    client = connect_and_hello(obs, t)
+    steps = 0
+    while steps < max_env_steps and not os.path.exists(stop_path) \
+            and failures < max_consecutive_failures:
+        if client is None:           # between reconnect attempts
+            time.sleep(reconnect_backoff_s)
+            try:
+                client = connect_and_hello(obs, t)
+                failures = 0
+            except OSError:
+                failures += 1
+            continue
+        reply = client.read_reply()
+        if reply is None:            # connection lost: reconnect + re-hello
+            client.close()
+            client = None
+            continue
+        arrays, _ = decode_arrays(reply)
+        obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
+                                           t)
+        steps += num_envs
+        if not client.push(payload):
+            client.close()
+            client = None
+    if client is not None:
+        client.close()

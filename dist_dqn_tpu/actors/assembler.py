@@ -40,6 +40,12 @@ class NStepAssembler:
         self.lanes = [_Lane() for _ in range(num_lanes)]
         self._out: Dict[str, List] = self._empty_out()
 
+    def reset(self) -> None:
+        """Drop partial lane windows (actor reconnected: the step stream
+        has a gap, so open windows must not bridge it). Already-emitted
+        transitions stay in the drain buffer — they are complete."""
+        self.lanes = [_Lane() for _ in range(len(self.lanes))]
+
     @staticmethod
     def _empty_out() -> Dict[str, List]:
         return {"obs": [], "action": [], "reward": [], "discount": [],
@@ -132,6 +138,12 @@ class SequenceAssembler:
         self.lanes = [_SeqLane() for _ in range(num_lanes)]
         self._prev_done = [False] * num_lanes
         self._out: List[Dict[str, np.ndarray]] = []
+
+    def reset(self) -> None:
+        """Drop partial windows after an actor reconnect (see
+        NStepAssembler.reset); emitted sequences stay drainable."""
+        self.lanes = [_SeqLane() for _ in range(len(self.lanes))]
+        self._prev_done = [False] * len(self.lanes)
 
     def step(self, obs: np.ndarray, action: np.ndarray, reward: np.ndarray,
              terminated: np.ndarray, truncated: np.ndarray,

@@ -1,0 +1,47 @@
+"""Standalone remote-actor entry: run rollout workers on OTHER hosts.
+
+The multi-host half of the DCN story (BASELINE.json:5): the learner service
+listens on ``ApexRuntimeConfig.tcp_port``; each worker host runs
+
+    python -m dist_dqn_tpu.actors.remote \
+        --address <learner-host>:<port> --actor-id 8 \
+        --env CartPole-v1 --num-envs 16
+
+Actor ids must be unique across the fleet and live in
+``[num_actors, num_actors + num_remote_actors)`` of the service's id space.
+Workers are stateless (SURVEY.md §5): on a dropped connection they
+reconnect and re-introduce themselves; killing and restarting a worker
+costs at most one assembly window of experience.
+"""
+from __future__ import annotations
+
+import argparse
+
+from dist_dqn_tpu.actors.actor import run_remote_actor
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--address", required=True,
+                        help="learner service endpoint, host:port")
+    parser.add_argument("--actor-id", type=int, required=True)
+    parser.add_argument("--env", default="CartPole-v1")
+    parser.add_argument("--num-envs", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--max-env-steps", type=int, default=10 ** 12)
+    parser.add_argument("--stop-file", default="/tmp/dqn_actor_stop",
+                        help="existence of this file stops the worker")
+    parser.add_argument("--max-reconnect-failures", type=int, default=60,
+                        help="exit after this many consecutive failed "
+                             "reconnects (the learner is gone)")
+    args = parser.parse_args()
+    host, port = args.address.rsplit(":", 1)
+    seed = args.seed if args.seed is not None else 1000 + 7 * args.actor_id
+    run_remote_actor(args.actor_id, args.env, args.num_envs, seed,
+                     (host, int(port)), args.stop_file,
+                     max_env_steps=args.max_env_steps,
+                     max_consecutive_failures=args.max_reconnect_failures)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,18 @@ class ApexRuntimeConfig:
     # Periodic greedy evaluation on a service-owned env instance.
     eval_every_steps: int = 0          # 0 disables
     eval_episodes: int = 5
+    # DCN path: actors on OTHER hosts connect over TCP (full-duplex record
+    # stream, actors/transport.py). tcp_port None disables the listener;
+    # 0 binds an ephemeral port (exposed as service.tcp_address).
+    # num_remote_actors are spawned locally by the service for tests /
+    # single-host runs; real remote actors run
+    # ``python -m dist_dqn_tpu.actors.remote`` against tcp_address.
+    tcp_port: Optional[int] = None
+    num_remote_actors: int = 0
+    # True (default): the service spawns its remote actors as local
+    # processes — the single-host DCN stand-in. False: the slots stay open
+    # for external workers started on other hosts against tcp_address.
+    spawn_remote_actors: bool = True
 
 
 class ApexLearnerService:
@@ -73,6 +85,9 @@ class ApexLearnerService:
         self.cfg, self.rt = cfg, rt
         self.run_id = uuid.uuid4().hex[:8]
         self.log = MetricLogger(log_fn=log_fn)
+        # Actor id space: [0, num_actors) are local (shm transport),
+        # [num_actors, total_actors) are remote (TCP/DCN transport).
+        self.total_actors = rt.num_actors + rt.num_remote_actors
 
         # Transport endpoints (created before actors spawn).
         self.req_ring = ShmRing(f"req_{self.run_id}",
@@ -83,6 +98,13 @@ class ApexLearnerService:
                        create=True)
             for i in range(rt.num_actors)
         ]
+        self.tcp_server = None
+        self.tcp_address = None
+        if rt.tcp_port is not None or rt.num_remote_actors:
+            from dist_dqn_tpu.actors.transport import TcpRecordServer
+            self.tcp_server = TcpRecordServer(port=rt.tcp_port or 0)
+            self.tcp_address = self.tcp_server.address
+        self._actor_conn: Dict[int, int] = {}   # remote actor id -> conn id
         self.stop_path = str(shm_dir() / f"stop_{self.run_id}")
 
         # Probe the env for action count (host-side, cheap).
@@ -109,10 +131,10 @@ class ApexLearnerService:
             stride = cfg.replay.sequence_stride or cfg.replay.unroll_length
             self.assemblers = [
                 SequenceAssembler(rt.envs_per_actor, self.seq_len, stride)
-                for _ in range(rt.num_actors)
+                for _ in range(self.total_actors)
             ]
-            self._carry: List = [None] * rt.num_actors
-            self._prev_carry: List = [None] * rt.num_actors
+            self._carry: List = [None] * self.total_actors
+            self._prev_carry: List = [None] * self.total_actors
             self._prio_fn = None
         else:
             init, train_step = make_learner(net, cfg.learner)
@@ -120,7 +142,7 @@ class ApexLearnerService:
             self.assemblers = [
                 NStepAssembler(rt.envs_per_actor, cfg.learner.n_step,
                                cfg.learner.gamma)
-                for _ in range(rt.num_actors)
+                for _ in range(self.total_actors)
             ]
 
             def prio_fn(params, target_params, obs, action, reward,
@@ -146,17 +168,17 @@ class ApexLearnerService:
             cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
             priority_eps=cfg.replay.priority_eps)
         # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1)*alpha).
-        n_act = max(rt.num_actors - 1, 1)
+        n_act = max(self.total_actors - 1, 1)
         self.actor_eps = np.array([
             cfg.actor.apex_epsilon_base
             ** (1 + i / n_act * cfg.actor.apex_epsilon_alpha)
-            for i in range(rt.num_actors)
+            for i in range(self.total_actors)
         ], np.float32)
 
         self._prev_obs: List[Optional[np.ndarray]] = \
-            [None] * rt.num_actors
+            [None] * self.total_actors
         self._prev_actions: List[Optional[np.ndarray]] = \
-            [None] * rt.num_actors
+            [None] * self.total_actors
         self._pending: List[Dict[str, np.ndarray]] = []
         self._pending_count = 0
         self.env_steps = 0
@@ -165,12 +187,13 @@ class ApexLearnerService:
         self._ckpt = None
         self._eval_env = None
         self._next_eval = rt.eval_every_steps or float("inf")
+        self.bad_records = 0
 
     # -- actor lifecycle ----------------------------------------------------
     def spawn_actors(self):
         import multiprocessing as mp
 
-        from dist_dqn_tpu.actors.actor import run_actor
+        from dist_dqn_tpu.actors.actor import run_actor, run_remote_actor
         ctx = mp.get_context("spawn")
         self.procs = []
         for i in range(self.rt.num_actors):
@@ -182,6 +205,20 @@ class ApexLearnerService:
                 daemon=True)
             p.start()
             self.procs.append(p)
+        # Locally-spawned remote actors (single-host stand-in for DCN
+        # workers; real ones run actors/remote.py on other hosts).
+        if not self.rt.spawn_remote_actors:
+            return
+        for j in range(self.rt.num_remote_actors):
+            actor_id = self.rt.num_actors + j
+            p = ctx.Process(
+                target=run_remote_actor,
+                args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
+                      1000 + 7 * actor_id,
+                      ("127.0.0.1", self.tcp_address[1]), self.stop_path),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
 
     def shutdown(self):
         with open(self.stop_path, "w") as f:
@@ -190,6 +227,8 @@ class ApexLearnerService:
             p.join(timeout=10)
             if p.is_alive():
                 p.terminate()
+        if self.tcp_server is not None:
+            self.tcp_server.close()
         self.req_ring.unlink()
         for b in self.act_boxes:
             b.unlink()
@@ -242,16 +281,41 @@ class ApexLearnerService:
         actions = np.asarray(actions, np.int32)
         self._prev_actions[actor] = actions
         self._prev_obs[actor] = obs
-        self.act_boxes[actor].write(
-            encode_arrays({"action": actions}), version=t + 1)
+        payload = encode_arrays({"action": actions})
+        if actor < self.rt.num_actors:
+            self.act_boxes[actor].write(payload, version=t + 1)
+        else:
+            conn = self._actor_conn.get(actor)
+            if conn is not None:
+                self.tcp_server.send(conn, payload)
 
-    def _handle_record(self, payload: bytes):
+    def _handle_record(self, payload: bytes, conn_id: Optional[int] = None):
         arrays, meta = decode_arrays(payload)
-        actor, t = meta["actor"], meta["t"]
+        actor, t = int(meta["actor"]), int(meta["t"])
+        if conn_id is not None:
+            # Remote actor: only the remote id range is valid over TCP (a
+            # misconfigured worker must not feed a LOCAL actor's lanes),
+            # and replies route to the connection its latest record
+            # arrived on (survives reconnects after churn).
+            if not self.rt.num_actors <= actor < self.total_actors:
+                raise ValueError(f"TCP record for out-of-range actor id "
+                                 f"{actor}")
+            self._actor_conn[actor] = conn_id
+        elif not 0 <= actor < self.rt.num_actors:
+            raise ValueError(f"shm record for out-of-range actor id {actor}")
         if meta["kind"] == "hello":
             self._ensure_learner(arrays["obs"][0])
+            if self._prev_obs[actor] is not None:
+                # Re-hello = reconnect: the step stream has a gap, so drop
+                # partial assembly windows (and the recurrent carry — the
+                # next act restarts it from zeros) rather than bridging it.
+                self.assemblers[actor].reset()
+                if self.recurrent:
+                    self._carry[actor] = None
             self._reply_actions(actor, arrays["obs"], t)
             return
+        if self._prev_obs[actor] is None:
+            raise ValueError(f"step record for actor {actor} before hello")
         # step record: completes (prev_obs, prev_action) -> transition.
         terminated = arrays["terminated"].astype(bool)
         truncated = arrays["truncated"].astype(bool)
@@ -433,6 +497,20 @@ class ApexLearnerService:
                         break
                     drained = True
                     self._handle_record(rec)
+                if self.tcp_server is not None:
+                    for _ in range(256):
+                        rec = self.tcp_server.pop()
+                        if rec is None:
+                            break
+                        drained = True
+                        conn_id, payload = rec
+                        try:
+                            self._handle_record(payload, conn_id=conn_id)
+                        except Exception:
+                            # Network input is untrusted (the listener may
+                            # face other hosts): a malformed or misrouted
+                            # record must not take down the training run.
+                            self.bad_records += 1
                 self._flush_pending()
                 self._maybe_train()
                 if self._ckpt is not None:
@@ -464,7 +542,10 @@ class ApexLearnerService:
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
                 "replay_size": len(self.replay),
-                "ring_dropped": self.req_ring.dropped}
+                "ring_dropped": self.req_ring.dropped,
+                "tcp_dropped": (self.tcp_server.dropped
+                                if self.tcp_server else 0),
+                "bad_records": self.bad_records}
 
 
 def run_apex(cfg: ExperimentConfig, rt: ApexRuntimeConfig, log_fn=print):

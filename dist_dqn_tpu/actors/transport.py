@@ -19,6 +19,7 @@ import socket
 import struct
 import subprocess
 import threading
+import time
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
@@ -202,20 +203,29 @@ def decode_arrays(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict]:
 # ---------------------------------------------------------------------------
 
 class TcpRecordServer:
-    """Accepts length-prefixed records from remote actors; same ``pop()``
-    interface as ShmRing so the learner service is transport-agnostic."""
+    """Full-duplex record endpoint for actors on OTHER hosts (the DCN path).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+    Accepts length-prefixed records from remote actors and can send reply
+    records (actions) back down the same connection: ``pop()`` returns
+    ``(conn_id, payload)`` and ``send(conn_id, payload)`` routes a reply —
+    the learner service maps actor ids to the connection their last record
+    arrived on, so routing survives actor restarts/reconnects.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  max_backlog: int = 4096):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.address = self._sock.getsockname()
-        self._records: List[bytes] = []
+        self._records: List[Tuple[int, bytes]] = []
+        self._conns: Dict[int, socket.socket] = {}
+        self._next_conn = 0
         self._lock = threading.Lock()
         self._max_backlog = max_backlog
-        self.dropped = 0
+        self.dropped = 0              # always 0: full backlog backpressures
+        self.backpressure_events = 0  # records that had to wait for space
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
@@ -230,10 +240,14 @@ class TcpRecordServer:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
+            with self._lock:
+                conn_id = self._next_conn
+                self._next_conn += 1
+                self._conns[conn_id] = conn
+            threading.Thread(target=self._serve, args=(conn_id, conn),
                              daemon=True).start()
 
-    def _serve(self, conn: socket.socket):
+    def _serve(self, conn_id: int, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while not self._stop.is_set():
@@ -244,12 +258,23 @@ class TcpRecordServer:
                 payload = self._recv_exact(conn, n)
                 if payload is None:
                     return
-                with self._lock:
-                    if len(self._records) >= self._max_backlog:
-                        self.dropped += 1
-                    else:
-                        self._records.append(payload)
+                # Backpressure, not drops: pausing this connection's reads
+                # fills the kernel socket buffers and TCP flow control
+                # throttles the sender — a dropped record would stall its
+                # lock-step actor for a full reply timeout instead.
+                waited = False
+                while not self._stop.is_set():
+                    with self._lock:
+                        if len(self._records) < self._max_backlog:
+                            self._records.append((conn_id, payload))
+                            break
+                        if not waited:
+                            waited = True
+                            self.backpressure_events += 1
+                    time.sleep(0.001)
         finally:
+            with self._lock:
+                self._conns.pop(conn_id, None)
             conn.close()
 
     @staticmethod
@@ -266,12 +291,39 @@ class TcpRecordServer:
             n -= len(b)
         return b"".join(chunks)
 
-    def pop(self) -> Optional[bytes]:
+    def pop(self) -> Optional[Tuple[int, bytes]]:
         with self._lock:
             return self._records.pop(0) if self._records else None
 
+    def send(self, conn_id: int, payload: bytes) -> bool:
+        """Reply down a connection (False if it is gone — actor churn)."""
+        with self._lock:
+            conn = self._conns.get(conn_id)
+        if conn is None:
+            return False
+        try:
+            conn.sendall(struct.pack("<I", len(payload)) + payload)
+            return True
+        except OSError:
+            return False
+
     def close(self):
         self._stop.set()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                # shutdown() sends FIN immediately even while a serve
+                # thread blocks in recv on the same socket; bare close()
+                # would leave remote peers hanging until their timeout.
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         try:
             self._sock.close()
         except OSError:
@@ -279,10 +331,15 @@ class TcpRecordServer:
 
 
 class TcpRecordClient:
-    """Actor-side sender for the TCP path."""
+    """Actor-side endpoint: push records, block on the action reply.
 
-    def __init__(self, address: Tuple[str, int]):
-        self._sock = socket.create_connection(address)
+    The remote-actor protocol is lock-step per actor (send observations,
+    wait for actions), so replies are read synchronously off the same
+    socket — no background thread, no reordering to handle.
+    """
+
+    def __init__(self, address: Tuple[str, int], timeout_s: float = 30.0):
+        self._sock = socket.create_connection(address, timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def push(self, payload: bytes) -> bool:
@@ -291,6 +348,14 @@ class TcpRecordClient:
             return True
         except OSError:
             return False
+
+    def read_reply(self) -> Optional[bytes]:
+        """Block (up to the socket timeout) for the next reply record."""
+        hdr = TcpRecordServer._recv_exact(self._sock, 4)
+        if hdr is None:
+            return None
+        (n,) = struct.unpack("<I", hdr)
+        return TcpRecordServer._recv_exact(self._sock, n)
 
     def close(self):
         try:

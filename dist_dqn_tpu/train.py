@@ -149,6 +149,19 @@ def main():
                              "(e.g. CartPole-v1, ale:Pong)")
     parser.add_argument("--num-actors", type=int, default=4)
     parser.add_argument("--envs-per-actor", type=int, default=8)
+    parser.add_argument("--num-remote-actors", type=int, default=0,
+                        help="apex runtime: remote (TCP) actor slots")
+    parser.add_argument("--tcp-port", type=int, default=None,
+                        help="apex runtime: listen for remote actors "
+                             "(actors/remote.py) on this port; 0 = "
+                             "ephemeral")
+    parser.add_argument("--remote-actor-mode", choices=("local", "external"),
+                        default="local",
+                        help="local: the service spawns its remote actors "
+                             "as local processes (single-host DCN "
+                             "stand-in); external: slots stay open for "
+                             "workers started on other hosts via "
+                             "python -m dist_dqn_tpu.actors.remote")
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -177,7 +190,10 @@ def main():
             checkpoint_dir=args.checkpoint_dir,
             save_every_steps=args.save_every_frames or cfg.eval_every_steps,
             eval_every_steps=args.eval_every_steps or 0,
-            eval_episodes=cfg.eval_episodes)
+            eval_episodes=cfg.eval_episodes,
+            tcp_port=args.tcp_port,
+            num_remote_actors=args.num_remote_actors,
+            spawn_remote_actors=args.remote_actor_mode == "local")
         print(json.dumps(run_apex(cfg, rt)))
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,

@@ -1,0 +1,163 @@
+"""DCN (TCP) actor path: full-duplex record transport and the learner
+service fed by a mix of local (shm) and remote (TCP) actor processes."""
+import dataclasses
+
+import numpy as np
+
+from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+from dist_dqn_tpu.actors.transport import (TcpRecordClient, TcpRecordServer,
+                                           decode_arrays, encode_arrays)
+from dist_dqn_tpu.config import CONFIGS
+
+
+def test_tcp_roundtrip_and_reply_routing():
+    server = TcpRecordServer(host="127.0.0.1")
+    try:
+        c1 = TcpRecordClient(server.address)
+        c2 = TcpRecordClient(server.address)
+        c1.push(encode_arrays({"x": np.arange(3)}, {"actor": 1}))
+        c2.push(encode_arrays({"x": np.arange(4)}, {"actor": 2}))
+        import time
+        got = {}
+        for _ in range(2000):
+            rec = server.pop()
+            if rec is None:
+                time.sleep(0.005)
+                continue
+            conn_id, payload = rec
+            _, meta = decode_arrays(payload)
+            got[meta["actor"]] = conn_id
+            if len(got) == 2:
+                break
+        assert set(got) == {1, 2}
+        # Replies route per connection, full duplex.
+        assert server.send(got[1], encode_arrays({"a": np.array([7])}))
+        assert server.send(got[2], encode_arrays({"a": np.array([9])}))
+        r1, _ = decode_arrays(c1.read_reply())
+        r2, _ = decode_arrays(c2.read_reply())
+        assert int(r1["a"][0]) == 7 and int(r2["a"][0]) == 9
+        c1.close()
+        c2.close()
+        # Send to a closed connection reports failure, not a crash.
+        import time
+        for _ in range(100):
+            if not server.send(got[1], b"x"):
+                break
+            time.sleep(0.01)
+        assert not server.send(got[1], b"x")
+    finally:
+        server.close()
+
+
+def test_apex_mixed_local_and_remote_actors():
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=150),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=4, total_env_steps=1500,
+                           inserts_per_grad_step=32,
+                           num_remote_actors=2)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 1500
+    assert result["grad_steps"] >= 5
+    assert result["ring_dropped"] == 0
+    assert result["tcp_dropped"] == 0
+
+
+def test_apex_remote_r2d2_actors():
+    cfg = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    lstm_size=16, dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=64,
+                                   burn_in=2, unroll_length=6,
+                                   sequence_stride=3),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=0,
+                           envs_per_actor=4, total_env_steps=1000,
+                           inserts_per_grad_step=16,
+                           num_remote_actors=2)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 1000
+    assert result["grad_steps"] >= 3
+    assert result["tcp_dropped"] == 0
+
+
+def test_assembler_reset_drops_partial_windows():
+    from dist_dqn_tpu.actors.assembler import NStepAssembler, \
+        SequenceAssembler
+
+    asm = NStepAssembler(1, n_step=3, gamma=0.9)
+    asm.step(np.zeros((1, 2)), np.zeros((1,)), np.ones((1,)),
+             np.zeros((1,), bool), np.zeros((1,), bool), np.zeros((1, 2)))
+    asm.reset()
+    # Two more steps: would have completed the pre-reset window; must not.
+    for _ in range(2):
+        asm.step(np.zeros((1, 2)), np.zeros((1,)), np.ones((1,)),
+                 np.zeros((1,), bool), np.zeros((1,), bool),
+                 np.zeros((1, 2)))
+    assert asm.drain() is None
+
+    seq = SequenceAssembler(1, seq_len=3, stride=1)
+    seq.step(np.zeros((1, 2)), np.zeros((1,)), np.zeros((1,)),
+             np.ones((1,), bool), np.zeros((1,), bool),
+             np.zeros((1, 4)), np.zeros((1, 4)))
+    seq.reset()
+    for t in range(3):
+        seq.step(np.full((1, 2), float(t)), np.zeros((1,)), np.zeros((1,)),
+                 np.zeros((1,), bool), np.zeros((1,), bool),
+                 np.zeros((1, 4)), np.zeros((1, 4)))
+    out = seq.drain()
+    # Window starts fresh post-reset (no pre-reset step, no stale
+    # prev-done leaking into the first reset flag).
+    np.testing.assert_allclose(out["obs"][0, :, 0], [0.0, 1.0, 2.0])
+    assert not out["reset"][0].any()
+
+
+def test_service_rejects_malformed_and_misrouted_records():
+    import jax
+    from dist_dqn_tpu.actors.service import ApexLearnerService
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=256, min_fill=32),
+        learner=dataclasses.replace(cfg.learner, batch_size=8))
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=2, total_env_steps=100,
+                           num_remote_actors=1, spawn_remote_actors=False)
+    svc = ApexLearnerService(cfg, rt, log_fn=lambda s: None)
+    try:
+        import pytest
+        # TCP record claiming a LOCAL actor id: must be rejected.
+        hello = encode_arrays({"obs": np.zeros((2, 4), np.float32)},
+                              {"kind": "hello", "actor": 0, "t": 0})
+        with pytest.raises(ValueError, match="out-of-range"):
+            svc._handle_record(hello, conn_id=7)
+        # Step record before any hello: rejected, not a crash later.
+        step = encode_arrays(
+            {"obs": np.zeros((2, 4), np.float32),
+             "reward": np.zeros((2,), np.float32),
+             "terminated": np.zeros((2,), np.uint8),
+             "truncated": np.zeros((2,), np.uint8),
+             "next_obs": np.zeros((2, 4), np.float32)},
+            {"kind": "step", "actor": 1, "t": 5})
+        with pytest.raises(ValueError, match="before hello"):
+            svc._handle_record(step, conn_id=7)
+    finally:
+        svc.shutdown()

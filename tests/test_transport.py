@@ -128,7 +128,10 @@ def test_tcp_record_transport():
             rec = server.pop()
             if rec is not None:
                 got.append(rec)
-        assert got == payloads
+        # pop() yields (conn_id, payload); one client => one conn id,
+        # payloads in send order.
+        assert [p for _, p in got] == payloads
+        assert len({c for c, _ in got}) == 1
         client.close()
     finally:
         server.close()
