@@ -104,19 +104,20 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     obs = env.reset()
     t = 0
     failures = 0
-    client = connect_and_hello(obs, t)
-    steps = 0
+    client = None                    # first connect goes through the retry
+    steps = 0                        # path too (learner may not be up yet)
+    keep_waiting = lambda: not os.path.exists(stop_path)  # noqa: E731
     while steps < max_env_steps and not os.path.exists(stop_path) \
             and failures < max_consecutive_failures:
-        if client is None:           # between reconnect attempts
-            time.sleep(reconnect_backoff_s)
+        if client is None:           # between (re)connect attempts
             try:
                 client = connect_and_hello(obs, t)
                 failures = 0
             except OSError:
                 failures += 1
+                time.sleep(reconnect_backoff_s)
             continue
-        reply = client.read_reply()
+        reply = client.read_reply(keep_waiting)
         if reply is None:            # connection lost: reconnect + re-hello
             client.close()
             client = None

@@ -69,6 +69,11 @@ class ApexRuntimeConfig:
     # processes — the single-host DCN stand-in. False: the slots stay open
     # for external workers started on other hosts against tcp_address.
     spawn_remote_actors: bool = True
+    # Multi-learner: shard each training batch over this many local
+    # devices with gradients pmean-allreduced over ICI (the service-side
+    # counterpart of the fused mesh trainer; the NCCL-allreduce
+    # replacement, BASELINE.json:5). 1 = single device; 0 = all local.
+    learner_devices: int = 1
 
 
 class ApexLearnerService:
@@ -102,7 +107,12 @@ class ApexLearnerService:
         self.tcp_address = None
         if rt.tcp_port is not None or rt.num_remote_actors:
             from dist_dqn_tpu.actors.transport import TcpRecordServer
-            self.tcp_server = TcpRecordServer(port=rt.tcp_port or 0)
+            # Loopback unless an external port was explicitly requested —
+            # the record stream is unauthenticated, so the single-host
+            # stand-in mode must not listen on all interfaces.
+            host = "0.0.0.0" if rt.tcp_port is not None else "127.0.0.1"
+            self.tcp_server = TcpRecordServer(host=host,
+                                              port=rt.tcp_port or 0)
             self.tcp_address = self.tcp_server.address
         self._actor_conn: Dict[int, int] = {}   # remote actor id -> conn id
         self.stop_path = str(shm_dir() / f"stop_{self.run_id}")
@@ -115,6 +125,15 @@ class ApexLearnerService:
 
         net = build_network(cfg.network, self.num_actions)
         self.net = net
+        # Multi-learner: batches shard over the dp mesh axis, gradients
+        # pmean over ICI, learner state replicated.
+        self.n_learners = (len(jax.devices()) if rt.learner_devices == 0
+                           else rt.learner_devices)
+        if cfg.learner.batch_size % self.n_learners:
+            raise ValueError(
+                f"batch_size={cfg.learner.batch_size} not divisible by "
+                f"learner_devices={self.n_learners}")
+        axis = "dp" if self.n_learners > 1 else None
         # Recurrent (R2D2) configs swap in the sequence learner, the
         # carry-threaded policy and the sequence assembler; the transport,
         # actors and replay shard are shared (BASELINE.json:10).
@@ -124,7 +143,8 @@ class ApexLearnerService:
             from dist_dqn_tpu.agents.r2d2 import (make_r2d2_learner,
                                                   make_recurrent_actor_step)
             init, train_step = make_r2d2_learner(net, cfg.learner,
-                                                 cfg.replay)
+                                                 cfg.replay,
+                                                 axis_name=axis)
             self._act = jax.jit(make_recurrent_actor_step(net))
             self.seq_len = (cfg.replay.burn_in + cfg.replay.unroll_length
                             + cfg.learner.n_step)
@@ -137,7 +157,8 @@ class ApexLearnerService:
             self._prev_carry: List = [None] * self.total_actors
             self._prio_fn = None
         else:
-            init, train_step = make_learner(net, cfg.learner)
+            init, train_step = make_learner(net, cfg.learner,
+                                            axis_name=axis)
             self._act = jax.jit(make_actor_step(net))
             self.assemblers = [
                 NStepAssembler(rt.envs_per_actor, cfg.learner.n_step,
@@ -162,7 +183,10 @@ class ApexLearnerService:
             self._prio_fn = jax.jit(prio_fn)
         self.state = None
         self._init_learner = init
-        self._train_step = jax.jit(train_step, donate_argnums=0)
+        if axis is None:
+            self._train_step = jax.jit(train_step, donate_argnums=0)
+        else:
+            self._train_step = self._shard_train_step(train_step, axis)
 
         self.replay = PrioritizedHostReplay(
             cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
@@ -188,42 +212,98 @@ class ApexLearnerService:
         self._eval_env = None
         self._next_eval = rt.eval_every_steps or float("inf")
         self.bad_records = 0
+        self.actor_restarts = 0
+
+    def _shard_train_step(self, train_step, axis: str):
+        """Lift the per-device train step onto the local learner mesh:
+        batch leaves shard over ``axis``, learner state replicates, and the
+        pmean inside the step (agents/) allreduces gradients over ICI."""
+        jax = self.jax
+        from jax.sharding import PartitionSpec as P
+
+        from dist_dqn_tpu.parallel import make_mesh
+        from dist_dqn_tpu.types import SequenceSample, Transition
+
+        mesh = make_mesh(devices=jax.devices()[:self.n_learners])
+        repl = P()
+        if self.recurrent:
+            # Time-major [L, S, ...] fields shard the sequence axis (1).
+            data_specs = (SequenceSample(
+                obs=P(None, axis), action=P(None, axis),
+                reward=P(None, axis), done=P(None, axis),
+                reset=P(None, axis), start_state=(P(axis), P(axis)),
+                weights=P(axis), t_idx=P(axis), b_idx=P(axis)),)
+            metric_specs = {"loss": repl, "raw_loss": repl,
+                            "priorities": P(axis), "grad_norm": repl}
+        else:
+            data_specs = (jax.tree.map(lambda _: P(axis),
+                                       Transition(obs=0, action=0, reward=0,
+                                                  discount=0, next_obs=0)),
+                          P(axis))  # batch, weights
+            metric_specs = {"loss": repl, "raw_loss": repl,
+                            "priorities": P(axis), "grad_norm": repl,
+                            "mean_q_target_gap": repl}
+
+        def sharded(state, *data):
+            state_spec = jax.tree.map(lambda _: repl, state,
+                                      is_leaf=lambda x: x is None)
+            body = jax.shard_map(
+                train_step, mesh=mesh,
+                in_specs=(state_spec,) + data_specs,
+                out_specs=(state_spec, metric_specs), check_vma=False)
+            return body(state, *data)
+
+        return jax.jit(sharded, donate_argnums=0)
 
     # -- actor lifecycle ----------------------------------------------------
-    def spawn_actors(self):
+    def _spawn_one(self, actor_id: int):
+        """(Re)start one actor process; returns the Process handle."""
         import multiprocessing as mp
 
         from dist_dqn_tpu.actors.actor import run_actor, run_remote_actor
         ctx = mp.get_context("spawn")
-        self.procs = []
-        for i in range(self.rt.num_actors):
+        if actor_id < self.rt.num_actors:
             p = ctx.Process(
                 target=run_actor,
-                args=(i, self.rt.host_env, self.rt.envs_per_actor,
-                      1000 + 7 * i, f"req_{self.run_id}",
-                      f"act_{self.run_id}_{i}", self.stop_path),
+                args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
+                      1000 + 7 * actor_id, f"req_{self.run_id}",
+                      f"act_{self.run_id}_{actor_id}", self.stop_path),
                 daemon=True)
-            p.start()
-            self.procs.append(p)
-        # Locally-spawned remote actors (single-host stand-in for DCN
-        # workers; real ones run actors/remote.py on other hosts).
-        if not self.rt.spawn_remote_actors:
-            return
-        for j in range(self.rt.num_remote_actors):
-            actor_id = self.rt.num_actors + j
+        else:
             p = ctx.Process(
                 target=run_remote_actor,
                 args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
                       1000 + 7 * actor_id,
                       ("127.0.0.1", self.tcp_address[1]), self.stop_path),
                 daemon=True)
-            p.start()
-            self.procs.append(p)
+        p.start()
+        return p
+
+    def spawn_actors(self):
+        self.procs: Dict[int, object] = {}
+        for i in range(self.rt.num_actors):
+            self.procs[i] = self._spawn_one(i)
+        # Locally-spawned remote actors (single-host stand-in for DCN
+        # workers; real ones run actors/remote.py on other hosts).
+        if self.rt.spawn_remote_actors:
+            for j in range(self.rt.num_remote_actors):
+                actor_id = self.rt.num_actors + j
+                self.procs[actor_id] = self._spawn_one(actor_id)
+
+    def supervise_actors(self):
+        """Failure handling for actor churn (SURVEY.md §5): actors are
+        stateless workers, so a dead process is simply restarted — its
+        fresh hello resets the assembly lanes and recurrent carry, and the
+        learner never notices beyond a briefly idle lane."""
+        for actor_id, p in list(self.procs.items()):
+            if not p.is_alive():
+                self.actor_restarts += 1
+                self.procs[actor_id] = self._spawn_one(actor_id)
 
     def shutdown(self):
         with open(self.stop_path, "w") as f:
             f.write("stop")
-        for p in getattr(self, "procs", []):
+        for p in getattr(self, "procs", {}).values():
             p.join(timeout=10)
             if p.is_alive():
                 p.terminate()
@@ -506,11 +586,18 @@ class ApexLearnerService:
                         conn_id, payload = rec
                         try:
                             self._handle_record(payload, conn_id=conn_id)
-                        except Exception:
+                        except Exception as e:
                             # Network input is untrusted (the listener may
                             # face other hosts): a malformed or misrouted
                             # record must not take down the training run.
+                            # Logged (rate-limited) so a genuine service
+                            # bug surfacing here is visible, not silently
+                            # counted away.
                             self.bad_records += 1
+                            if self.bad_records <= 5:
+                                self.log.log_fn(
+                                    f"# bad TCP record ({self.bad_records})"
+                                    f": {type(e).__name__}: {e}")
                 self._flush_pending()
                 self._maybe_train()
                 if self._ckpt is not None:
@@ -526,10 +613,13 @@ class ApexLearnerService:
                     time.sleep(0.0002)
                 now = time.perf_counter()
                 if now - last_log > self.rt.log_every_s:
+                    self.supervise_actors()
                     self.log.record(env_steps=self.env_steps,
                                     grad_steps=self.grad_steps,
                                     replay_size=float(len(self.replay)),
                                     loss=getattr(self, "_last_loss", 0.0),
+                                    actor_restarts=float(
+                                        self.actor_restarts),
                                     ring_dropped=float(
                                         self.req_ring.dropped))
                     self.log.flush()
@@ -543,9 +633,12 @@ class ApexLearnerService:
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
                 "replay_size": len(self.replay),
                 "ring_dropped": self.req_ring.dropped,
-                "tcp_dropped": (self.tcp_server.dropped
-                                if self.tcp_server else 0),
-                "bad_records": self.bad_records}
+                # Full backlogs backpressure rather than drop; a nonzero
+                # count means the learner is not keeping up with actors.
+                "tcp_backpressure": (self.tcp_server.backpressure_events
+                                     if self.tcp_server else 0),
+                "bad_records": self.bad_records,
+                "actor_restarts": self.actor_restarts}
 
 
 def run_apex(cfg: ExperimentConfig, rt: ApexRuntimeConfig, log_fn=print):

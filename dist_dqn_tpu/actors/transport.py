@@ -212,7 +212,7 @@ class TcpRecordServer:
     arrived on, so routing survives actor restarts/reconnects.
     """
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  max_backlog: int = 4096):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -336,9 +336,16 @@ class TcpRecordClient:
     The remote-actor protocol is lock-step per actor (send observations,
     wait for actions), so replies are read synchronously off the same
     socket — no background thread, no reordering to handle.
+
+    A recv timeout is NOT a dead connection: the service legitimately
+    stalls for long stretches (first jit compile, checkpoint writes,
+    evaluation), so ``read_reply`` keeps waiting through timeouts while
+    ``keep_waiting()`` approves, and returns None only on EOF/error — a
+    learner stall must not make the whole fleet tear down healthy
+    connections and drop assembly windows.
     """
 
-    def __init__(self, address: Tuple[str, int], timeout_s: float = 30.0):
+    def __init__(self, address: Tuple[str, int], timeout_s: float = 5.0):
         self._sock = socket.create_connection(address, timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
@@ -349,13 +356,31 @@ class TcpRecordClient:
         except OSError:
             return False
 
-    def read_reply(self) -> Optional[bytes]:
-        """Block (up to the socket timeout) for the next reply record."""
-        hdr = TcpRecordServer._recv_exact(self._sock, 4)
+    def _recv_exact(self, n: int, keep_waiting) -> Optional[bytes]:
+        chunks = []
+        while n:
+            try:
+                b = self._sock.recv(n)
+            except socket.timeout:
+                if keep_waiting():
+                    continue
+                return None
+            except OSError:
+                return None
+            if not b:
+                return None
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def read_reply(self, keep_waiting=lambda: True) -> Optional[bytes]:
+        """Block for the next reply record; None = connection dead (or
+        ``keep_waiting`` said stop)."""
+        hdr = self._recv_exact(4, keep_waiting)
         if hdr is None:
             return None
         (n,) = struct.unpack("<I", hdr)
-        return TcpRecordServer._recv_exact(self._sock, n)
+        return self._recv_exact(n, keep_waiting)
 
     def close(self):
         try:

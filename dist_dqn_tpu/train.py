@@ -151,6 +151,10 @@ def main():
     parser.add_argument("--envs-per-actor", type=int, default=8)
     parser.add_argument("--num-remote-actors", type=int, default=0,
                         help="apex runtime: remote (TCP) actor slots")
+    parser.add_argument("--learner-devices", type=int, default=1,
+                        help="apex runtime: shard train batches over this "
+                             "many local devices (0 = all; gradients "
+                             "pmean over ICI)")
     parser.add_argument("--tcp-port", type=int, default=None,
                         help="apex runtime: listen for remote actors "
                              "(actors/remote.py) on this port; 0 = "
@@ -193,7 +197,8 @@ def main():
             eval_episodes=cfg.eval_episodes,
             tcp_port=args.tcp_port,
             num_remote_actors=args.num_remote_actors,
-            spawn_remote_actors=args.remote_actor_mode == "local")
+            spawn_remote_actors=args.remote_actor_mode == "local",
+            learner_devices=args.learner_devices)
         print(json.dumps(run_apex(cfg, rt)))
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,

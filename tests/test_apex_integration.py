@@ -59,3 +59,53 @@ def test_apex_checkpoint_resume_and_eval(tmp_path):
     resumed = [s for s in logs2 if "resumed_at_env_steps" in s]
     assert resumed, logs2[:3]
     assert result2["env_steps"] >= 900
+
+
+def test_apex_multi_learner_sharded(tmp_path):
+    """8 learner devices on the virtual CPU mesh: batches shard, gradients
+    pmean-allreduce, the run trains to completion."""
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=150),
+        learner=dataclasses.replace(cfg.learner, batch_size=32, n_step=2),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=2,
+                           envs_per_actor=4, total_env_steps=1200,
+                           inserts_per_grad_step=32, learner_devices=0)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 1200
+    assert result["grad_steps"] >= 5
+
+
+def test_apex_multi_learner_r2d2(tmp_path):
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    cfg = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    lstm_size=16, dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=64,
+                                   burn_in=2, unroll_length=6,
+                                   sequence_stride=3),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=4, total_env_steps=1200,
+                           inserts_per_grad_step=16, learner_devices=8)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 1200
+    assert result["grad_steps"] >= 3

@@ -68,7 +68,7 @@ def test_apex_mixed_local_and_remote_actors():
     assert result["env_steps"] >= 1500
     assert result["grad_steps"] >= 5
     assert result["ring_dropped"] == 0
-    assert result["tcp_dropped"] == 0
+    assert result["tcp_backpressure"] == 0
 
 
 def test_apex_remote_r2d2_actors():
@@ -91,7 +91,7 @@ def test_apex_remote_r2d2_actors():
     result = run_apex(cfg, rt, log_fn=lambda s: None)
     assert result["env_steps"] >= 1000
     assert result["grad_steps"] >= 3
-    assert result["tcp_dropped"] == 0
+    assert result["tcp_backpressure"] == 0
 
 
 def test_assembler_reset_drops_partial_windows():
@@ -161,3 +161,39 @@ def test_service_rejects_malformed_and_misrouted_records():
             svc._handle_record(step, conn_id=7)
     finally:
         svc.shutdown()
+
+
+def test_actor_churn_supervision():
+    """Kill an actor mid-run: the service restarts it and finishes."""
+    import threading
+    import time
+    from dist_dqn_tpu.actors.service import ApexLearnerService
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=100),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2))
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=2,
+                           envs_per_actor=4, total_env_steps=2500,
+                           inserts_per_grad_step=64, log_every_s=0.5)
+    svc = ApexLearnerService(cfg, rt, log_fn=lambda s: None)
+
+    def assassin():
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            procs = getattr(svc, "procs", None)
+            if procs and svc.env_steps > 200:
+                procs[0].terminate()
+                return
+            time.sleep(0.1)
+
+    killer = threading.Thread(target=assassin, daemon=True)
+    killer.start()
+    result = svc.run()
+    assert result["actor_restarts"] >= 1
+    assert result["env_steps"] >= 2500
