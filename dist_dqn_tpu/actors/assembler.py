@@ -195,3 +195,140 @@ class SequenceAssembler:
                for k in self._out[0]}
         self._out = []
         return out
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) n-step assembly — the host ingestion hot path.
+# ---------------------------------------------------------------------------
+
+_asm_lib = None
+
+
+def _assembler_lib():
+    """Build (if needed) and load the C++ assembler (ctypes, no pybind11)."""
+    global _asm_lib
+    if _asm_lib is None:
+        import ctypes
+
+        from dist_dqn_tpu.actors.transport import build_native_lib
+
+        lib = ctypes.CDLL(str(build_native_lib("assembler.cc",
+                                               "libdqnassembler.so")))
+        lib.dqn_asm_create.restype = ctypes.c_void_p
+        lib.dqn_asm_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_float, ctypes.c_uint64]
+        lib.dqn_asm_destroy.argtypes = [ctypes.c_void_p]
+        lib.dqn_asm_reset.argtypes = [ctypes.c_void_p]
+        lib.dqn_asm_set_arena.argtypes = [ctypes.c_void_p] \
+            + [ctypes.c_void_p] * 5 + [ctypes.c_int64]
+        lib.dqn_asm_step.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 6
+        lib.dqn_asm_pending.restype = ctypes.c_int64
+        lib.dqn_asm_pending.argtypes = [ctypes.c_void_p]
+        lib.dqn_asm_overflow.restype = ctypes.c_int64
+        lib.dqn_asm_overflow.argtypes = [ctypes.c_void_p]
+        lib.dqn_asm_take.restype = ctypes.c_int64
+        lib.dqn_asm_take.argtypes = [ctypes.c_void_p]
+        _asm_lib = lib
+    return _asm_lib
+
+
+class NativeNStepAssembler:
+    """C++ n-step assembly (actors/_native/assembler.cc): same interface
+    and exact same episode-boundary semantics as ``NStepAssembler`` — the
+    designated native path for the learner service's trajectory ingestion
+    (SURVEY.md §7 hard part #1).
+
+    Copy discipline: lane rings hold pointers into the caller's step-record
+    arrays (this wrapper keeps the last n_step+1 records alive to cover
+    every open window) and emissions land once in persistent numpy arenas;
+    ``drain`` returns VIEWS into those arenas, valid until the next
+    ``step`` call — downstream replay insertion copies them immediately,
+    so nothing is copied twice. Callers must not mutate the arrays they
+    pass to ``step``.
+    """
+
+    def __init__(self, num_lanes: int, n_step: int, gamma: float,
+                 arena_capacity: int = 0):
+        self.num_lanes = num_lanes
+        self.n = n_step
+        self.gamma = gamma
+        self._lib = _assembler_lib()
+        self._h = None
+        self._obs_shape = None
+        self._obs_dtype = None
+        self._obs_size = 0
+        # Worst case per step call: every lane flushes a full window of
+        # suffixes (n emissions); headroom for several steps between drains.
+        self._capacity = arena_capacity or max(64 * num_lanes * n_step,
+                                               1024)
+        self._keepalive: Deque = deque(maxlen=n_step + 1)
+        self._arena = None
+
+    def _ptr(self, arr: np.ndarray):
+        import ctypes
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    def _init_native(self, obs: np.ndarray):
+        self._obs_shape = obs.shape[1:]
+        self._obs_dtype = obs.dtype
+        self._obs_size = obs.nbytes // obs.shape[0]
+        self._h = self._lib.dqn_asm_create(
+            self.num_lanes, self.n, float(self.gamma), self._obs_size)
+        cap = self._capacity
+        self._arena = {
+            "obs": np.empty((cap,) + self._obs_shape, self._obs_dtype),
+            "action": np.empty((cap,), np.int32),
+            "reward": np.empty((cap,), np.float32),
+            "discount": np.empty((cap,), np.float32),
+            "next_obs": np.empty((cap,) + self._obs_shape, self._obs_dtype),
+        }
+        self._lib.dqn_asm_set_arena(
+            self._h, self._ptr(self._arena["obs"]),
+            self._ptr(self._arena["action"]),
+            self._ptr(self._arena["reward"]),
+            self._ptr(self._arena["discount"]),
+            self._ptr(self._arena["next_obs"]), cap)
+
+    def step(self, obs, action, reward, terminated, truncated, next_obs):
+        obs = np.ascontiguousarray(obs)
+        next_obs = np.ascontiguousarray(next_obs)
+        if self._h is None:
+            self._init_native(obs)
+        a = np.ascontiguousarray(action, np.int32)
+        r = np.ascontiguousarray(reward, np.float32)
+        te = np.ascontiguousarray(terminated, np.uint8)
+        tr = np.ascontiguousarray(truncated, np.uint8)
+        # The ring references obs for up to n_step subsequent calls.
+        self._keepalive.append((obs, next_obs))
+        self._lib.dqn_asm_step(self._h, self._ptr(obs), self._ptr(a),
+                               self._ptr(r), self._ptr(te), self._ptr(tr),
+                               self._ptr(next_obs))
+        if self._lib.dqn_asm_overflow(self._h):
+            raise RuntimeError(
+                "native assembler arena overflow: drain() more often or "
+                "raise arena_capacity")
+
+    def drain(self, copy: bool = True) -> Optional[Dict[str, np.ndarray]]:
+        """Emitted transitions; ``copy=False`` returns arena VIEWS that are
+        only valid until the next ``step()`` call — for consumers that
+        ingest them immediately (e.g. replay insertion in the same loop
+        iteration). The default copies, so results can be batched across
+        steps like the Python assembler's output."""
+        if self._h is None:
+            return None
+        count = self._lib.dqn_asm_take(self._h)
+        if count == 0:
+            return None
+        out = {k: v[:count] for k, v in self._arena.items()}
+        if copy:
+            out = {k: np.array(v) for k, v in out.items()}
+        return out
+
+    def reset(self) -> None:
+        if self._h is not None:
+            self._lib.dqn_asm_reset(self._h)
+        self._keepalive.clear()
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.dqn_asm_destroy(self._h)

@@ -74,6 +74,11 @@ class ApexRuntimeConfig:
     # counterpart of the fused mesh trainer; the NCCL-allreduce
     # replacement, BASELINE.json:5). 1 = single device; 0 = all local.
     learner_devices: int = 1
+    # C++ n-step assembly (actors/_native/assembler.cc; ~6x the Python
+    # path on pixel frames). Feed-forward configs only — the R2D2
+    # sequence assembler is Python. Falls back with a log line if the
+    # native build is unavailable.
+    native_assembly: bool = True
 
 
 class ApexLearnerService:
@@ -129,6 +134,10 @@ class ApexLearnerService:
         # pmean over ICI, learner state replicated.
         self.n_learners = (len(jax.devices()) if rt.learner_devices == 0
                            else rt.learner_devices)
+        if self.n_learners > len(jax.devices()):
+            raise ValueError(
+                f"learner_devices={self.n_learners} but only "
+                f"{len(jax.devices())} devices are available")
         if cfg.learner.batch_size % self.n_learners:
             raise ValueError(
                 f"batch_size={cfg.learner.batch_size} not divisible by "
@@ -160,9 +169,21 @@ class ApexLearnerService:
             init, train_step = make_learner(net, cfg.learner,
                                             axis_name=axis)
             self._act = jax.jit(make_actor_step(net))
+            asm_cls = NStepAssembler
+            if rt.native_assembly:
+                try:
+                    from dist_dqn_tpu.actors.assembler import \
+                        NativeNStepAssembler
+                    from dist_dqn_tpu.actors.assembler import \
+                        _assembler_lib
+                    _assembler_lib()  # force the g++ build now, not mid-run
+                    asm_cls = NativeNStepAssembler
+                except Exception as e:
+                    log_fn(f"# native assembler unavailable "
+                           f"({type(e).__name__}: {e}); using Python path")
             self.assemblers = [
-                NStepAssembler(rt.envs_per_actor, cfg.learner.n_step,
-                               cfg.learner.gamma)
+                asm_cls(rt.envs_per_actor, cfg.learner.n_step,
+                        cfg.learner.gamma)
                 for _ in range(self.total_actors)
             ]
 

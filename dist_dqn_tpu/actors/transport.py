@@ -31,14 +31,20 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def _build_native() -> Path:
-    src = _NATIVE_DIR / "transport.cc"
-    if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime:
-        return _LIB_PATH
+def build_native_lib(src_name: str, lib_name: str) -> Path:
+    """Compile one _native/*.cc into a shared lib on demand (mtime-cached)."""
+    src = _NATIVE_DIR / src_name
+    out = _NATIVE_DIR / lib_name
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           str(src), "-o", str(_LIB_PATH)]
+           str(src), "-o", str(out)]
     subprocess.run(cmd, check=True, capture_output=True)
-    return _LIB_PATH
+    return out
+
+
+def _build_native() -> Path:
+    return build_native_lib("transport.cc", "libdqntransport.so")
 
 
 def native_lib() -> ctypes.CDLL:
@@ -345,24 +351,44 @@ class TcpRecordClient:
     connections and drop assembly windows.
     """
 
-    def __init__(self, address: Tuple[str, int], timeout_s: float = 5.0):
+    def __init__(self, address: Tuple[str, int], timeout_s: float = 5.0,
+                 max_stall_s: float = 300.0):
         self._sock = socket.create_connection(address, timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Dead-peer floor below the app-level stall bound: a silent
+        # partition (no FIN/RST) still gets torn down by the kernel.
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        self._timeout_s = timeout_s
+        self._max_stall_s = max_stall_s
 
     def push(self, payload: bytes) -> bool:
+        # sendall's partial progress cannot be resumed after a timeout, so
+        # sends get the full stall bound: server-side backpressure pauses
+        # reads during learner stalls, and a large (pixel) record can
+        # legitimately sit mid-send well past the short recv timeout.
         try:
+            self._sock.settimeout(self._max_stall_s)
             self._sock.sendall(struct.pack("<I", len(payload)) + payload)
             return True
         except OSError:
             return False
+        finally:
+            try:
+                self._sock.settimeout(self._timeout_s)
+            except OSError:
+                pass
 
     def _recv_exact(self, n: int, keep_waiting) -> Optional[bytes]:
+        deadline = time.monotonic() + self._max_stall_s
         chunks = []
         while n:
             try:
                 b = self._sock.recv(n)
             except socket.timeout:
-                if keep_waiting():
+                # Keep waiting through service stalls (compile/checkpoint/
+                # eval), but not forever: past max_stall_s the peer is
+                # treated as dead even without a FIN (silent partition).
+                if keep_waiting() and time.monotonic() < deadline:
                     continue
                 return None
             except OSError:
@@ -371,11 +397,12 @@ class TcpRecordClient:
                 return None
             chunks.append(b)
             n -= len(b)
+            deadline = time.monotonic() + self._max_stall_s
         return b"".join(chunks)
 
     def read_reply(self, keep_waiting=lambda: True) -> Optional[bytes]:
-        """Block for the next reply record; None = connection dead (or
-        ``keep_waiting`` said stop)."""
+        """Block for the next reply record; None = connection dead, stalled
+        past ``max_stall_s``, or ``keep_waiting`` said stop."""
         hdr = self._recv_exact(4, keep_waiting)
         if hdr is None:
             return None

@@ -140,6 +140,9 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
             grads = jax.lax.pmean(grads, axis_name)
             loss = jax.lax.pmean(loss, axis_name)
             raw_loss = jax.lax.pmean(raw_loss, axis_name)
+            mean_gap = jax.lax.pmean(jnp.mean(priorities), axis_name)
+        else:
+            mean_gap = jnp.mean(priorities)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         steps = state.steps + 1
@@ -163,7 +166,7 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
             "raw_loss": raw_loss,
             "priorities": priorities,
             "grad_norm": optax.global_norm(grads),
-            "mean_q_target_gap": jnp.mean(priorities),
+            "mean_q_target_gap": mean_gap,
         }
         return new_state, metrics
 
