@@ -49,6 +49,25 @@ def make_schedules(cfg: ExperimentConfig, B: int, num_shards: int
     return epsilon, beta_at
 
 
+def pallas_routing(enabled: bool) -> Tuple[bool, bool]:
+    """(use_pallas, pallas_interpret) for the priority-sampling kernel.
+
+    Pallas kernels compile only on real TPU backends; anywhere else the
+    config flag falls back to the equivalent XLA sampler — the Python-level
+    interpreter inside a scanned hot loop would look like a hang at real
+    buffer sizes. DIST_DQN_PALLAS_INTERPRET=1 opts back in for tiny-size
+    integration tests of the kernel routing.
+    """
+    import os
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = (not on_tpu
+                 and os.environ.get("DIST_DQN_PALLAS_INTERPRET") == "1")
+    return enabled and (on_tpu or interpret), interpret
+
+
 def make_rng_splitter(spmd: bool) -> Callable:
     """split(carry_rng, n) -> (new_carry_rng, [n] keys); in SPMD mode the
     carry rng is a [1] key array (per-device stream) and stays that shape."""

@@ -142,6 +142,41 @@ def _sample_kernel(w_ref, u_ref, t_out, b_out, p_out, tot_out, rs_ref, *,
                        keepdims=True)
 
 
+def stratified_sample(w: Array, rng: Array, batch_size: int,
+                      use_pallas: bool = False, interpret: bool = False
+                      ) -> Tuple[Array, Array, Array, Array]:
+    """Stratified inverse-CDF draw from a [T, B] mass plane — the ONE
+    implementation both replay samplers (transition and sequence) share.
+
+    Returns (t_idx [S], b_idx [S], mass_sel [S], total []). Routing:
+    ``use_pallas`` runs the VMEM kernel below; otherwise the portable XLA
+    cumsum+searchsorted path.
+    """
+    u01 = (jnp.arange(batch_size, dtype=jnp.float32)
+           + jax.random.uniform(rng, (batch_size,))) / batch_size
+    if use_pallas:
+        return pallas_stratified_sample(w, u01, interpret=interpret)
+    num_envs = w.shape[1]
+    flat = w.reshape(-1)
+    cdf = jnp.cumsum(flat)
+    total = cdf[-1]
+    idx = jnp.clip(jnp.searchsorted(cdf, u01 * total), 0, flat.shape[0] - 1)
+    t_idx = (idx // num_envs).astype(jnp.int32)
+    b_idx = (idx % num_envs).astype(jnp.int32)
+    return t_idx, b_idx, flat[idx], total
+
+
+def importance_weights(mass_sel: Array, total: Array, n_valid: Array,
+                       beta: Array) -> Array:
+    """(N * P(i))^-beta, batch-max normalized; zero-mass selections
+    (possible only through fp boundary pathology) get weight 0 instead of
+    an enormous one that would crush the batch."""
+    p_sel = jnp.maximum(mass_sel, 1e-12) / jnp.maximum(total, 1e-12)
+    weights = (jnp.maximum(n_valid, 1.0) * p_sel) ** (-beta)
+    weights = jnp.where(mass_sel > 0.0, weights, 0.0)
+    return weights / jnp.maximum(jnp.max(weights), 1e-12)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_stratified_sample(w: Array, u: Array, interpret: bool = False
                              ) -> Tuple[Array, Array, Array, Array]:

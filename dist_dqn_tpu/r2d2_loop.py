@@ -70,6 +70,8 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
 
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
     _split_rng = loop_common.make_rng_splitter(spmd)
+    use_pallas, pallas_interpret = loop_common.pallas_routing(
+        rcfg.pallas_sampler)
 
     def can_train(replay: sring.SequenceRingState, iteration: Array) -> Array:
         filled = replay.ring.size * B >= min_fill
@@ -129,7 +131,8 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
                 l, rep = c
                 s = sring.sequence_ring_sample(
                     rep, key, batch_size, seq_len,
-                    rcfg.priority_exponent, beta)
+                    rcfg.priority_exponent, beta, use_pallas=use_pallas,
+                    pallas_interpret=pallas_interpret)
                 l, metrics = train_step(l, s)
                 rep = sring.sequence_ring_update(
                     rep, s.t_idx, s.b_idx, metrics["priorities"],

@@ -86,34 +86,17 @@ def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
     (ops/pallas_sampler.py, BASELINE.json:5) — same stratified inverse-CDF
     math, VMEM-resident; the XLA path below is the portable fallback.
     """
+    from dist_dqn_tpu.ops.pallas_sampler import (importance_weights,
+                                                 stratified_sample)
+
     num_slots, num_envs = state.priorities.shape
     mask = _valid_start_mask(state.ring, n_step)                  # [T]
     w = jnp.where(mask[:, None], state.priorities ** alpha, 0.0)  # [T, B]
     n_valid = (jnp.sum(mask.astype(jnp.float32)) * num_envs)
-    u01 = (jnp.arange(batch_size, dtype=jnp.float32)
-           + jax.random.uniform(rng, (batch_size,))) / batch_size
-
-    if use_pallas:
-        from dist_dqn_tpu.ops.pallas_sampler import pallas_stratified_sample
-        t_idx, b_idx, mass_sel, total = pallas_stratified_sample(
-            w, u01, interpret=pallas_interpret)
-    else:
-        flat = w.reshape(-1)
-        cdf = jnp.cumsum(flat)
-        total = cdf[-1]
-        idx = jnp.clip(jnp.searchsorted(cdf, u01 * total), 0,
-                       flat.shape[0] - 1)
-        t_idx = (idx // num_envs).astype(jnp.int32)
-        b_idx = (idx % num_envs).astype(jnp.int32)
-        mass_sel = flat[idx]
-
-    # Importance weights: (N * P(i))^-beta, normalized by the batch max.
-    # A zero-mass selection (possible only through fp boundary pathology)
-    # gets weight 0 instead of an enormous one that would crush the batch.
-    p_sel = jnp.maximum(mass_sel, 1e-12) / jnp.maximum(total, 1e-12)
-    weights = (n_valid * p_sel) ** (-beta)
-    weights = jnp.where(mass_sel > 0.0, weights, 0.0)
-    weights = weights / jnp.maximum(jnp.max(weights), 1e-12)
+    t_idx, b_idx, mass_sel, total = stratified_sample(
+        w, rng, batch_size, use_pallas=use_pallas,
+        interpret=pallas_interpret)
+    weights = importance_weights(mass_sel, total, n_valid, beta)
 
     batch = ring.gather_transitions(state.ring, t_idx, b_idx, n_step, gamma)
     return PrioritizedSample(batch=batch, weights=weights, t_idx=t_idx,

@@ -103,29 +103,24 @@ def _gather_seq(field: Array, t_idx: Array, b_idx: Array, L: int,
 
 def sequence_ring_sample(state: SequenceRingState, rng: Array,
                          batch_size: int, seq_len: int, alpha: float,
-                         beta: Array) -> SequenceSample:
+                         beta: Array, use_pallas: bool = False,
+                         pallas_interpret: bool = False) -> SequenceSample:
     """Stratified-CDF sample of ``batch_size`` length-``seq_len`` sequences.
 
-    Same inverse-CDF machinery as the transition sampler: the priority plane
-    is already masked (zero = invalid start), so one cumsum + searchsorted
-    draws ~ p^alpha and yields the total mass for importance weights free.
+    Same inverse-CDF machinery as the transition sampler — the priority
+    plane is already masked (zero = invalid start) — including the same
+    Pallas kernel routing (ops/pallas_sampler.py) for large planes on TPU.
     """
+    from dist_dqn_tpu.ops.pallas_sampler import (importance_weights,
+                                                 stratified_sample)
+
     num_slots, num_envs = state.priorities.shape
-    flat = (state.priorities ** alpha).reshape(-1)
-    flat = jnp.where(state.priorities.reshape(-1) > 0.0, flat, 0.0)
-    cdf = jnp.cumsum(flat)
-    total = cdf[-1]
-
-    u = (jnp.arange(batch_size, dtype=jnp.float32)
-         + jax.random.uniform(rng, (batch_size,))) / batch_size * total
-    idx = jnp.clip(jnp.searchsorted(cdf, u), 0, flat.shape[0] - 1)
-    t_idx = (idx // num_envs).astype(jnp.int32)
-    b_idx = (idx % num_envs).astype(jnp.int32)
-
-    n_valid = jnp.sum((flat > 0.0).astype(jnp.float32))
-    p_sel = jnp.maximum(flat[idx], 1e-12) / jnp.maximum(total, 1e-12)
-    weights = (jnp.maximum(n_valid, 1.0) * p_sel) ** (-beta)
-    weights = weights / jnp.maximum(jnp.max(weights), 1e-12)
+    w = jnp.where(state.priorities > 0.0, state.priorities ** alpha, 0.0)
+    t_idx, b_idx, mass_sel, total = stratified_sample(
+        w, rng, batch_size, use_pallas=use_pallas,
+        interpret=pallas_interpret)
+    n_valid = jnp.sum((w > 0.0).astype(jnp.float32))
+    weights = importance_weights(mass_sel, total, n_valid, beta)
 
     r = state.ring
     obs = jax.tree.map(

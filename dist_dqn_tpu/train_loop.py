@@ -15,7 +15,6 @@ device, and only the learner's gradients cross the ICI via ``pmean``
 """
 from __future__ import annotations
 
-import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -72,17 +71,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
 
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
     _split_rng = loop_common.make_rng_splitter(spmd)
-    # Pallas kernels compile only on real TPU backends. Anywhere else the
-    # config flag falls back to the equivalent XLA sampler — the Python-
-    # level interpreter inside a scanned hot loop would look like a hang at
-    # real buffer sizes. DIST_DQN_PALLAS_INTERPRET=1 opts back in for
-    # tiny-size integration tests of the kernel routing.
-    on_tpu = jax.default_backend() == "tpu"
-    pallas_interpret = (not on_tpu
-                        and os.environ.get("DIST_DQN_PALLAS_INTERPRET")
-                        == "1")
-    use_pallas = (prioritized and cfg.replay.pallas_sampler
-                  and (on_tpu or pallas_interpret))
+    use_pallas, pallas_interpret = loop_common.pallas_routing(
+        prioritized and cfg.replay.pallas_sampler)
 
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay

@@ -193,3 +193,106 @@ def test_r2d2_fused_loop_learns_cartpole():
     # Learning smoke: clearly above the ~20-step random-policy return.
     assert max(returns + evals) >= 80.0, (returns, evals)
     assert all(abs(r["loss"]) < 1e3 for r in history)
+
+
+def test_sequence_sampler_pallas_agrees_with_xla():
+    state = sring.sequence_ring_init(64, 4, jnp.zeros((2,)), lstm_size=4)
+    state = _seq_fill(state, 40, 4, seq_len=4, stride=1, dones=(11, 23))
+    key = jax.random.PRNGKey(0)
+    kw = dict(batch_size=32, seq_len=4, alpha=0.6, beta=jnp.float32(0.4))
+    s_xla = sring.sequence_ring_sample(state, key, **kw)
+    s_pal = sring.sequence_ring_sample(state, key, use_pallas=True,
+                                       pallas_interpret=True, **kw)
+    agree = np.mean((np.asarray(s_xla.t_idx) == np.asarray(s_pal.t_idx))
+                    & (np.asarray(s_xla.b_idx) == np.asarray(s_pal.b_idx)))
+    assert agree >= 0.95
+    np.testing.assert_allclose(np.asarray(s_pal.weights),
+                               np.asarray(s_xla.weights), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_r2d2_sharded_train_step_matches_single_device():
+    """8 sequence learners on batch shards + pmean == 1 learner full-batch."""
+    import pytest
+    from jax.sharding import PartitionSpec as P
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    from dist_dqn_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    burn, unroll, n = 2, 4, 2
+    L, S, A = burn + unroll + n, 16, 3
+    net = _tiny_net(num_actions=A)
+    rng = jax.random.PRNGKey(0)
+    sample = SequenceSample(
+        obs=jax.random.normal(rng, (L, S, 4)),
+        action=jax.random.randint(jax.random.PRNGKey(1), (L, S), 0, A),
+        reward=jax.random.normal(jax.random.PRNGKey(2), (L, S)),
+        done=jnp.zeros((L, S), bool).at[3, 2].set(True),
+        reset=jnp.zeros((L, S), bool).at[4, 2].set(True),
+        start_state=net.initial_state(S),
+        weights=jnp.ones((S,)),
+        t_idx=jnp.zeros((S,), jnp.int32),
+        b_idx=jnp.zeros((S,), jnp.int32),
+    )
+    lcfg = LearnerConfig(learning_rate=1e-2, gamma=0.95, n_step=n,
+                         value_rescale=True)
+    rcfg = ReplayConfig(burn_in=burn, unroll_length=unroll)
+    init_s, step_s = make_r2d2_learner(net, lcfg, rcfg)
+    _, step_d = make_r2d2_learner(net, lcfg, rcfg, axis_name="dp")
+    state = init_s(jax.random.PRNGKey(3), sample.obs[0, 0])
+
+    state_spec = jax.tree.map(lambda _: P(), state,
+                              is_leaf=lambda x: x is None)
+    sample_spec = SequenceSample(
+        obs=P(None, "dp"), action=P(None, "dp"), reward=P(None, "dp"),
+        done=P(None, "dp"), reset=P(None, "dp"),
+        start_state=(P("dp"), P("dp")), weights=P("dp"),
+        t_idx=P("dp"), b_idx=P("dp"))
+    metric_specs = {"loss": P(), "raw_loss": P(), "priorities": P("dp"),
+                    "grad_norm": P()}
+    dist = jax.jit(jax.shard_map(
+        step_d, mesh=mesh, in_specs=(state_spec, sample_spec),
+        out_specs=(state_spec, metric_specs), check_vma=False))
+
+    s1, m1 = jax.jit(step_s)(state, sample)
+    s2, m2 = dist(state, sample)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5,
+                                   atol=1e-6)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m1["priorities"]),
+                               np.asarray(m2["priorities"]), rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_r2d2_fused_loop_with_pallas_sampler_runs(monkeypatch):
+    monkeypatch.setenv("DIST_DQN_PALLAS_INTERPRET", "1")
+    cfg = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="cartpole",
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    lstm_size=8, compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64,
+                                   burn_in=2, unroll_length=4,
+                                   sequence_stride=2, pallas_sampler=True),
+        learner=dataclasses.replace(cfg.learner, n_step=2, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        total_env_steps=400,
+    )
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.r2d2_loop import make_r2d2_train
+
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run_chunk = make_r2d2_train(cfg, env, net)
+    run = jax.jit(run_chunk, static_argnums=1)
+    carry = init(jax.random.PRNGKey(0))
+    carry, metrics = run(carry, 60)
+    assert float(metrics["grad_steps_in_chunk"]) > 0
+    assert np.isfinite(float(metrics["loss"]))
