@@ -23,6 +23,7 @@ class NetworkConfig:
     v_min: float = -10.0
     v_max: float = 10.0
     lstm_size: int = 0                 # >0 => recurrent core (R2D2)
+    remat_torso: bool = False          # recompute torso acts in backward
     compute_dtype: str = "float32"     # "bfloat16" for the TPU MXU path
 
 
@@ -153,7 +154,11 @@ R2D2 = ExperimentConfig(
     name="r2d2",
     env_name="pixel_pong",
     network=NetworkConfig(torso="nature", hidden=512, dueling=True,
-                          lstm_size=512, compute_dtype="bfloat16"),
+                          lstm_size=512, compute_dtype="bfloat16",
+                          # 120-step unrolls x batch of pixel frames: the
+                          # torso activations dominate learner HBM; trade
+                          # them for recompute (models/recurrent.py).
+                          remat_torso=True),
     replay=ReplayConfig(capacity=100_000, prioritized=True,
                         priority_exponent=0.9, importance_exponent=0.6,
                         burn_in=40, unroll_length=80, sequence_stride=40,

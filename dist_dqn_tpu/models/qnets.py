@@ -178,7 +178,7 @@ def build_network(cfg: NetworkConfig, num_actions: int) -> nn.Module:
             num_actions=num_actions, torso=cfg.torso,
             mlp_features=cfg.mlp_features, hidden=cfg.hidden,
             lstm_size=cfg.lstm_size, dueling=cfg.dueling,
-            compute_dtype=dtype)
+            remat_torso=cfg.remat_torso, compute_dtype=dtype)
     return QNetwork(
         num_actions=num_actions, torso=cfg.torso,
         mlp_features=cfg.mlp_features, hidden=cfg.hidden,

@@ -27,6 +27,39 @@ Array = jnp.ndarray
 LSTMCarry = Tuple[Array, Array]  # (c, h), each [B, lstm_size] float32
 
 
+class _Embed(nn.Module):
+    """Torso + pre-LSTM dense: [N, ...obs] -> [N, E] float32.
+
+    A separate module (not a method) so ``nn.remat`` can wrap it: under
+    rematerialization the unroll's [T*B] conv activations — the dominant
+    learner-memory term for pixel R2D2 — are recomputed in the backward
+    pass instead of living in HBM across the whole sequence loss.
+    """
+
+    torso: str
+    mlp_features: Tuple[int, ...]
+    hidden: int
+    compute_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, obs: Array) -> Array:
+        from dist_dqn_tpu.models.qnets import MLPTorso, NatureCNN
+
+        x = obs
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        if self.torso == "nature":
+            x = NatureCNN(dtype=self.compute_dtype)(x)
+        elif self.torso == "mlp":
+            x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
+        else:
+            raise ValueError(f"unknown torso {self.torso!r}")
+        if self.hidden:
+            x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype,
+                                 name="embed")(x))
+        return x.astype(jnp.float32)
+
+
 class _ResetCell(nn.Module):
     """LSTM cell that zeroes its carry where ``reset`` is set.
 
@@ -64,6 +97,9 @@ class RecurrentQNetwork(nn.Module):
     lstm_size: int = 512
     dueling: bool = True
     compute_dtype: jnp.dtype = jnp.float32
+    # Recompute torso activations in the backward pass (HBM for FLOPs) —
+    # for long-unroll pixel configs where [T*B] conv activations dominate.
+    remat_torso: bool = False
     # Present for API parity with QNetwork (scalar-Q head only).
     num_atoms: int = 1
     noisy: bool = False
@@ -73,22 +109,14 @@ class RecurrentQNetwork(nn.Module):
         return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
 
     def _embed(self, obs: Array) -> Array:
-        """[N, ...obs] -> [N, E] float32 embedding (torso + pre-LSTM dense)."""
-        from dist_dqn_tpu.models.qnets import MLPTorso, NatureCNN
+        """[N, ...obs] -> [N, E] float32 embedding (torso + pre-LSTM dense).
 
-        x = obs
-        if x.dtype == jnp.uint8:
-            x = x.astype(self.compute_dtype) / 255.0
-        if self.torso == "nature":
-            x = NatureCNN(dtype=self.compute_dtype)(x)
-        elif self.torso == "mlp":
-            x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
-        else:
-            raise ValueError(f"unknown torso {self.torso!r}")
-        if self.hidden:
-            x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype,
-                                 name="embed")(x))
-        return x.astype(jnp.float32)
+        The same param names are produced with and without remat (nn.remat
+        is transform-transparent), so checkpoints interchange freely.
+        """
+        cls = nn.remat(_Embed) if self.remat_torso else _Embed
+        return cls(self.torso, self.mlp_features, self.hidden,
+                   self.compute_dtype, name="torso")(obs)
 
     def _q_head(self, h: Array) -> Array:
         """[N, H] -> [N, A] float32 (dueling combine when configured)."""

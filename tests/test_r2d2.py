@@ -296,3 +296,31 @@ def test_r2d2_fused_loop_with_pallas_sampler_runs(monkeypatch):
     carry, metrics = run(carry, 60)
     assert float(metrics["grad_steps_in_chunk"]) > 0
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_remat_torso_same_params_and_grads():
+    """remat is numerics- and checkpoint-transparent: identical param
+    structure, outputs, and gradients with the flag on/off."""
+    obs = jax.random.normal(jax.random.PRNGKey(1), (5, 3, 4))
+    nets = [RecurrentQNetwork(num_actions=3, torso="mlp",
+                              mlp_features=(16,), hidden=8, lstm_size=8,
+                              dueling=True, remat_torso=flag)
+            for flag in (False, True)]
+    carry0 = nets[0].initial_state(3)
+    params = nets[0].init(jax.random.PRNGKey(0), carry0, obs,
+                          method=nets[0].unroll)
+    assert (jax.tree.structure(params)
+            == jax.tree.structure(nets[1].init(jax.random.PRNGKey(0),
+                                               carry0, obs,
+                                               method=nets[1].unroll)))
+
+    def loss(p, net):
+        _, q = net.apply(p, carry0, obs, method=net.unroll)
+        return jnp.sum(q ** 2)
+
+    outs = [jax.value_and_grad(loss)(params, net) for net in nets]
+    np.testing.assert_allclose(float(outs[0][0]), float(outs[1][0]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs[0][1]), jax.tree.leaves(outs[1][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
