@@ -31,10 +31,12 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def build_native_lib(src_name: str, lib_name: str) -> Path:
+def build_native_lib(src_name: str, lib_name: str,
+                     directory: Optional[Path] = None) -> Path:
     """Compile one _native/*.cc into a shared lib on demand (mtime-cached)."""
-    src = _NATIVE_DIR / src_name
-    out = _NATIVE_DIR / lib_name
+    native_dir = directory or _NATIVE_DIR
+    src = native_dir / src_name
+    out = native_dir / lib_name
     if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
         return out
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",

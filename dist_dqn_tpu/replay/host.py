@@ -5,10 +5,16 @@ host holds one replay *shard* in host DRAM, fed by CPU actors over the DCN
 transport (actors/). The learner samples batches here and ships them to the
 device; priorities flow back after each update.
 
-Unlike the sequential CUDA/host sum-trees the reference family uses, every
-operation is vectorized numpy: batched leaf writes propagate level-by-level
-(log2(cap) passes over *unique* parents), and sampling descends all queries
-through the tree in lockstep. No Python-per-item loops anywhere.
+Two interchangeable tree backends implement the priority mass:
+
+  * NativeSumTree — C++ (replay/_native/sumtree.cc), the default for the
+    learner service: delta-propagation writes, per-query descent sampling,
+    periodic exact rebuild. This is the native-runtime equivalent of the
+    reference family's CUDA/host sum-trees (BASELINE.json:5).
+  * SumTree — vectorized numpy fallback (no Python-per-item loops: batched
+    leaf writes propagate level-by-level over *unique* parents; sampling
+    descends all queries in lockstep). Used where the toolchain can't build
+    the native lib, and as the correctness cross-check in tests.
 
 The device-side sampler (replay/prioritized_device.py) is the fused-loop
 equivalent; both implement the same P(i) ~ p_i^alpha contract, tested against
@@ -16,18 +22,121 @@ each other and against brute-force references.
 """
 from __future__ import annotations
 
+import ctypes
+import threading
+from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+_NATIVE_DIR = Path(__file__).parent / "_native"
+_tree_lib = None
+_tree_lib_lock = threading.Lock()
+_fallback_warned = False
+
+
+def _pad_pow2(capacity: int) -> int:
+    padded = 1
+    while padded < capacity:
+        padded *= 2
+    return padded
+# Exact interior-node recompute cadence for the native tree's delta
+# propagation (float64 drift bound; see sumtree.cc). Coarse on purpose:
+# a rebuild is one O(capacity) pass, ~ms at the 1M-slot Ape-X shard.
+_REBUILD_EVERY_WRITES = 1 << 22
+
+
+def _native_tree_lib() -> ctypes.CDLL:
+    """Build (if needed) and load the C++ sum-tree library."""
+    global _tree_lib
+    with _tree_lib_lock:
+        if _tree_lib is None:
+            from dist_dqn_tpu.actors.transport import build_native_lib
+            lib = ctypes.CDLL(str(build_native_lib(
+                "sumtree.cc", "libdqnsumtree.so", directory=_NATIVE_DIR)))
+            lib.dqn_tree_create.restype = ctypes.c_void_p
+            lib.dqn_tree_create.argtypes = [ctypes.c_int64]
+            lib.dqn_tree_destroy.argtypes = [ctypes.c_void_p]
+            lib.dqn_tree_total.restype = ctypes.c_double
+            lib.dqn_tree_total.argtypes = [ctypes.c_void_p]
+            lib.dqn_tree_writes.restype = ctypes.c_uint64
+            lib.dqn_tree_writes.argtypes = [ctypes.c_void_p]
+            lib.dqn_tree_rebuild.argtypes = [ctypes.c_void_p]
+            for name in ("dqn_tree_get", "dqn_tree_set", "dqn_tree_sample"):
+                getattr(lib, name).argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64]
+            _tree_lib = lib
+    return _tree_lib
+
+
+class NativeSumTree:
+    """C++ sum-tree (replay/_native/sumtree.cc) with the SumTree interface.
+
+    Same P(i) contract and tie semantics as the numpy tree below; writes use
+    delta propagation with a periodic exact rebuild (drift bound). Preferred
+    for the learner service's host shard — see PrioritizedHostReplay.
+    """
+
+    def __init__(self, capacity: int):
+        self._lib = _native_tree_lib()
+        self.capacity = _pad_pow2(capacity)  # mirrors dqn_tree_create
+        self._h = self._lib.dqn_tree_create(capacity)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h is not None:
+            self._lib.dqn_tree_destroy(h)
+
+    @property
+    def total(self) -> float:
+        return float(self._lib.dqn_tree_total(self._h))
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        idx = np.ascontiguousarray(idx, np.int64)
+        out = np.empty(idx.shape[0], np.float64)
+        self._lib.dqn_tree_get(self._h, idx.ctypes.data, out.ctypes.data,
+                               idx.shape[0])
+        return out
+
+    def set(self, idx: np.ndarray, values: np.ndarray) -> None:
+        idx = np.ascontiguousarray(idx, np.int64)
+        values = np.ascontiguousarray(
+            np.broadcast_to(values, idx.shape), np.float64)
+        self._lib.dqn_tree_set(self._h, idx.ctypes.data, values.ctypes.data,
+                               idx.shape[0])
+        if self._lib.dqn_tree_writes(self._h) >= _REBUILD_EVERY_WRITES:
+            self._lib.dqn_tree_rebuild(self._h)
+
+    def sample(self, mass: np.ndarray) -> np.ndarray:
+        mass = np.ascontiguousarray(mass, np.float64)
+        out = np.empty(mass.shape[0], np.int64)
+        self._lib.dqn_tree_sample(self._h, mass.ctypes.data, out.ctypes.data,
+                                  mass.shape[0])
+        return out
+
+
+def make_sum_tree(capacity: int, native: Optional[bool] = None):
+    """Pick the tree backend: native C++ if buildable (default), numpy else."""
+    global _fallback_warned
+    if native is None or native:
+        try:
+            return NativeSumTree(capacity)
+        except Exception as e:
+            if native:
+                raise
+            if not _fallback_warned:
+                _fallback_warned = True
+                print(f"# native sum-tree unavailable ({e!r}); "
+                      "using numpy tree")
+    return SumTree(capacity)
 
 
 class SumTree:
     """Flat-array binary sum-tree with vectorized batch set/sample."""
 
     def __init__(self, capacity: int):
-        self.capacity = 1
-        while self.capacity < capacity:
-            self.capacity *= 2
+        self.capacity = _pad_pow2(capacity)
         self.depth = self.capacity.bit_length() - 1
         self.tree = np.zeros(2 * self.capacity, np.float64)
 
@@ -72,11 +181,12 @@ class PrioritizedHostReplay:
     """
 
     def __init__(self, capacity: int, alpha: float = 0.6,
-                 priority_eps: float = 1e-6, seed: int = 0):
+                 priority_eps: float = 1e-6, seed: int = 0,
+                 native: Optional[bool] = None):
         self.capacity = capacity
         self.alpha = alpha
         self.priority_eps = priority_eps
-        self.tree = SumTree(capacity)
+        self.tree = make_sum_tree(capacity, native=native)
         self._data: Optional[Dict[str, np.ndarray]] = None
         self._pos = 0
         self._size = 0

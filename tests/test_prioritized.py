@@ -6,7 +6,8 @@ import numpy as np
 
 from dist_dqn_tpu.replay import device as ring
 from dist_dqn_tpu.replay import prioritized_device as pring
-from dist_dqn_tpu.replay.host import PrioritizedHostReplay, SumTree
+from dist_dqn_tpu.replay.host import (NativeSumTree, PrioritizedHostReplay,
+                                      SumTree, make_sum_tree)
 
 
 # ---------------------------------------------------------------------------
@@ -71,6 +72,48 @@ def test_host_replay_wraparound_overwrites():
     vals = set(np.unique(got["x"]))
     assert 0.0 not in vals and 3.0 not in vals  # overwritten slots gone
     assert 99.0 in vals and 4.0 in vals
+
+
+def test_native_sumtree_matches_numpy():
+    """The C++ tree and the numpy tree are drop-in replacements: identical
+    totals, leaf reads, and descent results (tie semantics included) across
+    random batched writes, overwrites, and samples."""
+    cap = 37  # non-power-of-two: both pad to 64
+    nat, ref = NativeSumTree(cap), SumTree(cap)
+    assert nat.capacity == ref.capacity == 64
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        n = int(rng.integers(1, 48))
+        idx = rng.integers(0, cap, size=n)  # duplicates allowed
+        vals = rng.uniform(0.0, 5.0, size=n)
+        # Duplicate leaf writes in one batch: numpy fancy-assign keeps the
+        # *last* value per index; apply the same contract to both trees.
+        _, last = np.unique(idx[::-1], return_index=True)
+        keep = n - 1 - last
+        nat.set(idx[keep], vals[keep])
+        ref.set(idx[keep], vals[keep])
+        np.testing.assert_allclose(nat.total, ref.total, rtol=1e-12)
+        probe = rng.integers(0, cap, size=16)
+        np.testing.assert_allclose(nat.get(probe), ref.get(probe))
+        mass = rng.uniform(0.0, ref.total, size=256)
+        np.testing.assert_array_equal(nat.sample(mass), ref.sample(mass))
+
+
+def test_native_sumtree_rebuild_is_exact():
+    nat = NativeSumTree(16)
+    rng = np.random.default_rng(11)
+    for _ in range(50):
+        nat.set(rng.integers(0, 16, size=8), rng.uniform(size=8))
+    leaves = nat.get(np.arange(16))
+    nat._lib.dqn_tree_rebuild(nat._h)
+    np.testing.assert_allclose(nat.total, leaves.sum(), rtol=1e-12)
+    assert nat._lib.dqn_tree_writes(nat._h) == 0
+
+
+def test_make_sum_tree_backend_selection():
+    assert isinstance(make_sum_tree(8, native=True), NativeSumTree)
+    assert isinstance(make_sum_tree(8, native=False), SumTree)
+    assert isinstance(PrioritizedHostReplay(8).tree, NativeSumTree)
 
 
 # ---------------------------------------------------------------------------
