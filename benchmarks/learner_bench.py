@@ -1,0 +1,126 @@
+"""Learner grad-steps/sec microbenchmark (north-star metric #2).
+
+BASELINE.json:2 names learner grad-steps/sec alongside env-steps/sec/chip as
+the throughput metrics this framework is judged on. bench.py covers the
+fused actor+learner loop; this script isolates the *learner* train step —
+what the Ape-X service spends its device time on — for each driver config's
+network/batch shape, on whatever backend is active (the real TPU chip under
+axon; pass --platform cpu to compare).
+
+Per config: build the configured Q-net, jit the train step with donated
+state (exactly how both runtimes call it), run a timed chain of steps, and
+fence with a device_get (on the tunnel platform block_until_ready does not
+block; same discipline as bench.py). Prints one JSON line per config.
+
+Usage: python benchmarks/learner_bench.py [--configs atari apex ...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OBS_SHAPE = (84, 84, 4)
+NUM_ACTIONS = 6
+
+
+def _feedforward_case(cfg):
+    """(state, jitted step, args) for the DQN/Rainbow-style learners."""
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.models.qnets import build_network
+    from dist_dqn_tpu.types import Transition
+
+    net = build_network(cfg.network, NUM_ACTIONS)
+    init, train_step = make_learner(net, cfg.learner)
+    rng = jax.random.PRNGKey(0)
+    state = init(rng, jnp.zeros(OBS_SHAPE, jnp.uint8))
+    B = cfg.learner.batch_size
+    r = np.random.default_rng(0)
+    batch = Transition(
+        obs=jnp.asarray(r.integers(0, 255, (B,) + OBS_SHAPE, np.uint8)),
+        action=jnp.asarray(r.integers(0, NUM_ACTIONS, B, np.int32)),
+        reward=jnp.asarray(r.normal(size=B).astype(np.float32)),
+        discount=jnp.full(B, cfg.learner.gamma ** cfg.learner.n_step,
+                          jnp.float32),
+        next_obs=jnp.asarray(r.integers(0, 255, (B,) + OBS_SHAPE, np.uint8)),
+    )
+    weights = jnp.ones(B, jnp.float32)
+    step = jax.jit(train_step, donate_argnums=0)
+    return state, step, (batch, weights)
+
+
+def _r2d2_case(cfg):
+    """(state, jitted step, args) for the recurrent sequence learner."""
+    from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner
+    from dist_dqn_tpu.models.qnets import build_network
+    from dist_dqn_tpu.types import SequenceSample
+
+    net = build_network(cfg.network, NUM_ACTIONS)
+    init, train_step = make_r2d2_learner(net, cfg.learner, cfg.replay)
+    state = init(jax.random.PRNGKey(0), jnp.zeros(OBS_SHAPE, jnp.uint8))
+    S = cfg.learner.batch_size
+    T = cfg.replay.burn_in + cfg.replay.unroll_length + cfg.learner.n_step
+    r = np.random.default_rng(0)
+    sample = SequenceSample(
+        obs=jnp.asarray(r.integers(0, 255, (T, S) + OBS_SHAPE, np.uint8)),
+        action=jnp.asarray(r.integers(0, NUM_ACTIONS, (T, S), np.int32)),
+        reward=jnp.asarray(r.normal(size=(T, S)).astype(np.float32)),
+        done=jnp.zeros((T, S), bool),
+        reset=jnp.zeros((T, S), bool),
+        start_state=net.initial_state(S),
+        weights=jnp.ones(S, jnp.float32),
+        t_idx=jnp.zeros(S, jnp.int32),
+        b_idx=jnp.zeros(S, jnp.int32),
+    )
+    step = jax.jit(train_step, donate_argnums=0)
+    return state, step, (sample,)
+
+
+def bench_config(name: str, iters: int) -> dict:
+    from dist_dqn_tpu.config import CONFIGS
+
+    cfg = CONFIGS[name]
+    if cfg.network.lstm_size:
+        state, step, args = _r2d2_case(cfg)
+    else:
+        state, step, args = _feedforward_case(cfg)
+    state, _ = step(state, *args)  # compile
+    state, _ = step(state, *args)  # one cached-dispatch warmup
+    jax.device_get(state.steps)    # fence before timing
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, *args)
+    jax.device_get(state.steps)    # fence: steps depends on every iteration
+    dt = time.perf_counter() - t0
+    return {
+        "config": name,
+        "grad_steps_per_sec": round(iters / dt, 2),
+        "batch_size": cfg.learner.batch_size,
+        "examples_per_sec": round(iters * cfg.learner.batch_size / dt, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", nargs="*",
+                   default=["atari", "apex", "r2d2", "rainbow"])
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    for name in args.configs:
+        print(json.dumps(bench_config(name, args.iters)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

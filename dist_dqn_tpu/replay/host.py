@@ -92,15 +92,24 @@ class NativeSumTree:
     def total(self) -> float:
         return float(self._lib.dqn_tree_total(self._h))
 
-    def get(self, idx: np.ndarray) -> np.ndarray:
+    def _check_idx(self, idx: np.ndarray) -> np.ndarray:
+        # Preserve the numpy tree's IndexError contract: an out-of-range
+        # index must never reach the C++ side (OOB write = heap corruption).
         idx = np.ascontiguousarray(idx, np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= self.capacity):
+            raise IndexError(f"sum-tree index out of range [0, "
+                             f"{self.capacity}): {idx.min()}..{idx.max()}")
+        return idx
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        idx = self._check_idx(idx)
         out = np.empty(idx.shape[0], np.float64)
         self._lib.dqn_tree_get(self._h, idx.ctypes.data, out.ctypes.data,
                                idx.shape[0])
         return out
 
     def set(self, idx: np.ndarray, values: np.ndarray) -> None:
-        idx = np.ascontiguousarray(idx, np.int64)
+        idx = self._check_idx(idx)
         values = np.ascontiguousarray(
             np.broadcast_to(values, idx.shape), np.float64)
         self._lib.dqn_tree_set(self._h, idx.ctypes.data, values.ctypes.data,
