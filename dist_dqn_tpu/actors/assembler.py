@@ -105,7 +105,7 @@ class NStepAssembler:
 
 class _SeqLane:
     __slots__ = ("obs", "action", "reward", "done", "opens", "carry_c",
-                 "carry_h", "count")
+                 "carry_h", "q_sel", "q_max", "count")
 
     def __init__(self):
         self.obs: Deque[np.ndarray] = deque()
@@ -115,6 +115,8 @@ class _SeqLane:
         self.opens: Deque[bool] = deque()   # step's obs opened a new episode
         self.carry_c: Deque[np.ndarray] = deque()
         self.carry_h: Deque[np.ndarray] = deque()
+        self.q_sel: Deque[float] = deque()  # Q(obs, taken action), f32
+        self.q_max: Deque[float] = deque()  # max_a Q(obs, a), f32
         self.count = 0                      # total steps ever appended
 
 
@@ -147,12 +149,19 @@ class SequenceAssembler:
 
     def step(self, obs: np.ndarray, action: np.ndarray, reward: np.ndarray,
              terminated: np.ndarray, truncated: np.ndarray,
-             carry_c: np.ndarray, carry_h: np.ndarray) -> None:
+             carry_c: np.ndarray, carry_h: np.ndarray,
+             q_sel: Optional[np.ndarray] = None,
+             q_max: Optional[np.ndarray] = None) -> None:
         """Feed one completed env step for every lane.
 
         ``carry_c``/``carry_h`` are [lanes, lstm] — the recurrent state the
-        server used to act on ``obs`` (pre-step carry).
+        server used to act on ``obs`` (pre-step carry). ``q_sel``/``q_max``
+        [lanes] are the inference-time Q of the taken action and the greedy
+        value; when provided, emitted sequences carry per-step q planes so
+        the service can seed insertion priorities with real TD magnitudes
+        (initial_sequence_priorities) instead of the running max.
         """
+        with_q = q_sel is not None
         for i, lane in enumerate(self.lanes):
             done = bool(terminated[i]) or bool(truncated[i])
             lane.obs.append(obs[i])
@@ -162,6 +171,9 @@ class SequenceAssembler:
             lane.opens.append(self._prev_done[i])
             lane.carry_c.append(carry_c[i])
             lane.carry_h.append(carry_h[i])
+            if with_q:
+                lane.q_sel.append(float(q_sel[i]))
+                lane.q_max.append(float(q_max[i]))
             self._prev_done[i] = done
             lane.count += 1
             # Same seeding rule as the device ring: the window whose last
@@ -169,15 +181,17 @@ class SequenceAssembler:
             # that start is stride-aligned.
             if len(lane.obs) == self.L:
                 if (lane.count - self.L) % self.stride == 0:
-                    self._emit(lane)
+                    self._emit(lane, with_q)
                 for q in (lane.obs, lane.action, lane.reward, lane.done,
-                          lane.opens, lane.carry_c, lane.carry_h):
-                    q.popleft()
+                          lane.opens, lane.carry_c, lane.carry_h,
+                          lane.q_sel, lane.q_max):
+                    if q:
+                        q.popleft()
 
-    def _emit(self, lane: _SeqLane) -> None:
+    def _emit(self, lane: _SeqLane, with_q: bool) -> None:
         reset = np.asarray(lane.opens, bool)
         reset[0] = False  # start state is already episode-correct
-        self._out.append({
+        seq = {
             "obs": np.stack(lane.obs),
             "action": np.asarray(lane.action, np.int32),
             "reward": np.asarray(lane.reward, np.float32),
@@ -185,7 +199,11 @@ class SequenceAssembler:
             "reset": reset,
             "state_c": np.asarray(lane.carry_c[0], np.float32),
             "state_h": np.asarray(lane.carry_h[0], np.float32),
-        })
+        }
+        if with_q:
+            seq["q_sel"] = np.asarray(lane.q_sel, np.float32)
+            seq["q_max"] = np.asarray(lane.q_max, np.float32)
+        self._out.append(seq)
 
     def drain(self) -> Optional[Dict[str, np.ndarray]]:
         """Collect emitted sequences as stacked [S, L, ...] arrays."""
@@ -195,6 +213,45 @@ class SequenceAssembler:
                for k in self._out[0]}
         self._out = []
         return out
+
+
+def _h(x: np.ndarray, eps: float = 1e-3) -> np.ndarray:
+    """R2D2 value rescale (numpy twin of ops/losses.value_rescale)."""
+    return np.sign(x) * (np.sqrt(np.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def _h_inv(x: np.ndarray, eps: float = 1e-3) -> np.ndarray:
+    inner = np.sqrt(1.0 + 4.0 * eps * (np.abs(x) + 1.0 + eps))
+    return np.sign(x) * (np.square((inner - 1.0) / (2.0 * eps)) - 1.0)
+
+
+def initial_sequence_priorities(seqs: Dict[str, np.ndarray], burn_in: int,
+                                unroll: int, gamma: float, eta: float,
+                                value_rescale: bool) -> np.ndarray:
+    """Actor-side R2D2 insertion priorities from inference-time Q-values.
+
+    The R2D2 seeding rule: priorities of a fresh sequence come from the TD
+    errors the acting network itself saw, not from the running max. Using
+    the per-step (q_sel, q_max) planes the SequenceAssembler recorded, the
+    1-step TD proxy over the loss region [burn_in, burn_in + unroll) is
+
+        td_t = q_sel_t - H( r_t + gamma * (1 - done_t) * H^-1(q_max_{t+1}) )
+
+    (H = identity unless ``value_rescale``), mixed with the R2D2 eta rule
+    p = eta * max|td| + (1 - eta) * mean|td|. Pure numpy — the Q planes rode
+    along with inference, so seeding costs no extra device passes.
+    """
+    q_sel, q_max = seqs["q_sel"], seqs["q_max"]      # [S, L]
+    r = seqs["reward"][:, burn_in:burn_in + unroll]  # [S, U]
+    done = seqs["done"][:, burn_in:burn_in + unroll].astype(np.float32)
+    boot = q_max[:, burn_in + 1:burn_in + unroll + 1]
+    if value_rescale:
+        boot = _h_inv(boot)
+    target = r + gamma * (1.0 - done) * boot
+    if value_rescale:
+        target = _h(target)
+    td = np.abs(q_sel[:, burn_in:burn_in + unroll] - target)
+    return eta * td.max(axis=1) + (1.0 - eta) * td.mean(axis=1)
 
 
 # ---------------------------------------------------------------------------

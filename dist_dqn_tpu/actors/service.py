@@ -154,7 +154,8 @@ class ApexLearnerService:
             init, train_step = make_r2d2_learner(net, cfg.learner,
                                                  cfg.replay,
                                                  axis_name=axis)
-            self._act = jax.jit(make_recurrent_actor_step(net))
+            self._act = jax.jit(make_recurrent_actor_step(net,
+                                                          return_q=True))
             self.seq_len = (cfg.replay.burn_in + cfg.replay.unroll_length
                             + cfg.learner.n_step)
             stride = cfg.replay.sequence_stride or cfg.replay.unroll_length
@@ -164,6 +165,7 @@ class ApexLearnerService:
             ]
             self._carry: List = [None] * self.total_actors
             self._prev_carry: List = [None] * self.total_actors
+            self._prev_q: List = [None] * self.total_actors
             self._prio_fn = None
         else:
             init, train_step = make_learner(net, cfg.learner,
@@ -372,10 +374,12 @@ class ApexLearnerService:
             # The assembler stores the carry ENTERING this step.
             self._prev_carry[actor] = (np.asarray(carry[0], np.float32),
                                        np.asarray(carry[1], np.float32))
-            carry, actions = self._act(
+            carry, actions, q_sel, q_max = self._act(
                 self.state.params, carry, self.jnp.asarray(obs), k,
                 self.jnp.float32(self.actor_eps[actor]))
             self._carry[actor] = carry
+            self._prev_q[actor] = (np.asarray(q_sel, np.float32),
+                                   np.asarray(q_max, np.float32))
         else:
             actions = self._act(self.state.params, self.jnp.asarray(obs), k,
                                 self.jnp.float32(self.actor_eps[actor]))
@@ -424,7 +428,7 @@ class ApexLearnerService:
             self.assemblers[actor].step(
                 self._prev_obs[actor], self._prev_actions[actor],
                 arrays["reward"], terminated, truncated,
-                *self._prev_carry[actor])
+                *self._prev_carry[actor], *self._prev_q[actor])
             # Zero the carry for lanes whose episode just ended, BEFORE the
             # next act (the incoming obs rows are post-reset there).
             done = np.logical_or(terminated, truncated)
@@ -440,11 +444,19 @@ class ApexLearnerService:
         emitted = self.assemblers[actor].drain()
         if emitted is not None:
             if self.recurrent:
-                # Fresh sequences enter at the shard's running max priority
-                # (replay.add's default seeding; the feed-forward path
-                # computes real initial TDs on device instead — a full
-                # burn-in unroll per insert is not worth it here).
-                self.replay.add(emitted)
+                # Seed with the R2D2 actor-side rule: TD magnitudes from
+                # the inference-time Q planes the assembler recorded (no
+                # extra device passes, unlike a burn-in unroll per insert).
+                from dist_dqn_tpu.actors.assembler import \
+                    initial_sequence_priorities
+                prios = initial_sequence_priorities(
+                    emitted, self.cfg.replay.burn_in,
+                    self.cfg.replay.unroll_length, self.cfg.learner.gamma,
+                    self.cfg.replay.priority_mix,
+                    self.cfg.learner.value_rescale)
+                emitted.pop("q_sel")
+                emitted.pop("q_max")
+                self.replay.add(emitted, priorities=prios)
             else:
                 self._pending.append(emitted)
                 self._pending_count += emitted["action"].shape[0]
@@ -565,8 +577,8 @@ class ApexLearnerService:
         for _ in range(10_000):
             self._rng, k = self.jax.random.split(self._rng)
             if self.recurrent:
-                carry, actions = self._act(self.state.params, carry,
-                                           jnp.asarray(obs), k, eps)
+                carry, actions, _, _ = self._act(self.state.params, carry,
+                                                 jnp.asarray(obs), k, eps)
             else:
                 actions = self._act(self.state.params, jnp.asarray(obs), k,
                                     eps)

@@ -160,12 +160,18 @@ def make_r2d2_learner(net, cfg: LearnerConfig, rcfg: ReplayConfig,
     return init, train_step
 
 
-def make_recurrent_actor_step(net):
+def make_recurrent_actor_step(net, return_q: bool = False):
     """Epsilon-greedy acting for the recurrent net, carry threaded by caller.
 
     act(params, carry, obs, rng, epsilon) -> (new_carry, actions [B]).
     The caller zeroes the carry on episode ends before the next call (the
     fused loop does this right after env.step), so no reset flags here.
+
+    With ``return_q`` the step also yields (q_sel, q_max) [B] float32 — the
+    Q-value of the action actually taken and the greedy value. The Ape-X
+    service records these per step so freshly assembled sequences enter
+    replay with real inference-time TD priorities (the R2D2 actor-side
+    seeding rule) instead of the running max, at zero extra device passes.
     """
 
     def act(params: PyTree, carry, obs: Array, rng: Array, epsilon: Array):
@@ -175,6 +181,12 @@ def make_recurrent_actor_step(net):
         random_a = jax.random.randint(k_rand, greedy.shape, 0,
                                       net.num_actions)
         explore = jax.random.uniform(k_eps, greedy.shape) < epsilon
-        return carry, jnp.where(explore, random_a, greedy)
+        actions = jnp.where(explore, random_a, greedy)
+        if not return_q:
+            return carry, actions
+        q32 = q.astype(jnp.float32)
+        q_sel = jnp.take_along_axis(q32, actions[:, None].astype(jnp.int32),
+                                    axis=-1)[:, 0]
+        return carry, actions, q_sel, jnp.max(q32, axis=-1)
 
     return act

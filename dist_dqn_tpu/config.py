@@ -38,6 +38,11 @@ class ReplayConfig:
     priority_eps: float = 1e-6
     min_fill: int = 1_000              # learning starts after this many items
     pallas_sampler: bool = False       # Pallas kernel for priority sampling
+    # Store the pre-reset successor obs alongside each step so n-step windows
+    # bootstrap exactly through time-limit truncation. None = auto: on for
+    # cheap (non-uint8) observations, off for pixel rings, where the second
+    # obs copy would double HBM and truncation is treated as terminal.
+    store_final_obs: "bool | None" = None
     # R2D2 sequence replay (>0 enables sequence mode):
     burn_in: int = 0
     unroll_length: int = 0

@@ -40,6 +40,17 @@ def _pad_pow2(capacity: int) -> int:
     while padded < capacity:
         padded *= 2
     return padded
+
+
+def _check_tree_idx(idx: np.ndarray, capacity: int) -> np.ndarray:
+    """Shared leaf-index validation for both tree backends: negative numpy
+    indices would silently wrap onto interior nodes (numpy tree) or write
+    out of bounds (C++ tree), so both must raise instead."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= capacity):
+        raise IndexError(f"sum-tree index out of range [0, {capacity}): "
+                         f"{idx.min()}..{idx.max()}")
+    return idx
 # Exact interior-node recompute cadence for the native tree's delta
 # propagation (float64 drift bound; see sumtree.cc). Coarse on purpose:
 # a rebuild is one O(capacity) pass, ~ms at the 1M-slot Ape-X shard.
@@ -92,24 +103,15 @@ class NativeSumTree:
     def total(self) -> float:
         return float(self._lib.dqn_tree_total(self._h))
 
-    def _check_idx(self, idx: np.ndarray) -> np.ndarray:
-        # Preserve the numpy tree's IndexError contract: an out-of-range
-        # index must never reach the C++ side (OOB write = heap corruption).
-        idx = np.ascontiguousarray(idx, np.int64)
-        if idx.size and (idx.min() < 0 or idx.max() >= self.capacity):
-            raise IndexError(f"sum-tree index out of range [0, "
-                             f"{self.capacity}): {idx.min()}..{idx.max()}")
-        return idx
-
     def get(self, idx: np.ndarray) -> np.ndarray:
-        idx = self._check_idx(idx)
+        idx = _check_tree_idx(idx, self.capacity)
         out = np.empty(idx.shape[0], np.float64)
         self._lib.dqn_tree_get(self._h, idx.ctypes.data, out.ctypes.data,
                                idx.shape[0])
         return out
 
     def set(self, idx: np.ndarray, values: np.ndarray) -> None:
-        idx = self._check_idx(idx)
+        idx = _check_tree_idx(idx, self.capacity)
         values = np.ascontiguousarray(
             np.broadcast_to(values, idx.shape), np.float64)
         self._lib.dqn_tree_set(self._h, idx.ctypes.data, values.ctypes.data,
@@ -154,11 +156,11 @@ class SumTree:
         return float(self.tree[1])
 
     def get(self, idx: np.ndarray) -> np.ndarray:
-        return self.tree[np.asarray(idx) + self.capacity]
+        return self.tree[_check_tree_idx(idx, self.capacity) + self.capacity]
 
     def set(self, idx: np.ndarray, values: np.ndarray) -> None:
         """Vectorized leaf write + upward propagation."""
-        leaf = np.asarray(idx, np.int64) + self.capacity
+        leaf = _check_tree_idx(idx, self.capacity) + self.capacity
         self.tree[leaf] = values
         pos = np.unique(leaf >> 1)
         while pos[0] >= 1:

@@ -67,7 +67,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                     cfg.learner.n_step + 2)
     # Exact truncation bootstrap for cheap (non-pixel) observations; pixel
     # rings skip final_obs to halve HBM use (truncation treated as terminal).
-    store_final = env.observation_dtype != jnp.uint8
+    # cfg.replay.store_final_obs overrides the heuristic either way.
+    store_final = (env.observation_dtype != jnp.uint8
+                   if cfg.replay.store_final_obs is None
+                   else cfg.replay.store_final_obs)
 
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
     _split_rng = loop_common.make_rng_splitter(spmd)

@@ -54,6 +54,52 @@ def test_sequence_assembler_reset_flags_cross_episode():
                                                    False])
 
 
+def test_sequence_assembler_q_planes_and_initial_priorities():
+    from dist_dqn_tpu.actors.assembler import initial_sequence_priorities
+
+    asm = SequenceAssembler(1, seq_len=4, stride=4)
+    rng = np.random.default_rng(5)
+    q_sel_all, q_max_all = rng.normal(size=8), rng.normal(size=8)
+    q_max_all = np.maximum(q_max_all, q_sel_all)
+    for t in range(8):
+        asm.step(np.full((1, 2), float(t)), np.zeros((1,), np.int32),
+                 np.full((1,), float(t)),
+                 np.full((1,), t == 5), np.zeros((1,), bool),
+                 np.zeros((1, 4)), np.zeros((1, 4)),
+                 q_sel_all[t:t + 1], q_max_all[t:t + 1])
+    out = asm.drain()
+    assert out["q_sel"].shape == (2, 4)
+    np.testing.assert_allclose(out["q_sel"][0], q_sel_all[:4])
+    np.testing.assert_allclose(out["q_max"][1], q_max_all[4:])
+
+    # Hand-checked 1-step TD proxy: burn=1, unroll=2, gamma=0.9, eta=0.9.
+    burn, unroll, gamma, eta = 1, 2, 0.9, 0.9
+    p = initial_sequence_priorities(out, burn, unroll, gamma, eta,
+                                    value_rescale=False)
+    for s, base in enumerate((0, 4)):
+        tds = []
+        for t in range(burn, burn + unroll):
+            done = float(base + t == 5)
+            target = (base + t) + gamma * (1.0 - done) * q_max_all[
+                base + t + 1]
+            tds.append(abs(q_sel_all[base + t] - target))
+        want = eta * max(tds) + (1 - eta) * np.mean(tds)
+        np.testing.assert_allclose(p[s], want, rtol=1e-6)
+
+
+def test_initial_sequence_priorities_value_rescale_consistent():
+    """With value_rescale, the numpy H/H^-1 twins must match ops/losses."""
+    import jax.numpy as jnp
+
+    from dist_dqn_tpu.actors.assembler import _h, _h_inv
+    from dist_dqn_tpu.ops import losses
+
+    x = np.linspace(-40.0, 40.0, 41)
+    np.testing.assert_allclose(_h(x), np.asarray(losses.value_rescale(
+        jnp.asarray(x))), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_h_inv(_h(x)), x, rtol=1e-4, atol=1e-4)
+
+
 def test_sequence_assembler_multilane_independent():
     asm = SequenceAssembler(2, seq_len=3, stride=1)
     for t in range(5):

@@ -35,3 +35,28 @@ def test_atari_config_fused_smoke():
     assert ring.final_obs is None
     assert ring.obs.shape[2:] == (84, 84, 4)
     assert ring.obs.dtype.name == "uint8"
+
+
+def test_store_final_obs_override_enables_exact_truncation_path():
+    """replay.store_final_obs=True forces the exact truncation bootstrap on a
+    pixel ring (the auto heuristic would skip it for uint8 obs)."""
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, hidden=64,
+                                    compute_dtype="float32"),
+        actor=dataclasses.replace(cfg.actor, num_envs=2),
+        replay=dataclasses.replace(cfg.replay, capacity=64, min_fill=16,
+                                   store_final_obs=True),
+        learner=dataclasses.replace(cfg.learner, batch_size=4),
+        train_every=4,
+    )
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run_chunk = make_fused_train(cfg, env, net)
+    carry = init(jax.random.PRNGKey(0))
+    assert carry.replay.final_obs is not None
+    assert carry.replay.final_obs.dtype.name == "uint8"
+    carry, metrics = jax.jit(run_chunk, static_argnums=1,
+                             donate_argnums=0)(carry, 24)
+    assert abs(float(metrics["loss"])) < 1e3

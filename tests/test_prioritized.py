@@ -110,16 +110,16 @@ def test_native_sumtree_rebuild_is_exact():
     assert nat._lib.dqn_tree_writes(nat._h) == 0
 
 
-def test_native_sumtree_rejects_out_of_range_indices():
-    nat = NativeSumTree(16)
-    for bad in (np.array([16]), np.array([-1]), np.array([3, 99])):
-        for op in (lambda: nat.set(bad, np.ones(bad.shape[0])),
-                   lambda: nat.get(bad)):
-            try:
-                op()
-                assert False, f"expected IndexError for idx={bad}"
-            except IndexError:
-                pass
+def test_sumtrees_reject_out_of_range_indices():
+    for tree in (NativeSumTree(16), SumTree(16)):
+        for bad in (np.array([16]), np.array([-1]), np.array([3, 99])):
+            for op in (lambda: tree.set(bad, np.ones(bad.shape[0])),
+                       lambda: tree.get(bad)):
+                try:
+                    op()
+                    assert False, f"expected IndexError for idx={bad}"
+                except IndexError:
+                    pass
 
 
 def test_make_sum_tree_backend_selection():
