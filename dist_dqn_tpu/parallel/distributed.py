@@ -1,0 +1,85 @@
+"""Multi-host runtime: ``jax.distributed`` over DCN.
+
+The reference family scales learners across hosts with NCCL/MPI process
+groups (BASELINE.json:5). The TPU-native equivalent is JAX's multi-process
+runtime: every host runs the SAME program, ``jax.distributed.initialize``
+wires the processes into one coordination service, and ``jax.devices()``
+becomes the *global* accelerator list — so the existing mesh trainers
+(parallel/learner.py) scale from multi-chip to multi-host without touching
+the compiled program: gradient ``pmean``s ride ICI within a host slice and
+DCN across hosts, exactly where XLA places them.
+
+What this module adds around ``jax.distributed``:
+
+  * platform-aware initialization (on CPU it selects the gloo collectives
+    implementation so the same code paths are testable without a pod —
+    SURVEY.md §4's portable-idiom rule);
+  * main-process gating helpers for logging/checkpointing (every process
+    computes, one reports);
+  * ``host_replica`` — fetch a replicated global pytree as host numpy so
+    per-process code (greedy eval, checkpoint writes) can use it without
+    entering a global program.
+
+Single-process runs never need this module; nothing here imports at
+train-CLI startup unless ``--coordinator`` is passed.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int,
+               local_device_ids: Optional[list] = None) -> None:
+    """Join this process into the multi-host runtime.
+
+    Must run before the first JAX backend touch (any jnp op / jax.devices).
+    ``coordinator_address`` is ``host:port`` of process 0 — reachable over
+    DCN from every host. On the CPU platform the gloo cross-process
+    collectives implementation is selected automatically (the pure-Python
+    default cannot allreduce across processes).
+    """
+    # Cross-process collectives on the CPU platform need the gloo
+    # implementation (the default cannot allreduce between processes).
+    # Selected unconditionally: the setting only affects the CPU client,
+    # so TPU/accelerator runs are untouched — and a CPU-only host that
+    # never set jax_platforms still gets working collectives.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def is_main_process() -> bool:
+    """True on the process that should log/checkpoint (process 0)."""
+    return jax.process_index() == 0
+
+
+def main_process_log(log_fn):
+    """Wrap ``log_fn`` so only process 0 emits (others compute silently)."""
+    if is_main_process():
+        return log_fn
+    return lambda *a, **k: None
+
+
+def host_replica(tree):
+    """Replicated global pytree -> host numpy copy (any process).
+
+    Replicated arrays are addressable on every process, so this never
+    triggers cross-host transfers; use it to hand params to process-local
+    programs (greedy eval) or checkpoint writes.
+    """
+    return jax.tree.map(np.asarray, jax.device_get(tree))
+
+
+def shutdown() -> None:
+    """Leave the multi-host runtime (idempotent)."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass

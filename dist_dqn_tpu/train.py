@@ -24,7 +24,7 @@ from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
 def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
           checkpoint_dir: str = None, save_every_frames: int = 0,
-          profile_dir: str = None):
+          profile_dir: str = None, num_devices: int = 1):
     """Run training; returns (final_carry, history list of metric dicts).
 
     With ``checkpoint_dir`` set, the learner state is checkpointed every
@@ -33,27 +33,71 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     and refill, per the failure model in SURVEY.md §5. With ``profile_dir``
     set, the second chunk (first post-compile) is captured as a
     ``jax.profiler`` trace for TensorBoard/xprof (SURVEY.md §5).
+
+    ``num_devices != 1`` selects the mesh trainers (parallel/learner.py):
+    env lanes + the replay shard spread over a ``dp`` mesh of that many
+    devices (0 = every device) and gradients pmean over the mesh. Under a
+    ``jax.distributed`` runtime (parallel/distributed.py) the device list —
+    and therefore the mesh — is global, so the same call scales over
+    multiple hosts: each process runs this function, process 0 logs, and
+    checkpoint/eval work from the replicated learner copy.
     """
+    multiprocess = jax.process_count() > 1
+    if multiprocess:
+        from dist_dqn_tpu.parallel.distributed import main_process_log
+        log_fn = main_process_log(log_fn)
     seed = cfg.seed if seed is None else seed
     total = total_env_steps or cfg.total_env_steps
     env = make_jax_env(cfg.env_name)
     net = build_network(cfg.network, env.num_actions)
 
+    use_mesh = num_devices != 1 or multiprocess
+    mesh = None
+    if use_mesh:
+        from dist_dqn_tpu.parallel import (make_mesh, make_mesh_fused_train,
+                                           make_mesh_r2d2_train)
+        if multiprocess:
+            # The mesh must span the GLOBAL device list — a prefix slice
+            # would leave other processes without addressable shards.
+            devs = jax.devices()
+            if num_devices not in (0, 1, len(devs)):
+                raise ValueError(
+                    f"multi-process runs use all {len(devs)} global "
+                    f"devices; --mesh-devices {num_devices} is not "
+                    "meaningful (pass 0)")
+        elif num_devices in (0, None):
+            devs = jax.devices()
+        else:
+            devs = jax.devices()[:num_devices]
+            if len(devs) < num_devices:
+                raise ValueError(f"--mesh-devices {num_devices} requested "
+                                 f"but only {len(devs)} available")
+        mesh = make_mesh(devices=devs)
     if cfg.network.lstm_size:
         from dist_dqn_tpu.r2d2_loop import make_r2d2_evaluator, \
             make_r2d2_train
-        init, run_chunk = make_r2d2_train(cfg, env, net)
+        if use_mesh:
+            init, run = make_mesh_r2d2_train(cfg, env, net, mesh)
+        else:
+            init, run_chunk = make_r2d2_train(cfg, env, net)
         evaluate = jax.jit(make_r2d2_evaluator(
             cfg, env, net, num_episodes=cfg.eval_episodes))
     else:
-        init, run_chunk = make_fused_train(cfg, env, net)
+        if use_mesh:
+            init, run = make_mesh_fused_train(cfg, env, net, mesh)
+        else:
+            init, run_chunk = make_fused_train(cfg, env, net)
         evaluate = jax.jit(make_evaluator(cfg, env, net,
                                           num_episodes=cfg.eval_episodes))
-    run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
+    if not use_mesh:
+        run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
 
     rng = jax.random.PRNGKey(seed)
     rng, k_init = jax.random.split(rng)
-    carry = init(k_init)
+    # Multi-process: jit inputs must not be process-local committed arrays;
+    # plain numpy keys are treated as replicated (identical on every
+    # process by construction — same seed).
+    carry = init(np.asarray(k_init))
 
     ckpt = None
     frame_offset = 0
@@ -69,6 +113,11 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             # finishes the remaining frames (and later saves land at
             # monotonically increasing orbax steps).
             frame_offset, learner = restored
+            # Mesh path: the restore is templated on the live learner's
+            # shardings (utils/checkpoint.py), so global replicated arrays
+            # come back as such. Multi-process runs call save/restore on
+            # every process (orbax collective IO) against a SHARED
+            # checkpoint directory.
             carry = carry._replace(learner=learner)
             log_fn(json.dumps({"resumed_at_frames": frame_offset}))
 
@@ -102,7 +151,17 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
         }
         if frames >= next_eval:
             rng, k_eval = jax.random.split(rng)
-            row["eval_return"] = float(evaluate(carry.learner.params, k_eval))
+            if not multiprocess:
+                row["eval_return"] = float(evaluate(carry.learner.params,
+                                                    k_eval))
+            elif jax.process_index() == 0:
+                # The eval program is process-local: only the logging
+                # process runs it, on the host copy of the replicated
+                # params (other processes still consumed k_eval above, so
+                # rng streams stay in lockstep).
+                from dist_dqn_tpu.parallel.distributed import host_replica
+                row["eval_return"] = float(
+                    evaluate(host_replica(carry.learner.params), k_eval))
             next_eval = frames + cfg.eval_every_steps
         history.append(row)
         log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
@@ -139,6 +198,20 @@ def main():
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
+    parser.add_argument("--mesh-devices", type=int, default=1,
+                        help="fused runtime: run over a dp mesh of this "
+                             "many devices (0 = all; multi-process runs "
+                             "use the GLOBAL device list). Replay shards "
+                             "per device, gradients pmean over the mesh")
+    parser.add_argument("--coordinator", default=None,
+                        help="multi-host: host:port of process 0's "
+                             "jax.distributed coordinator. Every host runs "
+                             "this same command with its own --process-id; "
+                             "checkpoints need a shared directory")
+    parser.add_argument("--num-processes", type=int, default=1,
+                        help="multi-host: total process count")
+    parser.add_argument("--process-id", type=int, default=0,
+                        help="multi-host: this process's id (0-based)")
     parser.add_argument("--runtime", choices=("fused", "apex"),
                         default="fused",
                         help="fused: on-device Anakin loop (JAX envs); "
@@ -169,6 +242,11 @@ def main():
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.coordinator:
+        # Must precede the first backend touch; platform choice above feeds
+        # the CPU-collectives selection (parallel/distributed.py).
+        from dist_dqn_tpu.parallel.distributed import initialize
+        initialize(args.coordinator, args.num_processes, args.process_id)
     cfg = CONFIGS[args.config]
     if args.eval_every_steps:
         import dataclasses as _dc
@@ -204,7 +282,7 @@ def main():
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
           chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
           save_every_frames=args.save_every_frames,
-          profile_dir=args.profile_dir)
+          profile_dir=args.profile_dir, num_devices=args.mesh_devices)
 
 
 if __name__ == "__main__":
