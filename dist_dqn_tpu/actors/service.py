@@ -79,6 +79,10 @@ class ApexRuntimeConfig:
     # sequence assembler is Python. Falls back with a log line if the
     # native build is unavailable.
     native_assembly: bool = True
+    # Host-loop tracing (utils/trace.py): write a Chrome trace-event file
+    # here covering ingestion / priority / sample / train spans — the host
+    # counterpart of the device xprof trace. None disables (no overhead).
+    trace_path: Optional[str] = None
 
 
 class ApexLearnerService:
@@ -236,6 +240,8 @@ class ApexLearnerService:
         self._next_eval = rt.eval_every_steps or float("inf")
         self.bad_records = 0
         self.actor_restarts = 0
+        from dist_dqn_tpu.utils.trace import make_tracer
+        self.tracer = make_tracer(rt.trace_path, process_name="apex-learner")
 
     def _shard_train_step(self, train_step, axis: str):
         """Lift the per-device train step onto the local learner mesh:
@@ -471,8 +477,12 @@ class ApexLearnerService:
         cat = {k: np.concatenate([p[k] for p in self._pending])
                for k in self._pending[0]}
         self._pending, self._pending_count = [], 0
-        jnp = self.jnp
         n = cat["action"].shape[0]
+        with self.tracer.span("priority.bootstrap", count=n):
+            self._bootstrap_and_insert(cat, n)
+
+    def _bootstrap_and_insert(self, cat, n: int):
+        jnp = self.jnp
         for lo in range(0, n, _PRIO_CHUNK):
             hi = min(lo + _PRIO_CHUNK, n)
             pad = _PRIO_CHUNK - (hi - lo)
@@ -538,24 +548,28 @@ class ApexLearnerService:
             beta = min(1.0, cfg.replay.importance_exponent
                        + (1 - cfg.replay.importance_exponent)
                        * self.env_steps / max(self.rt.total_env_steps, 1))
-            items, idx, weights = self.replay.sample(cfg.learner.batch_size,
-                                                     beta)
-            if self.recurrent:
-                sample = self._sequence_sample(items, weights)
-                self.state, metrics = self._train_step(self.state, sample)
+            with self.tracer.span("replay.sample",
+                                  batch=cfg.learner.batch_size):
+                items, idx, weights = self.replay.sample(
+                    cfg.learner.batch_size, beta)
+            with self.tracer.span("train_step"):
+                if self.recurrent:
+                    sample = self._sequence_sample(items, weights)
+                    self.state, metrics = self._train_step(self.state,
+                                                           sample)
+                else:
+                    from dist_dqn_tpu.types import Transition
+                    batch = Transition(
+                        obs=jnp.asarray(items["obs"]),
+                        action=jnp.asarray(items["action"]),
+                        reward=jnp.asarray(items["reward"]),
+                        discount=jnp.asarray(items["discount"]),
+                        next_obs=jnp.asarray(items["next_obs"]))
+                    self.state, metrics = self._train_step(
+                        self.state, batch, jnp.asarray(weights))
                 prios = np.asarray(metrics["priorities"])
-            else:
-                from dist_dqn_tpu.types import Transition
-                batch = Transition(
-                    obs=jnp.asarray(items["obs"]),
-                    action=jnp.asarray(items["action"]),
-                    reward=jnp.asarray(items["reward"]),
-                    discount=jnp.asarray(items["discount"]),
-                    next_obs=jnp.asarray(items["next_obs"]))
-                self.state, metrics = self._train_step(self.state, batch,
-                                                       jnp.asarray(weights))
-                prios = np.asarray(metrics["priorities"])
-            self.replay.update_priorities(idx, prios)
+            with self.tracer.span("replay.update_priorities"):
+                self.replay.update_priorities(idx, prios)
             self.grad_steps += 1
             self._last_loss = float(metrics["loss"])
 
@@ -609,7 +623,8 @@ class ApexLearnerService:
                     if rec is None:
                         break
                     drained = True
-                    self._handle_record(rec)
+                    with self.tracer.span("ingest.shm_record"):
+                        self._handle_record(rec)
                 if self.tcp_server is not None:
                     for _ in range(256):
                         rec = self.tcp_server.pop()
@@ -618,7 +633,9 @@ class ApexLearnerService:
                         drained = True
                         conn_id, payload = rec
                         try:
-                            self._handle_record(payload, conn_id=conn_id)
+                            with self.tracer.span("ingest.tcp_record"):
+                                self._handle_record(payload,
+                                                    conn_id=conn_id)
                         except Exception as e:
                             # Network input is untrusted (the listener may
                             # face other hosts): a malformed or misrouted
@@ -638,8 +655,10 @@ class ApexLearnerService:
                 if self.env_steps >= self._next_eval:
                     self._next_eval = self.env_steps \
                         + self.rt.eval_every_steps
+                    with self.tracer.span("eval"):
+                        eval_return = self._evaluate()
                     self.log.record(env_steps=self.env_steps,
-                                    eval_return=self._evaluate())
+                                    eval_return=eval_return)
                     self.log.flush()
                     last_log = time.perf_counter()
                 if not drained:
@@ -647,6 +666,9 @@ class ApexLearnerService:
                 now = time.perf_counter()
                 if now - last_log > self.rt.log_every_s:
                     self.supervise_actors()
+                    self.tracer.counter("replay_size", len(self.replay))
+                    self.tracer.counter("env_steps", self.env_steps)
+                    self.tracer.flush()
                     self.log.record(env_steps=self.env_steps,
                                     grad_steps=self.grad_steps,
                                     replay_size=float(len(self.replay)),
@@ -662,6 +684,7 @@ class ApexLearnerService:
                 self._ckpt.save(self.env_steps, self.state)
                 self._ckpt.close()
         finally:
+            self.tracer.close()
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
                 "replay_size": len(self.replay),

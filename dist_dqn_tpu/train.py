@@ -195,6 +195,10 @@ def main():
                         help="capture a jax.profiler trace of the first "
                              "post-warmup chunk into this directory "
                              "(view with TensorBoard / xprof)")
+    parser.add_argument("--trace-path", default=None,
+                        help="apex runtime: write a Chrome trace-event "
+                             "file of the host loop (ingest/sample/train "
+                             "spans; open in Perfetto) to this path")
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
@@ -276,7 +280,8 @@ def main():
             tcp_port=args.tcp_port,
             num_remote_actors=args.num_remote_actors,
             spawn_remote_actors=args.remote_actor_mode == "local",
-            learner_devices=args.learner_devices)
+            learner_devices=args.learner_devices,
+            trace_path=args.trace_path)
         print(json.dumps(run_apex(cfg, rt)))
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,

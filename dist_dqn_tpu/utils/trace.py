@@ -1,0 +1,151 @@
+"""Host-side structured tracing (SURVEY.md §5 aux subsystems).
+
+The device program is profiled with ``jax.profiler`` (train.py
+--profile-dir); this module covers the other half of the system — the
+learner service's HOST loop (actors/service.py), where Ape-X throughput is
+won or lost: record ingestion, trajectory assembly, priority bootstraps,
+replay sampling, train-step dispatch. ``SpanTracer`` records wall-clock
+spans/instants/counters with ~µs overhead per event (a perf_counter_ns and
+a tuple append; serialization happens at flush) and writes the Chrome
+trace-event format, so traces open in chrome://tracing or Perfetto next to
+the xprof device timeline.
+
+A ``NullTracer`` with the same surface is the disabled path — call sites
+never branch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
+
+
+class NullTracer:
+    """No-op twin of SpanTracer (the default when tracing is off)."""
+
+    enabled = False
+
+    @contextmanager
+    def span(self, name: str, **args):
+        yield
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def counter(self, name: str, value: float) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class SpanTracer(NullTracer):
+    """Chrome trace-event recorder for one host process.
+
+    Events buffer in memory as tuples and serialize on ``flush()`` /
+    ``close()`` — the hot path never touches JSON or the filesystem.
+    Thread-safe appends (the TCP drain thread traces too); each event
+    carries its thread id so Perfetto lays concurrent work out per track.
+    """
+
+    enabled = True
+
+    def __init__(self, path: str, process_name: str = "dist_dqn_tpu"):
+        self.path = path
+        self.process_name = process_name
+        self._events: List[Tuple] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._t0 = time.perf_counter_ns()
+        self._started = False
+        self._closed = False
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    @contextmanager
+    def span(self, name: str, **args):
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            end = self._now_us()
+            with self._lock:
+                self._events.append(
+                    ("X", name, start, end - start,
+                     threading.get_ident(), args or None))
+
+    def instant(self, name: str, **args) -> None:
+        with self._lock:
+            self._events.append(("i", name, self._now_us(), 0.0,
+                                 threading.get_ident(), args or None))
+
+    def counter(self, name: str, value: float) -> None:
+        with self._lock:
+            self._events.append(("C", name, self._now_us(), float(value),
+                                 threading.get_ident(), None))
+
+    def flush(self) -> None:
+        """Append buffered events to ``path`` and clear the buffer.
+
+        The file is the trace-event JSON-array format, streamed: each flush
+        writes only the NEW events (O(new), bounded memory over long runs);
+        ``close()`` terminates the array. The format spec allows a missing
+        terminator, so a trace from a crashed run still loads in Perfetto.
+        """
+        with self._lock:
+            if self._closed:
+                return
+            events = self._events
+            self._events = []
+            first = not self._started
+            self._started = True
+        lines = []
+        if first:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            lines.append("[\n" + json.dumps(
+                {"name": "process_name", "ph": "M", "pid": self._pid,
+                 "args": {"name": self.process_name}}))
+        for ph, name, ts, extra, tid, args in events:
+            ev = {"name": name, "ph": ph, "ts": ts, "pid": self._pid,
+                  "tid": tid}
+            if ph == "X":
+                ev["dur"] = extra
+            elif ph == "C":
+                ev["args"] = {"value": extra}
+            elif ph == "i":
+                ev["s"] = "t"
+            if args:
+                ev["args"] = {**ev.get("args", {}), **args}
+            lines.append(json.dumps(ev))
+        if not lines:
+            return
+        mode = "w" if first else "a"
+        with open(self.path, mode) as f:
+            f.write(",\n".join(lines) if first
+                    else ",\n" + ",\n".join(lines))
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            if self._closed or not self._started:
+                self._closed = True
+                return
+            self._closed = True
+        with open(self.path, "a") as f:
+            f.write("\n]\n")
+
+
+def make_tracer(trace_path: Optional[str],
+                process_name: str = "dist_dqn_tpu"):
+    """Tracer factory: a real SpanTracer when a path is given, else the
+    no-op twin."""
+    if trace_path:
+        return SpanTracer(trace_path, process_name=process_name)
+    return NullTracer()

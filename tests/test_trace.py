@@ -1,0 +1,100 @@
+"""Host-loop tracing (utils/trace.py): span/counter recording, Chrome
+trace-event output, and the service integration writing a valid trace."""
+import json
+import threading
+
+from dist_dqn_tpu.utils.trace import NullTracer, SpanTracer, make_tracer
+
+
+def test_span_tracer_records_chrome_events(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tr = SpanTracer(path, process_name="test-proc")
+    with tr.span("outer", batch=4):
+        with tr.span("inner"):
+            pass
+    tr.instant("marker", reason="x")
+    tr.counter("replay_size", 123.0)
+    tr.close()
+
+    events = json.load(open(path))
+    by_name = {e["name"]: e for e in events}
+    assert by_name["process_name"]["args"]["name"] == "test-proc"
+    outer, inner = by_name["outer"], by_name["inner"]
+    assert outer["ph"] == inner["ph"] == "X"
+    assert outer["args"]["batch"] == 4
+    # Nesting: inner lies within outer on the same thread track.
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["tid"] == inner["tid"] == threading.get_ident()
+    assert by_name["marker"]["ph"] == "i"
+    assert by_name["replay_size"]["ph"] == "C"
+    assert by_name["replay_size"]["args"]["value"] == 123.0
+
+
+def test_span_tracer_is_exception_safe(tmp_path):
+    tr = SpanTracer(str(tmp_path / "t.json"))
+    try:
+        with tr.span("failing"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    tr.close()
+    events = json.load(open(tr.path))
+    assert any(e["name"] == "failing" and "dur" in e for e in events)
+
+
+def test_flush_is_incremental_and_memory_bounded(tmp_path):
+    """Each flush appends only NEW events; the buffer is cleared, and an
+    unterminated (crashed-run) file still exposes every flushed event."""
+    tr = SpanTracer(str(tmp_path / "t.json"))
+    with tr.span("a"):
+        pass
+    tr.flush()
+    assert tr._events == []
+    size1 = len(open(tr.path).read())
+    tr.flush()  # nothing new: no growth
+    assert len(open(tr.path).read()) == size1
+    with tr.span("b"):
+        pass
+    tr.flush()
+    # Unterminated array (no close yet): spec-legal; recoverable by
+    # appending the terminator, as Perfetto does.
+    events = json.loads(open(tr.path).read() + "]")
+    assert {"a", "b"} <= {e["name"] for e in events}
+    tr.close()
+    events = json.load(open(tr.path))
+    assert {"a", "b"} <= {e["name"] for e in events}
+    tr.close()  # idempotent
+
+
+def test_make_tracer_disabled_is_noop():
+    tr = make_tracer(None)
+    assert isinstance(tr, NullTracer) and not tr.enabled
+    with tr.span("x"):
+        tr.counter("y", 1.0)
+    tr.close()  # no file side effects
+
+
+def test_apex_service_writes_trace(tmp_path):
+    import dataclasses
+
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+    from dist_dqn_tpu.config import CONFIGS
+
+    path = str(tmp_path / "apex_trace.json")
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=True),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=4, total_env_steps=900,
+                           inserts_per_grad_step=32, trace_path=path)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 900
+    names = {e["name"] for e in json.load(open(path))}
+    assert "ingest.shm_record" in names
+    assert "priority.bootstrap" in names
+    assert "replay.sample" in names and "train_step" in names
