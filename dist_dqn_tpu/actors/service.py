@@ -83,6 +83,14 @@ class ApexRuntimeConfig:
     # here covering ingestion / priority / sample / train spans — the host
     # counterpart of the device xprof trace. None disables (no overhead).
     trace_path: Optional[str] = None
+    # Learner pipelining: keep up to this many train steps in flight —
+    # the host samples/stages upcoming batches and writes completed steps'
+    # priorities while the device works (JAX dispatch is async). Priority
+    # updates lag by at most this many steps — standard Ape-X async-learner
+    # semantics. 0 = fully synchronous. Depth >1 mainly pays off when
+    # device round-trip LATENCY (not compute) dominates, e.g. remote-
+    # tunneled accelerators.
+    pipeline_depth: int = 2
 
 
 class ApexLearnerService:
@@ -232,6 +240,10 @@ class ApexLearnerService:
             [None] * self.total_actors
         self._pending: List[Dict[str, np.ndarray]] = []
         self._pending_count = 0
+        from collections import deque
+        self._in_flight = deque()  # (idx, metrics) of dispatched train steps
+        self._act_queue: List = []  # (actor, obs, t) awaiting batched act
+        self._obs_spec = None       # (per-env obs shape, dtype), first hello
         self.env_steps = 0
         self.grad_steps = 0
         self._rng = None
@@ -371,34 +383,87 @@ class ApexLearnerService:
                         f'{{"resumed_at_env_steps": {self.env_steps}}}')
 
     def _reply_actions(self, actor: int, obs: np.ndarray, t: int):
-        jax = self.jax
+        """Queue one actor's act request; the device call happens batched in
+        ``_flush_act_queue`` at the end of the drain burst."""
+        self._act_queue.append((actor, obs, t))
+
+    def _flush_act_queue(self):
+        """Sebulba-style batched inference: ONE device call serves every
+        actor that reported this burst.
+
+        Per-record inference pays a full dispatch (and, on remote-tunneled
+        devices, a network round trip) per actor — at hundreds of actors
+        that latency, not compute, caps ingestion. Queued rows concatenate
+        into a single [R, ...] act call (per-row epsilon from the Ape-X
+        ladder broadcasts inside the act fn) padded up to a power-of-two
+        row bucket so XLA compiles O(log actors) variants, then actions
+        split back out to each actor's reply channel.
+        """
+        if not self._act_queue:
+            return
+        jax, jnp = self.jax, self.jnp
+        burst = self._act_queue
+        self._act_queue = []
+        rows = [obs.shape[0] for _, obs, _ in burst]
+        total = sum(rows)
+        padded = 1
+        while padded < total:
+            padded *= 2
+        first = burst[0][1]
+        obs_cat = np.zeros((padded,) + first.shape[1:], first.dtype)
+        np.concatenate([obs for _, obs, _ in burst], out=obs_cat[:total])
+        eps = np.zeros((padded,), np.float32)
+        off = 0
+        for (actor, _, _), r in zip(burst, rows):
+            eps[off:off + r] = self.actor_eps[actor]
+            off += r
         self._rng, k = jax.random.split(self._rng)
-        if self.recurrent:
-            carry = self._carry[actor]
-            if carry is None:
-                carry = self.net.initial_state(obs.shape[0])
-            # The assembler stores the carry ENTERING this step.
-            self._prev_carry[actor] = (np.asarray(carry[0], np.float32),
-                                       np.asarray(carry[1], np.float32))
-            carry, actions, q_sel, q_max = self._act(
-                self.state.params, carry, self.jnp.asarray(obs), k,
-                self.jnp.float32(self.actor_eps[actor]))
-            self._carry[actor] = carry
-            self._prev_q[actor] = (np.asarray(q_sel, np.float32),
-                                   np.asarray(q_max, np.float32))
-        else:
-            actions = self._act(self.state.params, self.jnp.asarray(obs), k,
-                                self.jnp.float32(self.actor_eps[actor]))
-        actions = np.asarray(actions, np.int32)
-        self._prev_actions[actor] = actions
-        self._prev_obs[actor] = obs
-        payload = encode_arrays({"action": actions})
-        if actor < self.rt.num_actors:
-            self.act_boxes[actor].write(payload, version=t + 1)
-        else:
-            conn = self._actor_conn.get(actor)
-            if conn is not None:
-                self.tcp_server.send(conn, payload)
+        with self.tracer.span("act.batched", actors=len(burst), rows=total):
+            if self.recurrent:
+                cs, hs = [], []
+                for (actor, obs, _), r in zip(burst, rows):
+                    carry = self._carry[actor]
+                    if carry is None:
+                        carry = tuple(np.asarray(x, np.float32)
+                                      for x in self.net.initial_state(r))
+                    # The assembler stores the carry ENTERING this step.
+                    self._prev_carry[actor] = (np.asarray(carry[0],
+                                                          np.float32),
+                                               np.asarray(carry[1],
+                                                          np.float32))
+                    cs.append(self._prev_carry[actor][0])
+                    hs.append(self._prev_carry[actor][1])
+                lstm = cs[0].shape[-1]
+                pad = np.zeros((padded - total, lstm), np.float32)
+                carry_cat = (jnp.asarray(np.concatenate(cs + [pad])),
+                             jnp.asarray(np.concatenate(hs + [pad])))
+                carry_new, actions, q_sel, q_max = self._act(
+                    self.state.params, carry_cat, jnp.asarray(obs_cat), k,
+                    jnp.asarray(eps))
+                c_np = np.asarray(carry_new[0], np.float32)
+                h_np = np.asarray(carry_new[1], np.float32)
+                qs_np = np.asarray(q_sel, np.float32)
+                qm_np = np.asarray(q_max, np.float32)
+            else:
+                actions = self._act(self.state.params, jnp.asarray(obs_cat),
+                                    k, jnp.asarray(eps))
+            acts_np = np.asarray(actions, np.int32)
+        off = 0
+        for (actor, obs, t), r in zip(burst, rows):
+            sl = slice(off, off + r)
+            off += r
+            if self.recurrent:
+                self._carry[actor] = (c_np[sl], h_np[sl])
+                self._prev_q[actor] = (qs_np[sl], qm_np[sl])
+            self._prev_actions[actor] = acts_np[sl]
+            self._prev_obs[actor] = obs
+            payload = encode_arrays({"action": acts_np[sl]})
+            if actor < self.rt.num_actors:
+                self.act_boxes[actor].write(payload, version=t + 1)
+            else:
+                conn = self._actor_conn.get(actor)
+                if conn is not None:
+                    self.tcp_server.send(conn, payload)
 
     def _handle_record(self, payload: bytes, conn_id: Optional[int] = None):
         arrays, meta = decode_arrays(payload)
@@ -414,6 +479,21 @@ class ApexLearnerService:
             self._actor_conn[actor] = conn_id
         elif not 0 <= actor < self.rt.num_actors:
             raise ValueError(f"shm record for out-of-range actor id {actor}")
+        # Validate observation shape/dtype HERE, inside the per-record
+        # error boundary: a malformed remote record must surface as one
+        # bad_records increment, not as a concatenate error later in the
+        # batched act flush that would take down the whole service.
+        for key in ("obs", "next_obs"):
+            arr = arrays.get(key)
+            if arr is None:
+                continue
+            if self._obs_spec is None:
+                self._obs_spec = (arr.shape[1:], arr.dtype)
+            elif (arr.shape[1:] != self._obs_spec[0]
+                  or arr.dtype != self._obs_spec[1]):
+                raise ValueError(
+                    f"actor {actor} {key} {arr.shape[1:]}/{arr.dtype} does "
+                    f"not match the session spec {self._obs_spec}")
         if meta["kind"] == "hello":
             self._ensure_learner(arrays["obs"][0])
             if self._prev_obs[actor] is not None:
@@ -439,7 +519,7 @@ class ApexLearnerService:
             # next act (the incoming obs rows are post-reset there).
             done = np.logical_or(terminated, truncated)
             if done.any():
-                keep = self.jnp.asarray(~done, self.jnp.float32)[:, None]
+                keep = (~done).astype(np.float32)[:, None]
                 c = self._carry[actor]
                 self._carry[actor] = (c[0] * keep, c[1] * keep)
         else:
@@ -552,7 +632,8 @@ class ApexLearnerService:
                                   batch=cfg.learner.batch_size):
                 items, idx, weights = self.replay.sample(
                     cfg.learner.batch_size, beta)
-            with self.tracer.span("train_step"):
+                gen = self.replay.generation(idx)
+            with self.tracer.span("train_step.dispatch"):
                 if self.recurrent:
                     sample = self._sequence_sample(items, weights)
                     self.state, metrics = self._train_step(self.state,
@@ -567,11 +648,29 @@ class ApexLearnerService:
                         next_obs=jnp.asarray(items["next_obs"]))
                     self.state, metrics = self._train_step(
                         self.state, batch, jnp.asarray(weights))
-                prios = np.asarray(metrics["priorities"])
-            with self.tracer.span("replay.update_priorities"):
-                self.replay.update_priorities(idx, prios)
             self.grad_steps += 1
-            self._last_loss = float(metrics["loss"])
+            self._in_flight.append((idx, gen, metrics))
+            # Retire completed steps beyond the pipeline window; the oldest
+            # has had the longest to finish, so this rarely blocks.
+            while len(self._in_flight) > self.rt.pipeline_depth:
+                self._finalize_train()
+
+    def _finalize_train(self):
+        """Materialize the oldest in-flight step's priorities and write
+        them back (blocks on the device only if that step still runs)."""
+        if not self._in_flight:
+            return
+        idx, gen, metrics = self._in_flight.popleft()
+        with self.tracer.span("replay.update_priorities"):
+            # expected_gen drops updates for slots overwritten while this
+            # step was in flight (priority misattribution guard).
+            self.replay.update_priorities(
+                idx, np.asarray(metrics["priorities"]), expected_gen=gen)
+        self._last_loss = float(metrics["loss"])
+
+    def _finalize_all_train(self):
+        while self._in_flight:
+            self._finalize_train()
 
     def _evaluate(self) -> float:
         """Greedy episodes on a service-owned env (mean undiscounted
@@ -648,6 +747,7 @@ class ApexLearnerService:
                                 self.log.log_fn(
                                     f"# bad TCP record ({self.bad_records})"
                                     f": {type(e).__name__}: {e}")
+                self._flush_act_queue()
                 self._flush_pending()
                 self._maybe_train()
                 if self._ckpt is not None:
@@ -655,6 +755,7 @@ class ApexLearnerService:
                 if self.env_steps >= self._next_eval:
                     self._next_eval = self.env_steps \
                         + self.rt.eval_every_steps
+                    self._finalize_all_train()
                     with self.tracer.span("eval"):
                         eval_return = self._evaluate()
                     self.log.record(env_steps=self.env_steps,
@@ -680,6 +781,7 @@ class ApexLearnerService:
                     self.log.flush()
                     last_log = now
             self._flush_pending(force=True)
+            self._finalize_all_train()
             if self._ckpt is not None:
                 self._ckpt.save(self.env_steps, self.state)
                 self._ckpt.close()

@@ -206,6 +206,11 @@ class PrioritizedHostReplay:
         # Cumulative counters for metrics (BASELINE.json:2 throughput).
         self.added = 0
         self.sampled = 0
+        # Per-slot write generation: lets async learners (pipelined train
+        # steps, actors/service.py) detect that a sampled slot was
+        # overwritten before its priority write-back and drop the stale
+        # update instead of stamping it onto a different transition.
+        self._slot_gen = np.zeros(capacity, np.int64)
 
     def __len__(self) -> int:
         return self._size
@@ -232,9 +237,10 @@ class PrioritizedHostReplay:
                 + self.priority_eps
             self._max_priority = max(self._max_priority, float(p.max()))
         self.tree.set(idx, p ** self.alpha)
+        self.added += batch
+        self._slot_gen[idx] = self.added
         self._pos = int((self._pos + batch) % self.capacity)
         self._size = int(min(self._size + batch, self.capacity))
-        self.added += batch
 
     def sample(self, batch_size: int, beta: float
                ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
@@ -253,11 +259,26 @@ class PrioritizedHostReplay:
         self.sampled += batch_size
         return items, idx, weights
 
-    def update_priorities(self, idx: np.ndarray,
-                          priorities: np.ndarray) -> None:
+    def generation(self, idx: np.ndarray) -> np.ndarray:
+        """Write-generation stamps of the given slots (see update guard)."""
+        return self._slot_gen[np.asarray(idx, np.int64)].copy()
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray,
+                          expected_gen: Optional[np.ndarray] = None) -> None:
+        """Write back learner |TD| priorities. With ``expected_gen`` (the
+        ``generation`` captured at sample time), slots overwritten since
+        are skipped — required when the write-back is deferred past
+        subsequent inserts (pipelined learners)."""
+        idx = np.asarray(idx, np.int64)
         p = np.abs(np.asarray(priorities, np.float64)) + self.priority_eps
+        if expected_gen is not None:
+            live = self._slot_gen[idx] == expected_gen
+            if not live.all():
+                idx, p = idx[live], p[live]
+            if idx.size == 0:
+                return
         self._max_priority = max(self._max_priority, float(p.max()))
-        self.tree.set(np.asarray(idx, np.int64), p ** self.alpha)
+        self.tree.set(idx, p ** self.alpha)
 
 
 class UniformHostReplay:

@@ -64,6 +64,32 @@ def test_host_replay_roundtrip_and_priority_update():
     np.testing.assert_allclose(w2, want.astype(np.float32), rtol=1e-5)
 
 
+def test_update_priorities_generation_guard_drops_stale_writes():
+    """A deferred priority write-back must not stamp old |TD| values onto
+    slots that were overwritten while the train step was in flight."""
+    r = PrioritizedHostReplay(capacity=8, alpha=1.0, seed=3)
+    r.add({"x": np.arange(8, dtype=np.float32)}, priorities=np.ones(8))
+    idx = np.arange(4)
+    gen = r.generation(idx)
+    # Ring wraps: slots 0..3 now hold NEW transitions (priority 1.0).
+    r.add({"x": np.full(4, 50.0, np.float32)}, priorities=np.ones(4))
+    r.update_priorities(idx, np.full(4, 99.0), expected_gen=gen)
+    np.testing.assert_allclose(r.tree.get(idx), np.ones(4) + r.priority_eps)
+    # Without the guard the same call does overwrite (documented contract).
+    r.update_priorities(idx, np.full(4, 99.0))
+    assert (r.tree.get(idx) > 90).all()
+    # Partial overlap: only the overwritten half is dropped.
+    r2 = PrioritizedHostReplay(capacity=8, alpha=1.0, seed=4)
+    r2.add({"x": np.arange(8, dtype=np.float32)}, priorities=np.ones(8))
+    idx2 = np.array([0, 1, 6, 7])
+    gen2 = r2.generation(idx2)
+    r2.add({"x": np.full(2, 9.0, np.float32)}, priorities=np.ones(2))
+    r2.update_priorities(idx2, np.full(4, 99.0), expected_gen=gen2)
+    np.testing.assert_allclose(r2.tree.get([0, 1]),
+                               np.ones(2) + r2.priority_eps)
+    assert (r2.tree.get([6, 7]) > 90).all()
+
+
 def test_host_replay_wraparound_overwrites():
     r = PrioritizedHostReplay(capacity=8, alpha=1.0, seed=2)
     r.add({"x": np.arange(8, dtype=np.float32)}, priorities=np.ones(8))

@@ -97,4 +97,5 @@ def test_apex_service_writes_trace(tmp_path):
     names = {e["name"] for e in json.load(open(path))}
     assert "ingest.shm_record" in names
     assert "priority.bootstrap" in names
-    assert "replay.sample" in names and "train_step" in names
+    assert "replay.sample" in names and "train_step.dispatch" in names
+    assert "replay.update_priorities" in names and "act.batched" in names
