@@ -148,14 +148,28 @@ class HostVectorEnv:
                 np.asarray(tr_l))
 
 
+def is_pixel_env(name: str) -> bool:
+    """True if ``make_host_env(name)`` yields image observations (CNN torso
+    required). Owned here, next to the routing, so callers (train CLI) never
+    maintain their own name lists."""
+    return name == "pong" or name.startswith(("ale:", "dmc:"))
+
+
 def make_host_env(name: str, num_envs: int, seed: int = 0) -> HostVectorEnv:
     """Build a host vector env by name.
 
     ``"CartPole-v1"`` etc. -> plain gymnasium; ``"ale:<Game>"`` -> ALE with
     Atari preprocessing (requires ale-py; raises a clear error otherwise);
     ``"dmc:<domain>:<task>"`` -> DM-Control pixels with discretized torques
-    (envs/dmc_adapter.py, BASELINE.json:11).
+    (envs/dmc_adapter.py, BASELINE.json:11); ``"pong"`` -> the numpy twin
+    of the synthetic PixelPong (envs/host_pong.py) — the offline stand-in
+    that exercises the full Atari-shaped actor/learner path without ale-py.
     """
+    if name == "pong":
+        from dist_dqn_tpu.envs.host_pong import HostPixelPong
+
+        return HostVectorEnv(HostPixelPong, num_envs, seed=seed)
+
     if name.startswith("dmc:"):
         from dist_dqn_tpu.envs.dmc_adapter import DMCPixelEnv
 

@@ -262,7 +262,8 @@ def main():
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
-        if not args.host_env.startswith(("ale:", "dmc:")):
+        from dist_dqn_tpu.envs.gym_adapter import is_pixel_env
+        if not is_pixel_env(args.host_env):
             # Non-pixel host env: the config's Nature-CNN torso can't eat
             # flat observations — swap in the MLP torso, keep the rest.
             print(f"# host env {args.host_env} is non-pixel: using MLP torso")

@@ -30,6 +30,28 @@ def test_apex_split_end_to_end():
     assert result["ring_dropped"] == 0
 
 
+def test_apex_split_pixel_pong_native_assembly():
+    """The full Atari-shaped split offline: host PixelPong actors stream
+    84x84x4 uint8 stacks through the NATIVE assembler into the pixel PER
+    shard, with a (tiny) Nature-CNN learner on top (BASELINE.json:9)."""
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, hidden=32, dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=64,
+                                   pallas_sampler=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=8, n_step=3),
+    )
+    rt = ApexRuntimeConfig(host_env="pong", num_actors=1, envs_per_actor=4,
+                           total_env_steps=400, inserts_per_grad_step=64)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["env_steps"] >= 400
+    assert result["replay_size"] > 100
+    assert result["grad_steps"] >= 1
+    assert result["ring_dropped"] == 0 and result["bad_records"] == 0
+
+
 def test_apex_checkpoint_resume_and_eval(tmp_path):
     cfg = CONFIGS["apex"]
     cfg = dataclasses.replace(

@@ -58,6 +58,92 @@ class _FakeAtari:
         return frame, 3.0, self.t >= 9, False, {}
 
 
+def test_host_pong_contract_and_episode():
+    """The numpy PixelPong twin honors the Atari-shaped contract: 84x84x4
+    uint8 stacks, +-1 rewards, first-to-5 termination, step-cap truncation."""
+    from dist_dqn_tpu.envs.gym_adapter import make_host_env
+    from dist_dqn_tpu.envs.host_pong import HostPixelPong
+
+    env = HostPixelPong()
+    obs = env.reset(seed=0)
+    assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+    assert env.num_actions == 6
+    rewards, terms = [], []
+    for t in range(6000):
+        obs, r, term, trunc = env.step(t % 6)
+        rewards.append(r)
+        assert obs.shape == (84, 84, 4)
+        # The new frame entered the back of the stack, ball/paddles lit.
+        assert obs[:, :, -1].max() == 255
+        if term or trunc:
+            terms.append((term, trunc))
+            break
+    assert set(np.unique(rewards)) <= {-1.0, 0.0, 1.0}
+    assert sum(abs(r) for r in rewards) >= 5  # points were scored
+    assert terms, "episode never ended"
+
+    # Vector adapter: the "pong" name wires through make_host_env.
+    v = make_host_env("pong", 2, seed=1)
+    assert v.num_actions == 6
+    obs = v.reset()
+    assert obs.shape == (2, 84, 84, 4) and obs.dtype == np.uint8
+    obs, nxt, r, te, tr = v.step(np.array([2, 3]))
+    assert obs.shape == nxt.shape == (2, 84, 84, 4)
+
+
+def test_host_pong_matches_jax_pixel_pong_shapes():
+    """Both Pong implementations expose identical action/observation specs
+    so the fused and apex runtimes train interchangeable networks."""
+    from dist_dqn_tpu.envs.host_pong import HostPixelPong
+    from dist_dqn_tpu.envs.pixel_pong import PixelPong
+
+    assert HostPixelPong.num_actions == PixelPong.num_actions
+    assert HostPixelPong().reset(0).shape == PixelPong.observation_shape
+
+
+def test_host_pong_step_parity_with_jax_twin():
+    """Inject identical state into both Pong implementations and compare
+    one deterministic step — guards the hand-duplicated physics constants
+    against one-sided edits (no scoring, so no RNG enters)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dist_dqn_tpu.envs import pixel_pong
+    from dist_dqn_tpu.envs.host_pong import HostPixelPong
+
+    jenv = pixel_pong.PixelPong()
+    henv = HostPixelPong()
+    cases = [
+        # (ball xyvxvy, pad_y, opp_y, action): free flight, wall bounce,
+        # and an agent-paddle hit with spin.
+        ((40.0, 40.0, 1.6, 0.7), 40.0, 40.0, 2),
+        ((40.0, 2.0, 1.6, -1.0), 60.0, 30.0, 3),
+        ((77.0, 50.0, 1.6, 0.5), 50.0, 40.0, 0),
+    ]
+    for ball, pad_y, opp_y, action in cases:
+        henv.reset(seed=0)
+        henv._ball = np.array(ball, np.float32)
+        henv._pad_y, henv._opp_y = pad_y, opp_y
+        jstate = pixel_pong.PixelPongState(
+            ball=jnp.asarray(ball, jnp.float32), pad_y=jnp.float32(pad_y),
+            opp_y=jnp.float32(opp_y), score=jnp.zeros((2,), jnp.int32),
+            t=jnp.int32(0), frames=jnp.zeros((84, 84, 4), jnp.uint8),
+            rng=jax.random.PRNGKey(0))
+        jnew, _, jr, jterm, jtrunc = jenv.env_step(jstate,
+                                                   jnp.int32(action))
+        hobs, hr, hterm, htrunc = henv.step(action)
+        np.testing.assert_allclose(np.asarray(jnew.ball), henv._ball,
+                                   rtol=1e-5, err_msg=str(ball))
+        np.testing.assert_allclose(float(jnew.pad_y), henv._pad_y,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(jnew.opp_y), henv._opp_y,
+                                   rtol=1e-6)
+        assert float(jr) == hr and bool(jterm) == hterm
+        # Rendering parity: the freshly rasterized frame is identical.
+        np.testing.assert_array_equal(np.asarray(jnew.frames[:, :, -1]),
+                                      hobs[:, :, -1])
+
+
 def test_atari_preprocessing_stack_skip_clip():
     env = AtariPreprocessing(_FakeAtari(), frame_skip=4, stack=4)
     obs = env.reset()
