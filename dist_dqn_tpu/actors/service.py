@@ -83,6 +83,11 @@ class ApexRuntimeConfig:
     # here covering ingestion / priority / sample / train spans — the host
     # counterpart of the device xprof trace. None disables (no overhead).
     trace_path: Optional[str] = None
+    # Ingest-stall watchdog (SURVEY.md §5 failure detection): warn when no
+    # actor record has arrived for this many seconds while the run is not
+    # finished — actors may be wedged in ways process supervision can't
+    # see (remote workers gone, transport stuck). 0 disables.
+    stall_warn_s: float = 30.0
     # Learner pipelining: keep up to this many train steps in flight —
     # the host samples/stages upcoming batches and writes completed steps'
     # priorities while the device works (JAX dispatch is async). Priority
@@ -244,6 +249,8 @@ class ApexLearnerService:
         self._in_flight = deque()  # (idx, metrics) of dispatched train steps
         self._act_queue: List = []  # (actor, obs, t) awaiting batched act
         self._obs_spec = None       # (per-env obs shape, dtype), first hello
+        self._last_record = time.perf_counter()
+        self._stall_warned = False
         self.env_steps = 0
         self.grad_steps = 0
         self._rng = None
@@ -465,6 +472,19 @@ class ApexLearnerService:
                 if conn is not None:
                     self.tcp_server.send(conn, payload)
 
+    def _watchdog(self, now: float):
+        """Ingest-stall detection: actors can wedge without dying (remote
+        host gone, transport stuck); supervision only catches exits. Warn
+        once per stall with the silence duration; any record clears it."""
+        if not self.rt.stall_warn_s:
+            return
+        silent = now - self._last_record
+        if silent >= self.rt.stall_warn_s and not self._stall_warned:
+            self._stall_warned = True
+            self.log.log_fn(f'{{"ingest_stalled_s": {silent:.1f}, '
+                            f'"env_steps": {self.env_steps}}}')
+            self.tracer.instant("ingest_stalled", silent_s=round(silent, 1))
+
     def _handle_record(self, payload: bytes, conn_id: Optional[int] = None):
         arrays, meta = decode_arrays(payload)
         actor, t = int(meta["actor"]), int(meta["t"])
@@ -494,6 +514,10 @@ class ApexLearnerService:
                 raise ValueError(
                     f"actor {actor} {key} {arr.shape[1:]}/{arr.dtype} does "
                     f"not match the session spec {self._obs_spec}")
+        # Only a VALID record feeds the stall watchdog — a flood of
+        # malformed records must not mask an ingest stall.
+        self._last_record = time.perf_counter()
+        self._stall_warned = False
         if meta["kind"] == "hello":
             self._ensure_learner(arrays["obs"][0])
             if self._prev_obs[actor] is not None:
@@ -767,6 +791,7 @@ class ApexLearnerService:
                 now = time.perf_counter()
                 if now - last_log > self.rt.log_every_s:
                     self.supervise_actors()
+                    self._watchdog(now)
                     self.tracer.counter("replay_size", len(self.replay))
                     self.tracer.counter("env_steps", self.env_steps)
                     self.tracer.flush()

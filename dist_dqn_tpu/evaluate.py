@@ -1,0 +1,91 @@
+"""Standalone checkpoint evaluation:
+``python -m dist_dqn_tpu.evaluate --config cartpole --checkpoint-dir d``.
+
+The deploy-side half of the checkpoint story (SURVEY.md §5): load the
+newest learner checkpoint a training run (either runtime) saved with
+``--checkpoint-dir`` and run greedy episodes on the config's env, without
+any training machinery in the loop. Prints one JSON line with the mean
+undiscounted return.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+
+from dist_dqn_tpu.config import CONFIGS, ExperimentConfig
+
+
+def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
+                        episodes: int = 10, seed: int = 0,
+                        epsilon: float = 0.001) -> dict:
+    """Restore the newest checkpoint and play greedy episodes.
+
+    Returns {"eval_return": mean, "frames": checkpoint cursor, ...}.
+    Raises FileNotFoundError if the directory holds no checkpoint.
+    """
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    rng = jax.random.PRNGKey(seed)
+    rng, k_init, k_eval = jax.random.split(rng, 3)
+
+    if cfg.network.lstm_size:
+        from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner
+        from dist_dqn_tpu.r2d2_loop import make_r2d2_evaluator
+        init, _ = make_r2d2_learner(net, cfg.learner, cfg.replay)
+        evaluator = make_r2d2_evaluator(cfg, env, net,
+                                        num_episodes=episodes,
+                                        epsilon=epsilon)
+    else:
+        from dist_dqn_tpu.agents.dqn import make_learner
+        from dist_dqn_tpu.train_loop import make_evaluator
+        init, _ = make_learner(net, cfg.learner)
+        evaluator = make_evaluator(cfg, env, net, num_episodes=episodes,
+                                   epsilon=epsilon)
+
+    obs_example = jax.numpy.zeros(env.observation_shape,
+                                  env.observation_dtype)
+    example = init(k_init, obs_example)
+    # Read-only surface: never create the directory on a typo'd path, and
+    # release the orbax manager after the one restore.
+    import os
+    if not os.path.isdir(checkpoint_dir):
+        raise FileNotFoundError(
+            f"no checkpoint found under {checkpoint_dir!r}")
+    ckpt = TrainCheckpointer(checkpoint_dir)
+    try:
+        restored = ckpt.restore_latest(example)
+    finally:
+        ckpt.close()
+    if restored is None:
+        raise FileNotFoundError(
+            f"no checkpoint found under {checkpoint_dir!r}")
+    frames, learner = restored
+    mean_return = float(jax.jit(evaluator)(learner.params, k_eval))
+    return {"eval_return": mean_return, "frames": frames,
+            "episodes": episodes, "config": cfg.name}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", choices=sorted(CONFIGS), required=True)
+    parser.add_argument("--checkpoint-dir", required=True)
+    parser.add_argument("--episodes", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--platform", default=None,
+                        help="force a JAX platform (e.g. cpu)")
+    args = parser.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(json.dumps(evaluate_checkpoint(
+        CONFIGS[args.config], args.checkpoint_dir,
+        episodes=args.episodes, seed=args.seed)))
+
+
+if __name__ == "__main__":
+    main()

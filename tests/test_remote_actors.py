@@ -159,6 +159,52 @@ def test_service_rejects_malformed_and_misrouted_records():
             {"kind": "step", "actor": 1, "t": 5})
         with pytest.raises(ValueError, match="before hello"):
             svc._handle_record(step, conn_id=7)
+        # A valid remote hello establishes the session obs spec...
+        hello_ok = encode_arrays({"obs": np.zeros((2, 4), np.float32)},
+                                 {"kind": "hello", "actor": 1, "t": 0})
+        svc._handle_record(hello_ok, conn_id=7)
+        # ...after which a mismatched obs shape/dtype dies AT the record
+        # boundary (one bad_records increment in the run loop), never
+        # reaching the batched act concatenate.
+        for bad_obs in (np.zeros((2, 5), np.float32),
+                        np.zeros((2, 4), np.float64)):
+            bad = encode_arrays({"obs": bad_obs},
+                                {"kind": "hello", "actor": 1, "t": 1})
+            with pytest.raises(ValueError, match="does not match"):
+                svc._handle_record(bad, conn_id=7)
+    finally:
+        svc.shutdown()
+
+
+def test_ingest_stall_watchdog_warns_once_and_clears():
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=256, min_fill=32),
+        learner=dataclasses.replace(cfg.learner, batch_size=8))
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=2, total_env_steps=100,
+                           stall_warn_s=0.01)
+    from dist_dqn_tpu.actors.service import ApexLearnerService
+    logs = []
+    svc = ApexLearnerService(cfg, rt, log_fn=logs.append)
+    try:
+        svc._last_record -= 1.0          # fabricate 1s of silence
+        svc._watchdog(__import__("time").perf_counter())
+        svc._watchdog(__import__("time").perf_counter())  # warn ONCE
+        stalls = [s for s in logs if "ingest_stalled_s" in s]
+        assert len(stalls) == 1, logs
+        # Any record clears the stall latch; the next silence warns again.
+        hello = encode_arrays({"obs": np.zeros((2, 4), np.float32)},
+                              {"kind": "hello", "actor": 0, "t": 0})
+        svc._handle_record(hello)
+        svc._last_record -= 1.0
+        svc._watchdog(__import__("time").perf_counter())
+        assert len([s for s in logs if "ingest_stalled_s" in s]) == 2
     finally:
         svc.shutdown()
 
