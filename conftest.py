@@ -15,6 +15,9 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Transport payload checksums on under test (race/corruption detection;
+# off by default in production for throughput — actors/transport.py).
+os.environ.setdefault("DQN_TRANSPORT_CRC", "1")
 
 import jax  # noqa: E402
 

@@ -472,6 +472,13 @@ class ApexLearnerService:
                 if conn is not None:
                     self.tcp_server.send(conn, payload)
 
+    def _record_seen(self):
+        """Feed the stall watchdog — called only once a record has passed
+        every validation gate, so a flood of malformed records (capped bad-
+        record logging) cannot mask a genuine ingest stall."""
+        self._last_record = time.perf_counter()
+        self._stall_warned = False
+
     def _watchdog(self, now: float):
         """Ingest-stall detection: actors can wedge without dying (remote
         host gone, transport stuck); supervision only catches exits. Warn
@@ -514,12 +521,9 @@ class ApexLearnerService:
                 raise ValueError(
                     f"actor {actor} {key} {arr.shape[1:]}/{arr.dtype} does "
                     f"not match the session spec {self._obs_spec}")
-        # Only a VALID record feeds the stall watchdog — a flood of
-        # malformed records must not mask an ingest stall.
-        self._last_record = time.perf_counter()
-        self._stall_warned = False
         if meta["kind"] == "hello":
             self._ensure_learner(arrays["obs"][0])
+            self._record_seen()
             if self._prev_obs[actor] is not None:
                 # Re-hello = reconnect: the step stream has a gap, so drop
                 # partial assembly windows (and the recurrent carry — the
@@ -531,6 +535,7 @@ class ApexLearnerService:
             return
         if self._prev_obs[actor] is None:
             raise ValueError(f"step record for actor {actor} before hello")
+        self._record_seen()
         # step record: completes (prev_obs, prev_action) -> transition.
         terminated = arrays["terminated"].astype(bool)
         truncated = arrays["truncated"].astype(bool)
@@ -737,6 +742,9 @@ class ApexLearnerService:
     def run(self):
         """Main service loop until total_env_steps processed."""
         self.spawn_actors()
+        # Watchdog clock starts AFTER spawn: slow fleet startup (imports,
+        # env builds, first inference) is not an ingest stall.
+        self._last_record = time.perf_counter()
         last_log = time.perf_counter()
         try:
             while self.env_steps < self.rt.total_env_steps:

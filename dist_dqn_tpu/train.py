@@ -259,6 +259,9 @@ def main():
         if args.profile_dir:
             print("# --profile-dir applies to the fused runtime only; "
                   "ignored under --runtime apex")
+        if args.mesh_devices != 1:
+            print("# --mesh-devices applies to the fused runtime only; "
+                  "use --learner-devices for apex batch sharding")
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
