@@ -24,6 +24,7 @@ import dataclasses
 import os
 import time
 import uuid
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -32,6 +33,7 @@ from dist_dqn_tpu.actors.assembler import NStepAssembler
 from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing, shm_dir,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.config import ExperimentConfig
+from dist_dqn_tpu.replay.host import pad_pow2
 from dist_dqn_tpu.utils.metrics import MetricLogger
 
 _PRIO_CHUNK = 256
@@ -245,8 +247,7 @@ class ApexLearnerService:
             [None] * self.total_actors
         self._pending: List[Dict[str, np.ndarray]] = []
         self._pending_count = 0
-        from collections import deque
-        self._in_flight = deque()  # (idx, metrics) of dispatched train steps
+        self._in_flight = deque()  # (idx, gen, metrics) per dispatched step
         self._act_queue: List = []  # (actor, obs, t) awaiting batched act
         self._obs_spec = None       # (per-env obs shape, dtype), first hello
         self._last_record = time.perf_counter()
@@ -413,9 +414,7 @@ class ApexLearnerService:
         self._act_queue = []
         rows = [obs.shape[0] for _, obs, _ in burst]
         total = sum(rows)
-        padded = 1
-        while padded < total:
-            padded *= 2
+        padded = pad_pow2(total)
         first = burst[0][1]
         obs_cat = np.zeros((padded,) + first.shape[1:], first.dtype)
         np.concatenate([obs for _, obs, _ in burst], out=obs_cat[:total])
@@ -429,17 +428,13 @@ class ApexLearnerService:
             if self.recurrent:
                 cs, hs = [], []
                 for (actor, obs, _), r in zip(burst, rows):
-                    carry = self._carry[actor]
-                    if carry is None:
-                        carry = tuple(np.asarray(x, np.float32)
-                                      for x in self.net.initial_state(r))
+                    carry = self._carry[actor] or self.net.initial_state(r)
+                    c0 = np.asarray(carry[0], np.float32)
+                    h0 = np.asarray(carry[1], np.float32)
                     # The assembler stores the carry ENTERING this step.
-                    self._prev_carry[actor] = (np.asarray(carry[0],
-                                                          np.float32),
-                                               np.asarray(carry[1],
-                                                          np.float32))
-                    cs.append(self._prev_carry[actor][0])
-                    hs.append(self._prev_carry[actor][1])
+                    self._prev_carry[actor] = (c0, h0)
+                    cs.append(c0)
+                    hs.append(h0)
                 lstm = cs[0].shape[-1]
                 pad = np.zeros((padded - total, lstm), np.float32)
                 carry_cat = (jnp.asarray(np.concatenate(cs + [pad])),

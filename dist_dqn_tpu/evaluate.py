@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 
 import jax
 
@@ -53,7 +54,6 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     example = init(k_init, obs_example)
     # Read-only surface: never create the directory on a typo'd path, and
     # release the orbax manager after the one restore.
-    import os
     if not os.path.isdir(checkpoint_dir):
         raise FileNotFoundError(
             f"no checkpoint found under {checkpoint_dir!r}")

@@ -35,11 +35,14 @@ _tree_lib_lock = threading.Lock()
 _fallback_warned = False
 
 
-def _pad_pow2(capacity: int) -> int:
+def pad_pow2(n: int) -> int:
+    """Smallest power of two >= n (tree padding; shared with the batched
+    act bucketing in actors/service.py)."""
     padded = 1
-    while padded < capacity:
+    while padded < n:
         padded *= 2
     return padded
+
 
 
 def _check_tree_idx(idx: np.ndarray, capacity: int) -> np.ndarray:
@@ -51,6 +54,8 @@ def _check_tree_idx(idx: np.ndarray, capacity: int) -> np.ndarray:
         raise IndexError(f"sum-tree index out of range [0, {capacity}): "
                          f"{idx.min()}..{idx.max()}")
     return idx
+
+
 # Exact interior-node recompute cadence for the native tree's delta
 # propagation (float64 drift bound; see sumtree.cc). Coarse on purpose:
 # a rebuild is one O(capacity) pass, ~ms at the 1M-slot Ape-X shard.
@@ -91,7 +96,7 @@ class NativeSumTree:
 
     def __init__(self, capacity: int):
         self._lib = _native_tree_lib()
-        self.capacity = _pad_pow2(capacity)  # mirrors dqn_tree_create
+        self.capacity = pad_pow2(capacity)  # mirrors dqn_tree_create
         self._h = self._lib.dqn_tree_create(capacity)
 
     def __del__(self):
@@ -147,7 +152,7 @@ class SumTree:
     """Flat-array binary sum-tree with vectorized batch set/sample."""
 
     def __init__(self, capacity: int):
-        self.capacity = _pad_pow2(capacity)
+        self.capacity = pad_pow2(capacity)
         self.depth = self.capacity.bit_length() - 1
         self.tree = np.zeros(2 * self.capacity, np.float64)
 

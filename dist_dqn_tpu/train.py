@@ -21,6 +21,26 @@ from dist_dqn_tpu.models import build_network
 from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
 
 
+def _pick_mesh_devices(num_devices: int, multiprocess: bool):
+    """Device list for the dp mesh. Multi-process meshes must span the
+    GLOBAL device list — a prefix slice would leave other processes without
+    addressable shards; single-process requests larger than the machine
+    fail loudly instead of silently truncating."""
+    devs = jax.devices()
+    if multiprocess:
+        if num_devices not in (0, 1, len(devs)):
+            raise ValueError(
+                f"multi-process runs use all {len(devs)} global devices; "
+                f"--mesh-devices {num_devices} is not meaningful (pass 0)")
+        return devs
+    if num_devices in (0, None):
+        return devs
+    if len(devs) < num_devices:
+        raise ValueError(f"--mesh-devices {num_devices} requested but only "
+                         f"{len(devs)} available")
+    return devs[:num_devices]
+
+
 def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
           checkpoint_dir: str = None, save_every_frames: int = 0,
@@ -56,23 +76,8 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     if use_mesh:
         from dist_dqn_tpu.parallel import (make_mesh, make_mesh_fused_train,
                                            make_mesh_r2d2_train)
-        if multiprocess:
-            # The mesh must span the GLOBAL device list — a prefix slice
-            # would leave other processes without addressable shards.
-            devs = jax.devices()
-            if num_devices not in (0, 1, len(devs)):
-                raise ValueError(
-                    f"multi-process runs use all {len(devs)} global "
-                    f"devices; --mesh-devices {num_devices} is not "
-                    "meaningful (pass 0)")
-        elif num_devices in (0, None):
-            devs = jax.devices()
-        else:
-            devs = jax.devices()[:num_devices]
-            if len(devs) < num_devices:
-                raise ValueError(f"--mesh-devices {num_devices} requested "
-                                 f"but only {len(devs)} available")
-        mesh = make_mesh(devices=devs)
+        mesh = make_mesh(devices=_pick_mesh_devices(num_devices,
+                                                    multiprocess))
     if cfg.network.lstm_size:
         from dist_dqn_tpu.r2d2_loop import make_r2d2_evaluator, \
             make_r2d2_train
@@ -91,6 +96,18 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                                           num_episodes=cfg.eval_episodes))
     if not use_mesh:
         run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
+
+    # Eval-path choice, decided once: multi-process runs eval only on the
+    # logging process, from the host copy of the replicated params (the
+    # eval program is process-local).
+    if not multiprocess:
+        run_eval = lambda params, k: float(evaluate(params, k))  # noqa: E731
+    elif jax.process_index() == 0:
+        from dist_dqn_tpu.parallel.distributed import host_replica
+        run_eval = lambda params, k: float(  # noqa: E731
+            evaluate(host_replica(params), k))
+    else:
+        run_eval = None
 
     rng = jax.random.PRNGKey(seed)
     rng, k_init = jax.random.split(rng)
@@ -150,18 +167,11 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             "grad_steps_per_sec": float(metrics["grad_steps_in_chunk"]) / dt,
         }
         if frames >= next_eval:
+            # Every process consumes k_eval so rng streams stay in
+            # lockstep even where run_eval is None (non-logging processes).
             rng, k_eval = jax.random.split(rng)
-            if not multiprocess:
-                row["eval_return"] = float(evaluate(carry.learner.params,
-                                                    k_eval))
-            elif jax.process_index() == 0:
-                # The eval program is process-local: only the logging
-                # process runs it, on the host copy of the replicated
-                # params (other processes still consumed k_eval above, so
-                # rng streams stay in lockstep).
-                from dist_dqn_tpu.parallel.distributed import host_replica
-                row["eval_return"] = float(
-                    evaluate(host_replica(carry.learner.params), k_eval))
+            if run_eval is not None:
+                row["eval_return"] = run_eval(carry.learner.params, k_eval)
             next_eval = frames + cfg.eval_every_steps
         history.append(row)
         log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
