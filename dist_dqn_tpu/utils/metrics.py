@@ -53,6 +53,9 @@ class MetricLogger:
         self._extra.update(extra)
 
     def flush(self) -> Dict[str, float]:
+        """Emit one JSON row: the rates plus extras recorded SINCE the last
+        flush (one-shot values like eval_return must not go stale-sticky
+        into every later throughput row)."""
         row = {
             "env_steps_per_sec_per_chip":
                 round(self.env_steps.rate() / self.num_chips, 2),
@@ -60,5 +63,6 @@ class MetricLogger:
         }
         row.update({k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in self._extra.items()})
+        self._extra.clear()
         self.log_fn(json.dumps(row))
         return row
