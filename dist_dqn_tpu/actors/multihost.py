@@ -1,0 +1,162 @@
+"""Multi-host Ape-X: one learner service per host, gradients over DCN.
+
+The pod-scale reading of BASELINE.json:9 ("distributed prioritized replay +
+sharded/multi-learner"): every host runs its own ApexLearnerService — its
+own actor fleet, trajectory assembly, and replay SHARD in host DRAM — and
+the train step is ONE collective XLA program over the global device mesh:
+each host feeds its shard's batch slice, gradients pmean across hosts
+(ICI within a host slice, DCN between hosts), and params stay replicated
+bit-identically everywhere. Ingestion stays fully asynchronous per host;
+only training is in lockstep.
+
+Cadence without a scheduler: hosts agree on global counters (transitions
+inserted, readiness, env steps) through a tiny psum "agreement" collective.
+Each host fires an agreement when its local clock says one is due and then
+BLOCKS until every peer joins — calls therefore pair 1:1 across hosts by
+construction (a host cannot complete agreement k+1 before its peers
+completed k), and every host derives the SAME train-step target from the
+SAME agreed numbers, so the collective train steps pair too. This replaces
+the reference family's parameter-server/NCCL-group coordination with pure
+SPMD + one scalar collective.
+
+Requires a ``jax.distributed`` runtime (parallel/distributed.py). Used by
+ApexLearnerService when ``jax.process_count() > 1``; single-process runs
+never import this module.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class MultihostLearner:
+    """Collective-learner machinery for one service process in the group."""
+
+    def __init__(self, state_example_fn=None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dist_dqn_tpu.parallel import make_mesh
+
+        self.jax = jax
+        self.P = P
+        self.NamedSharding = NamedSharding
+        self.nprocs = jax.process_count()
+        self.local_devices = jax.local_device_count()
+        self.total_devices = jax.device_count()
+        self.mesh = make_mesh(devices=jax.devices())  # dp over the pod
+        self._repl = NamedSharding(self.mesh, P())
+        self._agree = None
+
+    # -- init ---------------------------------------------------------------
+    def wrap_init(self, init):
+        """Learner init -> global REPLICATED state (identical inputs on
+        every process; the jit is the group's first collective program)."""
+        jax = self.jax
+        jitted = jax.jit(init, out_shardings=self._repl)
+
+        def replicated_init(rng, obs_example):
+            return jitted(np.asarray(rng), np.asarray(obs_example))
+
+        return replicated_init
+
+    # -- train --------------------------------------------------------------
+    def wrap_train_step(self, train_step, data_specs, metric_specs):
+        """Per-device train step -> collective step over the global mesh.
+
+        The returned fn takes THIS process's numpy batch shard (leading
+        data axis = the local slice of the global batch), assembles global
+        arrays with ``make_array_from_process_local_data``, runs the
+        shard_map'd step (state replicated, data sharded over ``dp``,
+        pmean inside — agents/), and returns (state, metrics) where
+        ``metrics["priorities"]`` is this process's LOCAL slice as numpy.
+        """
+        jax = self.jax
+        P = self.P
+        mesh = self.mesh
+        repl = P()
+
+        def sharded(state, *data):
+            state_spec = jax.tree.map(lambda _: repl, state,
+                                      is_leaf=lambda x: x is None)
+            body = jax.shard_map(
+                train_step, mesh=mesh,
+                in_specs=(state_spec,) + data_specs,
+                out_specs=(state_spec, metric_specs), check_vma=False)
+            return body(state, *data)
+
+        jitted = jax.jit(sharded, donate_argnums=0)
+
+        def to_global(spec, x):
+            x = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                self.NamedSharding(mesh, spec), x)
+
+        def step(state, *host_data):
+            gdata = tuple(
+                jax.tree.map(to_global, spec, d)
+                for spec, d in zip(data_specs, host_data))
+            state, metrics = jitted(state, *gdata)
+            prios = metrics.pop("priorities")
+            # The local slice of the sharded priorities vector, in global
+            # batch order (shards sorted by their global offset).
+            shards = sorted(prios.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            metrics["priorities"] = np.concatenate(
+                [np.asarray(s.data) for s in shards])
+            return state, metrics
+
+        return step
+
+    # -- agreement ----------------------------------------------------------
+    # Counter psums run in float32 on device (the repo never enables x64),
+    # where integers are exact only below 2**24 — far too small for pod
+    # counters. Each value is therefore split into base-2**14 limbs before
+    # the collective: the low-limb sum stays < 2**23 for up to 512 hosts
+    # and the high-limb sum equals total // 2**14 (< 2**24 while the true
+    # total is < 2**38 ≈ 2.7e11), so recombination is EXACT up to 2**38.
+    _LIMB = 1 << 14
+
+    def agree(self, values: np.ndarray) -> np.ndarray:
+        """Exact psum of small non-negative integer counters across
+        processes (values < 2**38; see limb note above). BLOCKS until every
+        process joins — see module docstring for why this makes agreement
+        calls pair 1:1."""
+        jax = self.jax
+        P = self.P
+        if self._agree is None:
+            self._agree = jax.jit(jax.shard_map(
+                lambda x: jax.lax.psum(x, "dp"), mesh=self.mesh,
+                in_specs=P("dp"), out_specs=P(), check_vma=False))
+        ints = np.asarray(values, np.int64)
+        if (ints < 0).any() or (ints >= 1 << 38).any():
+            raise ValueError(f"agree() counters out of range: {ints}")
+        limbs = np.stack([ints // self._LIMB, ints % self._LIMB]
+                         ).astype(np.float32)  # [2, k]
+        # Exactly one contributing row per PROCESS: device 0 carries the
+        # values, other local devices zeros.
+        local = np.zeros((self.local_devices,) + limbs.shape, np.float32)
+        local[0] = limbs
+        garr = self.jax.make_array_from_process_local_data(
+            self.NamedSharding(self.mesh, P("dp")), local)
+        out = np.asarray(self.jax.device_get(self._agree(garr)))[0]
+        return out[0].astype(np.int64) * self._LIMB \
+            + out[1].astype(np.int64)
+
+    # -- host mirrors -------------------------------------------------------
+    def host_copy(self, tree):
+        """Replicated global pytree -> process-local numpy (for the local
+        act/eval/priority-bootstrap programs, which must not touch global
+        arrays)."""
+        from dist_dqn_tpu.parallel.distributed import host_replica
+        return host_replica(tree)
+
+    def shard_batch_size(self, global_batch: int) -> Tuple[int, int]:
+        """(this process's slice, per-device slice) of a global batch."""
+        if global_batch % self.total_devices:
+            raise ValueError(
+                f"global batch {global_batch} must divide over "
+                f"{self.total_devices} devices")
+        per_dev = global_batch // self.total_devices
+        return per_dev * self.local_devices, per_dev

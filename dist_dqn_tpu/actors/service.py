@@ -90,6 +90,11 @@ class ApexRuntimeConfig:
     # finished — actors may be wedged in ways process supervision can't
     # see (remote workers gone, transport stuck). 0 disables.
     stall_warn_s: float = 30.0
+    # Multi-host cadence: under a jax.distributed runtime, how often each
+    # host fires the counter-agreement collective (actors/multihost.py).
+    # The call BLOCKS until every host joins, so this is a minimum period,
+    # not a timer the hosts must hit together.
+    sync_every_s: float = 0.05
     # Learner pipelining: keep up to this many train steps in flight —
     # the host samples/stages upcoming batches and writes completed steps'
     # priorities while the device works (JAX dispatch is async). Priority
@@ -141,27 +146,42 @@ class ApexLearnerService:
         self._actor_conn: Dict[int, int] = {}   # remote actor id -> conn id
         self.stop_path = str(shm_dir() / f"stop_{self.run_id}")
 
-        # Probe the env for action count (host-side, cheap).
+        # Probe the env for action count + an obs example (host-side).
         from dist_dqn_tpu.envs.gym_adapter import make_host_env
         probe = make_host_env(rt.host_env, 1)
         self.num_actions = probe.num_actions
+        obs_example = probe.reset()[0]
         del probe
 
         net = build_network(cfg.network, self.num_actions)
         self.net = net
+        # Multi-host (jax.distributed runtime): every host runs its own
+        # service — actors + replay shard — and train steps are collective
+        # over the GLOBAL mesh (actors/multihost.py). Non-zero processes
+        # compute silently; process 0 reports.
+        self.distributed = jax.process_count() > 1
+        if self.distributed:
+            from dist_dqn_tpu.parallel.distributed import main_process_log
+            self.log = MetricLogger(log_fn=main_process_log(log_fn))
         # Multi-learner: batches shard over the dp mesh axis, gradients
         # pmean over ICI, learner state replicated.
-        self.n_learners = (len(jax.devices()) if rt.learner_devices == 0
+        self.n_learners = (len(jax.local_devices())
+                           if rt.learner_devices == 0
                            else rt.learner_devices)
-        if self.n_learners > len(jax.devices()):
+        if self.distributed:
+            if rt.learner_devices != 1:
+                log_fn("# distributed mode: the train mesh spans every "
+                       "global device; --learner-devices ignored")
+            self.n_learners = jax.local_device_count()
+        elif self.n_learners > len(jax.devices()):
             raise ValueError(
                 f"learner_devices={self.n_learners} but only "
                 f"{len(jax.devices())} devices are available")
-        if cfg.learner.batch_size % self.n_learners:
+        if not self.distributed and cfg.learner.batch_size % self.n_learners:
             raise ValueError(
                 f"batch_size={cfg.learner.batch_size} not divisible by "
                 f"learner_devices={self.n_learners}")
-        axis = "dp" if self.n_learners > 1 else None
+        axis = "dp" if (self.n_learners > 1 or self.distributed) else None
         # Recurrent (R2D2) configs swap in the sequence learner, the
         # carry-threaded policy and the sequence assembler; the transport,
         # actors and replay shard are shared (BASELINE.json:10).
@@ -225,7 +245,18 @@ class ApexLearnerService:
             self._prio_fn = jax.jit(prio_fn)
         self.state = None
         self._init_learner = init
-        if axis is None:
+        self._mh = None
+        self._host_params = None
+        if self.distributed:
+            from dist_dqn_tpu.actors.multihost import MultihostLearner
+            self._mh = MultihostLearner()
+            self._local_batch, _ = self._mh.shard_batch_size(
+                cfg.learner.batch_size)
+            data_specs, metric_specs = self._step_specs(axis)
+            self._train_step = self._mh.wrap_train_step(
+                train_step, data_specs, metric_specs)
+            self._init_learner = self._mh.wrap_init(init)
+        elif axis is None:
             self._train_step = jax.jit(train_step, donate_argnums=0)
         else:
             self._train_step = self._shard_train_step(train_step, axis)
@@ -262,18 +293,23 @@ class ApexLearnerService:
         self.actor_restarts = 0
         from dist_dqn_tpu.utils.trace import make_tracer
         self.tracer = make_tracer(rt.trace_path, process_name="apex-learner")
+        self.global_env_steps = 0
+        self._resume_global = 0
+        self._next_sync = 0.0
+        if self.distributed:
+            # Collective ordering must be identical on every process, so
+            # the learner init (the group's first collective, plus the
+            # checkpoint restore when configured) happens HERE — the first
+            # actor hello lands at different times on different hosts.
+            self._ensure_learner(obs_example)
 
-    def _shard_train_step(self, train_step, axis: str):
-        """Lift the per-device train step onto the local learner mesh:
-        batch leaves shard over ``axis``, learner state replicates, and the
-        pmean inside the step (agents/) allreduces gradients over ICI."""
-        jax = self.jax
+    def _step_specs(self, axis: str):
+        """(data_specs, metric_specs) PartitionSpecs for the train step:
+        batch leaves shard over ``axis``, scalars/state replicate."""
         from jax.sharding import PartitionSpec as P
 
-        from dist_dqn_tpu.parallel import make_mesh
         from dist_dqn_tpu.types import SequenceSample, Transition
 
-        mesh = make_mesh(devices=jax.devices()[:self.n_learners])
         repl = P()
         if self.recurrent:
             # Time-major [L, S, ...] fields shard the sequence axis (1).
@@ -285,13 +321,28 @@ class ApexLearnerService:
             metric_specs = {"loss": repl, "raw_loss": repl,
                             "priorities": P(axis), "grad_norm": repl}
         else:
-            data_specs = (jax.tree.map(lambda _: P(axis),
-                                       Transition(obs=0, action=0, reward=0,
-                                                  discount=0, next_obs=0)),
-                          P(axis))  # batch, weights
+            data_specs = (self.jax.tree.map(
+                lambda _: P(axis),
+                Transition(obs=0, action=0, reward=0, discount=0,
+                           next_obs=0)),
+                P(axis))  # batch, weights
             metric_specs = {"loss": repl, "raw_loss": repl,
                             "priorities": P(axis), "grad_norm": repl,
                             "mean_q_target_gap": repl}
+        return data_specs, metric_specs
+
+    def _shard_train_step(self, train_step, axis: str):
+        """Lift the per-device train step onto the local learner mesh:
+        batch leaves shard over ``axis``, learner state replicates, and the
+        pmean inside the step (agents/) allreduces gradients over ICI."""
+        jax = self.jax
+        from jax.sharding import PartitionSpec as P
+
+        from dist_dqn_tpu.parallel import make_mesh
+
+        mesh = make_mesh(devices=jax.devices()[:self.n_learners])
+        repl = P()
+        data_specs, metric_specs = self._step_specs(axis)
 
         def sharded(state, *data):
             state_spec = jax.tree.map(lambda _: repl, state,
@@ -382,13 +433,45 @@ class ApexLearnerService:
                 if restored is not None:
                     # Resume the cursor too: the run continues toward the
                     # same total_env_steps (replay refills from live actors).
-                    self.env_steps, self.state = restored
+                    resumed, self.state = restored
+                    if self.distributed:
+                        # The saved cursor is the GLOBAL agreed count:
+                        # local env_steps restarts at 0 and the offset
+                        # folds into the agreement result instead (else
+                        # each host's copy would be psummed N times).
+                        self._resume_global = resumed
+                        self.global_env_steps = resumed
+                    else:
+                        self.env_steps = resumed
                     if self.rt.eval_every_steps:
                         # Next eval is one full period out, not immediately.
-                        self._next_eval = (self.env_steps
-                                           + self.rt.eval_every_steps)
+                        self._next_eval = resumed + self.rt.eval_every_steps
                     self.log.log_fn(
-                        f'{{"resumed_at_env_steps": {self.env_steps}}}')
+                        f'{{"resumed_at_env_steps": {resumed}}}')
+            self._refresh_host_params()
+
+    def _refresh_host_params(self):
+        """Local numpy mirror of the replicated params for the process-
+        local programs — act, eval, priority bootstraps must not feed
+        GLOBAL mesh arrays into single-process jits. The target net is
+        mirrored only where something reads it (the feed-forward priority
+        bootstrap); the R2D2 path would otherwise D2H-copy it every train
+        burst for nothing."""
+        if self.distributed and self.state is not None:
+            target = (self._mh.host_copy(self.state.target_params)
+                      if self._prio_fn is not None else None)
+            self._host_params = (self._mh.host_copy(self.state.params),
+                                 target)
+
+    @property
+    def _policy_params(self):
+        return self._host_params[0] if self.distributed \
+            else self.state.params
+
+    @property
+    def _target_policy_params(self):
+        return self._host_params[1] if self.distributed \
+            else self.state.target_params
 
     def _reply_actions(self, actor: int, obs: np.ndarray, t: int):
         """Queue one actor's act request; the device call happens batched in
@@ -440,14 +523,14 @@ class ApexLearnerService:
                 carry_cat = (jnp.asarray(np.concatenate(cs + [pad])),
                              jnp.asarray(np.concatenate(hs + [pad])))
                 carry_new, actions, q_sel, q_max = self._act(
-                    self.state.params, carry_cat, jnp.asarray(obs_cat), k,
+                    self._policy_params, carry_cat, jnp.asarray(obs_cat), k,
                     jnp.asarray(eps))
                 c_np = np.asarray(carry_new[0], np.float32)
                 h_np = np.asarray(carry_new[1], np.float32)
                 qs_np = np.asarray(q_sel, np.float32)
                 qm_np = np.asarray(q_max, np.float32)
             else:
-                actions = self._act(self.state.params, jnp.asarray(obs_cat),
+                actions = self._act(self._policy_params, jnp.asarray(obs_cat),
                                     k, jnp.asarray(eps))
             acts_np = np.asarray(actions, np.int32)
         off = 0
@@ -597,7 +680,7 @@ class ApexLearnerService:
                     if pad else x[lo:hi]
 
             prios = self._prio_fn(
-                self.state.params, self.state.target_params,
+                self._policy_params, self._target_policy_params,
                 jnp.asarray(pad_to(cat["obs"])),
                 jnp.asarray(pad_to(cat["action"])),
                 jnp.asarray(pad_to(cat["reward"])),
@@ -635,27 +718,61 @@ class ApexLearnerService:
         return max(self.cfg.replay.min_fill // per_seq,
                    2 * self.cfg.learner.batch_size)
 
+    def _inserts_per_grad(self) -> int:
+        """inserts_per_grad_step is defined in TRANSITIONS; in sequence
+        mode replay.added counts sequences, each covering unroll_length
+        loss transitions, so convert to keep the configured replay ratio."""
+        inserts = self.rt.inserts_per_grad_step
+        if self.recurrent:
+            inserts = max(
+                inserts // max(self.cfg.replay.unroll_length, 1), 1)
+        return inserts
+
     def _maybe_train(self):
-        cfg = self.cfg
+        if self.distributed:
+            return self._maybe_train_distributed()
         if len(self.replay) < self._min_fill_items():
             return
-        # inserts_per_grad_step is defined in TRANSITIONS; in sequence mode
-        # replay.added counts sequences, each covering unroll_length loss
-        # transitions, so convert to keep the configured replay ratio.
-        inserts_per_grad = self.rt.inserts_per_grad_step
-        if self.recurrent:
-            inserts_per_grad = max(
-                inserts_per_grad // max(cfg.replay.unroll_length, 1), 1)
-        target_grad_steps = self.replay.added // inserts_per_grad
+        target = self.replay.added // self._inserts_per_grad()
+        self._train_to_target(target, self.env_steps,
+                              self.cfg.learner.batch_size)
+
+    def _maybe_train_distributed(self):
+        """Multi-host cadence (actors/multihost.py): agree on global
+        counters, then every host runs the SAME number of collective train
+        steps (its own shard's batch slice each). Ingestion stays async;
+        only this path is lockstep."""
+        if time.perf_counter() < self._next_sync:
+            return
+        ready = int(len(self.replay) >= self._min_fill_items())
+        agreed = self._mh.agree(np.array(
+            [self.replay.added, ready, self.env_steps], np.int64))
+        self._next_sync = time.perf_counter() + self.rt.sync_every_s
+        g_added, ready_count, g_env = (int(v) for v in agreed)
+        # Resumed runs: env_steps restarts at 0 on every host (the saved
+        # cursor was the GLOBAL count — psumming it back would multiply it
+        # by the host count); the offset re-enters here once.
+        self.global_env_steps = g_env + self._resume_global
+        if int(ready_count) < self._mh.nprocs:
+            return  # some host's shard is still below min_fill
+        target = g_added // self._inserts_per_grad()
+        before = self.grad_steps
+        self._train_to_target(target, self.global_env_steps,
+                              self._local_batch)
+        if self.grad_steps > before:
+            # Fresh local mirror for act/eval/priority bootstraps.
+            self._refresh_host_params()
+
+    def _train_to_target(self, target_grad_steps: int, progress_steps: int,
+                         batch_size: int):
+        cfg = self.cfg
         jnp = self.jnp
         while self.grad_steps < target_grad_steps:
             beta = min(1.0, cfg.replay.importance_exponent
                        + (1 - cfg.replay.importance_exponent)
-                       * self.env_steps / max(self.rt.total_env_steps, 1))
-            with self.tracer.span("replay.sample",
-                                  batch=cfg.learner.batch_size):
-                items, idx, weights = self.replay.sample(
-                    cfg.learner.batch_size, beta)
+                       * progress_steps / max(self.rt.total_env_steps, 1))
+            with self.tracer.span("replay.sample", batch=batch_size):
+                items, idx, weights = self.replay.sample(batch_size, beta)
                 gen = self.replay.generation(idx)
             with self.tracer.span("train_step.dispatch"):
                 if self.recurrent:
@@ -714,10 +831,10 @@ class ApexLearnerService:
         for _ in range(10_000):
             self._rng, k = self.jax.random.split(self._rng)
             if self.recurrent:
-                carry, actions, _, _ = self._act(self.state.params, carry,
+                carry, actions, _, _ = self._act(self._policy_params, carry,
                                                  jnp.asarray(obs), k, eps)
             else:
-                actions = self._act(self.state.params, jnp.asarray(obs), k,
+                actions = self._act(self._policy_params, jnp.asarray(obs), k,
                                     eps)
             obs, _, reward, term, trunc = env.step(np.asarray(actions))
             returns += np.asarray(reward) * alive
@@ -734,6 +851,13 @@ class ApexLearnerService:
             self.log.record(eval_episodes_truncated=float(alive.sum()))
         return float(returns.mean())
 
+    def _progress(self) -> int:
+        """Run-cursor: local env steps, or the group-agreed GLOBAL count in
+        multi-host mode (identical on every host at each sync, so all
+        hosts make termination/eval/checkpoint decisions in the same
+        order — the collective-pairing invariant)."""
+        return self.global_env_steps if self.distributed else self.env_steps
+
     def run(self):
         """Main service loop until total_env_steps processed."""
         self.spawn_actors()
@@ -742,7 +866,7 @@ class ApexLearnerService:
         self._last_record = time.perf_counter()
         last_log = time.perf_counter()
         try:
-            while self.env_steps < self.rt.total_env_steps:
+            while self._progress() < self.rt.total_env_steps:
                 drained = False
                 for _ in range(256):
                     rec = self.req_ring.pop()
@@ -778,16 +902,21 @@ class ApexLearnerService:
                 self._flush_pending()
                 self._maybe_train()
                 if self._ckpt is not None:
-                    self._ckpt.maybe_save(self.env_steps, self.state)
-                if self.env_steps >= self._next_eval:
-                    self._next_eval = self.env_steps \
+                    self._ckpt.maybe_save(self._progress(), self.state)
+                if self._progress() >= self._next_eval:
+                    self._next_eval = self._progress() \
                         + self.rt.eval_every_steps
                     self._finalize_all_train()
-                    with self.tracer.span("eval"):
-                        eval_return = self._evaluate()
-                    self.log.record(env_steps=self.env_steps,
-                                    eval_return=eval_return)
-                    self.log.flush()
+                    # Eval is a process-local program: in multi-host mode
+                    # only the reporting host plays episodes; all hosts
+                    # advance _next_eval identically (agreed counter).
+                    if not self.distributed \
+                            or self.jax.process_index() == 0:
+                        with self.tracer.span("eval"):
+                            eval_return = self._evaluate()
+                        self.log.record(env_steps=self._progress(),
+                                        eval_return=eval_return)
+                        self.log.flush()
                     last_log = time.perf_counter()
                 if not drained:
                     time.sleep(0.0002)
@@ -811,12 +940,13 @@ class ApexLearnerService:
             self._flush_pending(force=True)
             self._finalize_all_train()
             if self._ckpt is not None:
-                self._ckpt.save(self.env_steps, self.state)
+                self._ckpt.save(self._progress(), self.state)
                 self._ckpt.close()
         finally:
             self.tracer.close()
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
+                "global_env_steps": self.global_env_steps,
                 "replay_size": len(self.replay),
                 "ring_dropped": self.req_ring.dropped,
                 # Full backlogs backpressure rather than drop; a nonzero

@@ -1,0 +1,149 @@
+"""Multi-host Ape-X (actors/multihost.py): two REAL processes, each with
+its own actor fleet and replay shard, training in lockstep through the
+collective train step over a global 2-device gloo mesh — the pod-scale
+reading of BASELINE.json:9 ("distributed prioritized replay + sharded/
+multi-learner"), tested per SURVEY.md §4's portable-idiom rule."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# A real script file, not `python -c`: the service spawns actor processes
+# with the multiprocessing "spawn" context, which must re-import __main__.
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+
+    def main():
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        port, pid, mode = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+        from dist_dqn_tpu.parallel.distributed import initialize
+        initialize(f"localhost:{{port}}", 2, pid)
+        assert jax.device_count() == 2 and jax.local_device_count() == 1
+        import dataclasses
+        from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+        from dist_dqn_tpu.config import CONFIGS
+        if mode == "r2d2":
+            cfg = CONFIGS["r2d2"]
+            cfg = dataclasses.replace(
+                cfg,
+                network=dataclasses.replace(cfg.network, torso="mlp",
+                                            mlp_features=(32,), hidden=0,
+                                            lstm_size=16, dueling=False,
+                                            compute_dtype="float32"),
+                replay=dataclasses.replace(cfg.replay, capacity=2048,
+                                           min_fill=64, burn_in=2,
+                                           unroll_length=6,
+                                           sequence_stride=3),
+                # batch_size counts SEQUENCES, global: 8 per host here.
+                learner=dataclasses.replace(cfg.learner, batch_size=16,
+                                            n_step=2),
+            )
+            total, ipg = 1600, 16
+        else:
+            cfg = CONFIGS["apex"]
+            cfg = dataclasses.replace(
+                cfg,
+                network=dataclasses.replace(cfg.network, torso="mlp",
+                                            mlp_features=(32,), hidden=0,
+                                            dueling=False,
+                                            compute_dtype="float32"),
+                replay=dataclasses.replace(cfg.replay, capacity=4096,
+                                           min_fill=128),
+                # batch_size is GLOBAL in multi-host mode: 16 per host.
+                learner=dataclasses.replace(cfg.learner, batch_size=32,
+                                            n_step=2),
+            )
+            total, ipg = 2400, 32
+        rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                               envs_per_actor=4, total_env_steps=total,
+                               inserts_per_grad_step=ipg,
+                               sync_every_s=0.02)
+        result = run_apex(cfg, rt, log_fn=print)
+        # Agreed global cursor ended the run; each host contributed steps.
+        assert result["global_env_steps"] >= total, result
+        assert result["env_steps"] > 0
+        assert result["grad_steps"] >= 5, result
+        assert result["ring_dropped"] == 0 and result["bad_records"] == 0
+        print("MHAPEX_OK", pid, result["grad_steps"], flush=True)
+
+    if __name__ == "__main__":
+        main()
+""")
+
+
+def test_two_host_apex_split(tmp_path):
+    _run_two_hosts(tmp_path, "dqn")
+
+
+def test_two_host_apex_r2d2(tmp_path):
+    """Same lockstep machinery through the recurrent path: sequence-shard
+    PartitionSpecs, q-plane seeding, stored-state batches."""
+    _run_two_hosts(tmp_path, "r2d2")
+
+
+def _run_two_hosts(tmp_path, mode: str):
+    port = _free_port()
+    script = tmp_path / "mh_apex_worker.py"
+    script.write_text(_WORKER.format(repo=str(REPO)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(pid), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, cwd=str(REPO), text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
+        assert f"MHAPEX_OK {pid}" in out, out[-2000:]
+    # Lockstep training: both hosts ran the SAME number of collective
+    # train steps (they derive the target from the same agreed counters).
+    grads = [out.split("MHAPEX_OK")[1].split()[1] for out in outs]
+    assert grads[0] == grads[1], grads
+    # Non-zero processes compute silently; process 0 reports.
+    assert '"env_steps_per_sec_per_chip"' in outs[0]
+    assert '"env_steps_per_sec_per_chip"' not in outs[1]
+
+
+def test_agreement_limb_split_exactness():
+    """agree() must be EXACT for counters far beyond float32's 2**24
+    integer range (the psum runs in f32 on device) — pinned with 2**24+1,
+    which a straight f32 path cannot represent, on a single-process group
+    (psum over the 8 local conftest devices is the identity)."""
+    import numpy as np
+    import pytest
+
+    from dist_dqn_tpu.actors.multihost import MultihostLearner
+
+    mh = MultihostLearner()
+    vals = np.array([(1 << 37) + 12_345, 0, (1 << 24) + 1], np.int64)
+    np.testing.assert_array_equal(mh.agree(vals), vals)
+    with pytest.raises(ValueError, match="out of range"):
+        mh.agree(np.array([1 << 38]))
+    with pytest.raises(ValueError, match="out of range"):
+        mh.agree(np.array([-1]))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
