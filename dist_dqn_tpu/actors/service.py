@@ -85,6 +85,12 @@ class ApexRuntimeConfig:
     # here covering ingestion / priority / sample / train spans — the host
     # counterpart of the device xprof trace. None disables (no overhead).
     trace_path: Optional[str] = None
+    # On-device priority sampling for the host-DRAM shard (the
+    # BASELINE.json:5 wording): priority plane in accelerator memory,
+    # stratified draws via the Pallas kernel above its crossover. Items
+    # stay in host DRAM. Off by default — the C++ host tree wins below
+    # pod-scale shard sizes.
+    device_sampling: bool = False
     # Ingest-stall watchdog (SURVEY.md §5 failure detection): warn when no
     # actor record has arrived for this many seconds while the run is not
     # finished — actors may be wedged in ways process supervision can't
@@ -263,7 +269,8 @@ class ApexLearnerService:
 
         self.replay = PrioritizedHostReplay(
             cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
-            priority_eps=cfg.replay.priority_eps)
+            priority_eps=cfg.replay.priority_eps,
+            sampler="device" if rt.device_sampling else "tree")
         # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1)*alpha).
         n_act = max(self.total_actors - 1, 1)
         self.actor_eps = np.array([

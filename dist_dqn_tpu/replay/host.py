@@ -187,6 +187,95 @@ class SumTree:
         return idx - self.capacity
 
 
+class DevicePrioritySampler:
+    """On-device priority sampling for a host-DRAM shard (BASELINE.json:5:
+    the buffer shards across TPU-VM host DRAM, priority SAMPLING runs on
+    device via Pallas).
+
+    The p^alpha mass plane lives in accelerator memory as [rows, lanes];
+    host-side writes buffer as (idx, mass) pairs and apply as one donated
+    scatter right before each draw (a few KB per grad step). Draws use the
+    shared stratified sampler (ops/pallas_sampler.py) — the Pallas VMEM
+    kernel above its crossover on TPU, the XLA path elsewhere — and return
+    flat slot indices plus selected masses/total for importance weights.
+    The caller gathers the ITEMS from host DRAM; only priorities live on
+    device."""
+
+    def __init__(self, capacity: int, lanes: int = 512, seed: int = 0,
+                 use_pallas: Optional[bool] = None,
+                 interpret: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from dist_dqn_tpu.loop_common import pallas_routing
+        from dist_dqn_tpu.ops.pallas_sampler import (importance_weights,
+                                                     stratified_sample)
+        self.jax = jax
+        self.capacity = capacity
+        self.lanes = lanes
+        self.rows = -(-capacity // lanes)
+        if use_pallas is None:
+            # Platform-aware default, same crossover story as the fused
+            # loop: Pallas on TPU above ~1e5 cells, XLA otherwise.
+            use_pallas, interpret = pallas_routing(
+                self.rows * lanes >= 100_000)
+        self._plane = jnp.zeros((self.rows, lanes), jnp.float32)
+        self._pending_idx: list = []
+        self._pending_val: list = []
+        self._rng = jax.random.PRNGKey(seed)
+
+        def apply_writes(plane, idx, vals):
+            return plane.at[idx // lanes, idx % lanes].set(vals)
+
+        self._apply = jax.jit(apply_writes, donate_argnums=0)
+
+        def draw(plane, rng, batch, beta, n_valid):
+            t, b, mass, total = stratified_sample(
+                plane, rng, batch, use_pallas=use_pallas,
+                interpret=interpret)
+            w = importance_weights(mass, total, n_valid, beta)
+            return t * lanes + b, w
+
+        self._draw = jax.jit(draw, static_argnums=2)
+
+    def set(self, idx: np.ndarray, mass: np.ndarray) -> None:
+        """Buffer p^alpha mass writes (applied lazily before the next
+        draw). Last write per slot wins, as with the trees."""
+        self._pending_idx.append(np.asarray(idx, np.int32))
+        self._pending_val.append(np.asarray(mass, np.float32))
+
+    def _flush_writes(self) -> None:
+        if not self._pending_idx:
+            return
+        idx = np.concatenate(self._pending_idx)
+        vals = np.concatenate(self._pending_val)
+        self._pending_idx, self._pending_val = [], []
+        # Dedup to last-wins: XLA scatter order is unspecified for
+        # duplicate indices within one call.
+        _, last = np.unique(idx[::-1], return_index=True)
+        keep = idx.shape[0] - 1 - last
+        idx, vals = idx[keep], vals[keep]
+        # Pad to a power-of-two bucket (repeat one real pair — idempotent
+        # for .set) so the donated scatter compiles O(log) variants, not
+        # one per distinct write-batch length.
+        padded = pad_pow2(idx.shape[0])
+        if padded != idx.shape[0]:
+            pad = padded - idx.shape[0]
+            idx = np.concatenate([idx, np.repeat(idx[:1], pad)])
+            vals = np.concatenate([vals, np.repeat(vals[:1], pad)])
+        self._plane = self._apply(self._plane, idx, vals)
+
+    def sample(self, batch_size: int, beta: float, size: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (flat slot indices [S], IS weights [S])."""
+        self._flush_writes()
+        self._rng, k = self.jax.random.split(self._rng)
+        idx, w = self._draw(self._plane, k, batch_size, np.float32(beta),
+                            np.float32(size))
+        idx = np.minimum(np.asarray(idx, np.int64), size - 1)
+        return idx, np.asarray(w, np.float32)
+
+
 class PrioritizedHostReplay:
     """One prioritized replay shard over host DRAM.
 
@@ -194,15 +283,27 @@ class PrioritizedHostReplay:
     R2D2 sequences); storage is allocated lazily from the first batch's
     dtypes/shapes. ``alpha`` is folded into stored leaf mass at write time
     (hosts rewrite leaves cheaply, unlike the device path).
+
+    ``sampler="tree"`` (default) draws on the host via the C++/numpy
+    sum-tree; ``sampler="device"`` keeps the priority plane in accelerator
+    memory and draws with the Pallas/XLA stratified kernel
+    (DevicePrioritySampler) — the BASELINE.json:5 wording for the Ape-X
+    shard. Item storage stays in host DRAM either way.
     """
 
     def __init__(self, capacity: int, alpha: float = 0.6,
                  priority_eps: float = 1e-6, seed: int = 0,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None, sampler: str = "tree"):
         self.capacity = capacity
         self.alpha = alpha
         self.priority_eps = priority_eps
-        self.tree = make_sum_tree(capacity, native=native)
+        self.sampler = sampler
+        self.device_sampler = (DevicePrioritySampler(capacity, seed=seed)
+                               if sampler == "device" else None)
+        # Device mode never reads the host tree — don't pay its writes,
+        # rebuilds, or the float64 allocation for nothing.
+        self.tree = (None if self.device_sampler is not None
+                     else make_sum_tree(capacity, native=native))
         self._data: Optional[Dict[str, np.ndarray]] = None
         self._pos = 0
         self._size = 0
@@ -241,7 +342,11 @@ class PrioritizedHostReplay:
             p = np.abs(np.asarray(priorities, np.float64)) \
                 + self.priority_eps
             self._max_priority = max(self._max_priority, float(p.max()))
-        self.tree.set(idx, p ** self.alpha)
+        mass = p ** self.alpha
+        if self.device_sampler is not None:
+            self.device_sampler.set(idx, mass)
+        else:
+            self.tree.set(idx, mass)
         self.added += batch
         self._slot_gen[idx] = self.added
         self._pos = int((self._pos + batch) % self.capacity)
@@ -252,14 +357,18 @@ class PrioritizedHostReplay:
         """Stratified prioritized sample -> (items, indices, IS weights)."""
         if self._size == 0:
             raise ValueError("sample() on an empty replay shard")
-        total = self.tree.total
-        strata = (np.arange(batch_size)
-                  + self._rng.uniform(size=batch_size)) / batch_size
-        idx = self.tree.sample(strata * total)
-        idx = np.minimum(idx, self._size - 1)
-        p_sel = self.tree.get(idx) / total
-        weights = (self._size * np.maximum(p_sel, 1e-12)) ** (-beta)
-        weights = (weights / weights.max()).astype(np.float32)
+        if self.device_sampler is not None:
+            idx, weights = self.device_sampler.sample(batch_size, beta,
+                                                      self._size)
+        else:
+            total = self.tree.total
+            strata = (np.arange(batch_size)
+                      + self._rng.uniform(size=batch_size)) / batch_size
+            idx = self.tree.sample(strata * total)
+            idx = np.minimum(idx, self._size - 1)
+            p_sel = self.tree.get(idx) / total
+            weights = (self._size * np.maximum(p_sel, 1e-12)) ** (-beta)
+            weights = (weights / weights.max()).astype(np.float32)
         items = {k: v[idx] for k, v in self._data.items()}
         self.sampled += batch_size
         return items, idx, weights
@@ -283,7 +392,11 @@ class PrioritizedHostReplay:
             if idx.size == 0:
                 return
         self._max_priority = max(self._max_priority, float(p.max()))
-        self.tree.set(idx, p ** self.alpha)
+        mass = p ** self.alpha
+        if self.device_sampler is not None:
+            self.device_sampler.set(idx, mass)
+        else:
+            self.tree.set(idx, mass)
 
 
 class UniformHostReplay:

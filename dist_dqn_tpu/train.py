@@ -246,6 +246,10 @@ def main():
                         help="apex runtime: listen for remote actors "
                              "(actors/remote.py) on this port; 0 = "
                              "ephemeral")
+    parser.add_argument("--device-sampling", action="store_true",
+                        help="apex runtime: sample the host replay shard's "
+                             "priorities ON DEVICE (Pallas stratified "
+                             "kernel; items stay in host DRAM)")
     parser.add_argument("--remote-actor-mode", choices=("local", "external"),
                         default="local",
                         help="local: the service spawns its remote actors "
@@ -295,7 +299,8 @@ def main():
             num_remote_actors=args.num_remote_actors,
             spawn_remote_actors=args.remote_actor_mode == "local",
             learner_devices=args.learner_devices,
-            trace_path=args.trace_path)
+            trace_path=args.trace_path,
+            device_sampling=args.device_sampling)
         print(json.dumps(run_apex(cfg, rt)))
         return
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
