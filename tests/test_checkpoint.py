@@ -118,3 +118,33 @@ def test_standalone_evaluate_checkpoint(tmp_path):
     # Undertrained but must be a real playable policy returning a finite
     # CartPole return (episodes end between 1 and 500 steps).
     assert 1.0 <= out["eval_return"] <= 500.0
+
+
+def test_standalone_evaluate_checkpoint_recurrent(tmp_path):
+    """The R2D2 branch of evaluate_checkpoint: restore an LSTM learner
+    checkpoint and play carry-threaded greedy episodes."""
+    from dist_dqn_tpu.evaluate import evaluate_checkpoint
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="cartpole",
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    lstm_size=16, dueling=False,
+                                    remat_torso=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=64,
+                                   burn_in=2, unroll_length=6,
+                                   sequence_stride=3),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2),
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        eval_every_steps=10**9,
+    )
+    ckpt_dir = str(tmp_path / "r2d2_run")
+    train(cfg, total_env_steps=2000, chunk_iters=125,
+          log_fn=lambda s: None, checkpoint_dir=ckpt_dir)
+    out = evaluate_checkpoint(cfg, ckpt_dir, episodes=3, seed=2)
+    assert out["frames"] >= 2000 and out["config"] == "r2d2"
+    assert 1.0 <= out["eval_return"] <= 500.0
