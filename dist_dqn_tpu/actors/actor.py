@@ -23,9 +23,13 @@ from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
 from dist_dqn_tpu.envs.gym_adapter import make_host_env
 
 
-def _step_and_encode(env, actions, actor_id: int, t: int):
+def _step_and_encode(env, actions, actor_id: int, t: int,
+                     compress: "bool | str" = False):
     """Step the vector env and build the step record (shared by the shm
-    and TCP transports, so the record schema cannot diverge).
+    and TCP transports, so the record schema cannot diverge). The TCP
+    (DCN) caller passes compress="auto" — big pixel records shrink
+    severalfold under zlib before crossing hosts; shm stays uncompressed
+    (intra-host memcpy beats zlib).
 
     Returns (obs, t + 1, payload).
     """
@@ -35,7 +39,8 @@ def _step_and_encode(env, actions, actor_id: int, t: int):
          "terminated": terminated.astype(np.uint8),
          "truncated": truncated.astype(np.uint8),
          "next_obs": next_obs},
-        {"kind": "step", "actor": actor_id, "t": t + 1})
+        {"kind": "step", "actor": actor_id, "t": t + 1},
+        compress=compress)
     return obs, t + 1, payload
 
 
@@ -98,7 +103,8 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     def connect_and_hello(obs, t):
         client = TcpRecordClient(tuple(address))
         client.push(encode_arrays(
-            {"obs": obs}, {"kind": "hello", "actor": actor_id, "t": t}))
+            {"obs": obs}, {"kind": "hello", "actor": actor_id, "t": t},
+            compress="auto"))
         return client
 
     obs = env.reset()
@@ -124,7 +130,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             continue
         arrays, _ = decode_arrays(reply)
         obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
-                                           t)
+                                           t, compress="auto")
         steps += num_envs
         if not client.push(payload):
             client.close()

@@ -187,8 +187,16 @@ class ShmMailbox:
 _CRC_ENABLED = os.environ.get("DQN_TRANSPORT_CRC") == "1"
 
 
+# Compress records above this body size when compress="auto" — pixel
+# observation stacks (84x84x4 uint8, mostly background) shrink severalfold
+# under zlib-1, a big win on DCN links; small vector records are not worth
+# the CPU. Intra-host shm callers keep compress=False (memcpy beats zlib).
+_COMPRESS_AUTO_MIN = 16 * 1024
+
+
 def encode_arrays(arrays: Dict[str, np.ndarray],
-                  meta: Optional[Dict] = None) -> bytes:
+                  meta: Optional[Dict] = None,
+                  compress: "bool | str" = False) -> bytes:
     body_parts = [np.ascontiguousarray(v).tobytes()
                   for v in arrays.values()]
     header = {
@@ -196,6 +204,14 @@ def encode_arrays(arrays: Dict[str, np.ndarray],
         "arrays": [[k, v.dtype.str, list(v.shape)]
                    for k, v in arrays.items()],
     }
+    body_len = sum(len(p) for p in body_parts)
+    if compress == "auto":
+        compress = body_len >= _COMPRESS_AUTO_MIN
+    if compress:
+        import zlib
+        blob = zlib.compress(b"".join(body_parts), 1)
+        header["z"] = body_len  # uncompressed body length (decode check)
+        body_parts = [blob]
     if _CRC_ENABLED:
         # Frame: len(hb) | hb | crc32(hb + body) | body. The checksum
         # covers the HEADER bytes too — a flipped actor id or shape digit
@@ -217,7 +233,8 @@ def decode_arrays(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict]:
     header = json.loads(buf[4:4 + hlen].decode())
     off = 4 + hlen
     if header.get("crc"):
-        # Verify BEFORE materializing arrays: no copies of corrupt data.
+        # Verify BEFORE decompressing/materializing: the checksum covers
+        # the WIRE form (header + compressed blob when compressed).
         import zlib
         (want,) = struct.unpack_from("<I", buf, off)
         off += 4
@@ -227,6 +244,20 @@ def decode_arrays(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict]:
             raise ValueError(
                 f"transport record CRC mismatch (got {got:#010x}, frame "
                 f"says {want:#010x}): torn or corrupted record")
+    if "z" in header:
+        import zlib
+        # Untrusted input (the TCP listener may face other hosts): bound
+        # the inflate by the declared size so a deflate bomb fails cheaply
+        # as one bad record instead of exhausting learner memory; zero-copy
+        # view into the wire buffer.
+        want_len = int(header["z"])
+        d = zlib.decompressobj()
+        body = d.decompress(memoryview(buf)[off:], want_len + 1)
+        if len(body) != want_len or d.unconsumed_tail:
+            raise ValueError(
+                f"transport record decompressed to {len(body)}(+) bytes, "
+                f"header says {want_len}")
+        buf, off = body, 0
     out: Dict[str, np.ndarray] = {}
     for name, dtype, shape in header["arrays"]:
         dt = np.dtype(dtype)

@@ -59,6 +59,50 @@ def test_codec_crc_detects_corruption():
         decode_arrays(bytes(hdr))
 
 
+def test_codec_compression_roundtrip_and_auto_threshold():
+    """compress=True shrinks pixel-like records severalfold; "auto"
+    compresses big bodies and skips small ones; decode is transparent and
+    the CRC covers the wire (compressed) form."""
+    import pytest
+
+    big = {"obs": np.zeros((8, 84, 84, 4), np.uint8),
+           "reward": np.arange(8, dtype=np.float32)}
+    big["obs"][:, 10:20, 10:20, :] = 255
+    plain = encode_arrays(big, {"kind": "step", "actor": 1, "t": 2})
+    packed = encode_arrays(big, {"kind": "step", "actor": 1, "t": 2},
+                           compress=True)
+    assert len(packed) < len(plain) // 4
+    arrays, meta = decode_arrays(packed)
+    np.testing.assert_array_equal(arrays["obs"], big["obs"])
+    np.testing.assert_allclose(arrays["reward"], big["reward"])
+    assert meta == {"kind": "step", "actor": 1, "t": 2}
+
+    auto_big = encode_arrays(big, {"kind": "step", "actor": 1, "t": 2},
+                             compress="auto")
+    assert len(auto_big) == len(packed)            # over threshold
+    small = {"x": np.arange(16, dtype=np.float32)}
+    assert len(encode_arrays(small, compress="auto")) \
+        == len(encode_arrays(small))               # under: untouched
+
+    # Corruption inside the compressed blob still dies at the CRC gate.
+    bad = bytearray(packed)
+    bad[-3] ^= 0x55
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        decode_arrays(bytes(bad))
+
+    # Decompression-bomb guard: a record whose blob inflates past the
+    # declared size fails at the bound, not after inflating gigabytes.
+    import json as _json
+    import struct as _struct
+    import zlib as _zlib
+    bomb_body = _zlib.compress(b"\x00" * (1 << 20), 1)
+    hdr = {"meta": {}, "arrays": [["x", "|u1", [64]]], "z": 64}
+    hb = _json.dumps(hdr).encode()
+    bomb = _struct.pack("<I", len(hb)) + hb + bomb_body
+    with pytest.raises(ValueError, match="decompressed"):
+        decode_arrays(bomb)
+
+
 def test_ring_fifo_and_overflow():
     name = _name()
     ring = ShmRing(name, capacity=1 << 12, create=True)
