@@ -3,13 +3,20 @@
 Runs the fused on-device training loop (act -> PixelPong step -> replay ->
 learner update cadence) on whatever single accelerator is present and
 reports the driver's north-star metric (BASELINE.json:2,5):
-env-steps/sec/chip against the 50k/sec/chip Ape-X target.
+env-steps/sec/chip against the 50k/sec/chip Ape-X target, plus MFU
+(achieved model FLOP/s from XLA's cost analysis of the compiled chunk
+over the chip's bf16 peak — utils/flops.py).
 
 Timing is fenced with ``device_get`` on a chunk metric: on the remote-
 tunnel (axon) platform ``block_until_ready`` returns before execution
 finishes, so only a host-materialized value proves the chunk ran.
 
-Prints exactly ONE JSON line:
+Capture-proofing (VERDICT round 1, weak #2): this box's TPU tunnel can
+wedge such that ANY backend touch hangs forever, and round 1's driver
+capture died as a raw traceback. Every failure path here — backend-init
+hang, mid-run hang, any exception — emits exactly ONE structured JSON
+line (with an "error" field) before exiting nonzero, so a driver capture
+is always parseable:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 from __future__ import annotations
@@ -18,24 +25,107 @@ import dataclasses
 import json
 import os
 import sys
+import threading
 import time
 
 BASELINE_ENV_STEPS_PER_SEC_PER_CHIP = 50_000.0  # BASELINE.json:5 target
+METRIC = "env_steps_per_sec_per_chip"
+UNIT = ("env-steps/sec/chip (synthetic 84x84 Atari-shaped pixel env,"
+        " Nature CNN, fused on-device actor+learner)")
+
+_emit_lock = threading.Lock()
+_emitted = False
 
 
-def main():
-    import jax
+def _emit(payload: dict) -> None:
+    """Print the single contract JSON line (first caller wins)."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return
+        _emitted = True
+        print(json.dumps(payload), flush=True)
 
+
+def _emit_error(stage: str, err: str) -> None:
+    _emit({"metric": METRIC, "value": None, "unit": UNIT,
+           "vs_baseline": None, "error": f"{stage}: {err}"})
+
+
+def _env_float(name: str, default: float) -> float:
+    """Parse a float env override; a malformed value must not be able to
+    break the one-JSON-line contract, so it falls back to the default."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _watchdog(stage: str, seconds: float) -> threading.Timer:
+    """Arm a timer that emits an error line and hard-exits; caller cancels.
+
+    A hard ``os._exit`` is deliberate: a wedged tunnel blocks the main
+    thread inside an uninterruptible C call, so no exception-based unwind
+    can run — getting the JSON line out is all that matters.
+    """
+
+    def fire():
+        _emit_error(stage, f"no progress within {seconds:.0f}s "
+                           "(wedged TPU tunnel?)")
+        sys.stdout.flush()
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def main() -> int:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+
+    guard = _watchdog("backend-init", _env_float("BENCH_BACKEND_TIMEOUT_S",
+                                                 180.0))
+    try:
+        import jax
+
+        if smoke:
+            # The identical code path must smoke-test on any dev box without
+            # touching (and possibly wedging on) the tunnel platform.
+            jax.config.update("jax_platforms", "cpu")
+        device = jax.devices()[0]
+    except Exception as e:  # noqa: BLE001 — contract: never a raw traceback
+        _emit_error("backend-init", repr(e))
+        return 2
+    finally:
+        guard.cancel()
+
+    guard = _watchdog("measurement", _env_float("BENCH_TOTAL_TIMEOUT_S",
+                                                900.0))
+    try:
+        value, extras = _measure(jax, device, smoke)
+    except Exception as e:  # noqa: BLE001
+        _emit_error("measurement", repr(e))
+        return 2
+    finally:
+        guard.cancel()
+
+    _emit({"metric": METRIC, "value": round(value, 1), "unit": UNIT,
+           "vs_baseline": round(value / BASELINE_ENV_STEPS_PER_SEC_PER_CHIP,
+                                6), **extras})
+    return 0
+
+
+def _measure(jax, device, smoke: bool):
     from dist_dqn_tpu.config import CONFIGS
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
     from dist_dqn_tpu.train_loop import make_fused_train
+    from dist_dqn_tpu.utils import flops as flops_util
 
-    # BENCH_SMOKE=1 shrinks every dimension so the identical code path can be
-    # smoke-tested on a CPU dev box; default sizes target a real TPU chip
-    # (512 env lanes saturate the v5e MXU on the Nature-CNN batch, measured
-    # ~487k env-steps/sec/chip).
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    # BENCH_SMOKE=1 shrinks every dimension; default sizes target a real TPU
+    # chip (512 env lanes saturate the v5e MXU on the Nature-CNN batch,
+    # measured ~487k env-steps/sec/chip in round 1).
     num_envs = 8 if smoke else 512
     chunk = 20 if smoke else 200
     # ~25 chunks x 200 iters x 512 envs ~= 2.5M env steps: several seconds
@@ -64,24 +154,26 @@ def main():
         return float(jax.device_get(metrics["loss"]))
 
     carry = init(jax.random.PRNGKey(0))
-    for _ in range(2):  # compile + fill past min_fill into steady state
-        carry, metrics = run(carry, chunk)
+    # AOT-compile so the same Compiled object yields the cost analysis the
+    # MFU number is derived from.
+    compiled = run.lower(carry, chunk).compile()
+    flops_per_chunk = flops_util.compiled_flops(compiled)
+    for _ in range(2):  # warmup + fill past min_fill into steady state
+        carry, metrics = compiled(carry)
         fence(metrics)
 
     t0 = time.perf_counter()
     for _ in range(measure_chunks):
-        carry, metrics = run(carry, chunk)
+        carry, metrics = compiled(carry)
     fence(metrics)
     dt = time.perf_counter() - t0
 
     value = measure_chunks * chunk * num_envs / dt
-    print(json.dumps({
-        "metric": "env_steps_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "env-steps/sec/chip (synthetic 84x84 Atari-shaped pixel env,"
-                " Nature CNN, fused on-device actor+learner)",
-        "vs_baseline": round(value / BASELINE_ENV_STEPS_PER_SEC_PER_CHIP, 3),
-    }))
+    extras = {"platform": device.platform,
+              "device_kind": getattr(device, "device_kind", "unknown")}
+    extras.update(flops_util.mfu_fields(flops_per_chunk, measure_chunks, dt,
+                                        device))
+    return value, extras
 
 
 if __name__ == "__main__":

@@ -86,27 +86,34 @@ def _r2d2_case(cfg):
 
 def bench_config(name: str, iters: int) -> dict:
     from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.utils import flops as flops_util
 
     cfg = CONFIGS[name]
     if cfg.network.lstm_size:
         state, step, args = _r2d2_case(cfg)
     else:
         state, step, args = _feedforward_case(cfg)
-    state, _ = step(state, *args)  # compile
-    state, _ = step(state, *args)  # one cached-dispatch warmup
+    # AOT-compile so the timed Compiled object also yields the op-census
+    # FLOPs the MFU column is derived from (utils/flops.py).
+    compiled = step.lower(state, *args).compile()
+    flops_per_step = flops_util.compiled_flops(compiled)
+    state, _ = compiled(state, *args)  # one cached-dispatch warmup
     jax.device_get(state.steps)    # fence before timing
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, metrics = step(state, *args)
+        state, metrics = compiled(state, *args)
     jax.device_get(state.steps)    # fence: steps depends on every iteration
     dt = time.perf_counter() - t0
-    return {
+    device = jax.devices()[0]
+    out = {
         "config": name,
         "grad_steps_per_sec": round(iters / dt, 2),
         "batch_size": cfg.learner.batch_size,
         "examples_per_sec": round(iters * cfg.learner.batch_size / dt, 1),
-        "platform": jax.devices()[0].platform,
+        "platform": device.platform,
     }
+    out.update(flops_util.mfu_fields(flops_per_step, iters, dt, device))
+    return out
 
 
 def main():
