@@ -25,6 +25,8 @@ never import this module.
 """
 from __future__ import annotations
 
+import os
+import threading
 from typing import Tuple
 
 import numpy as np
@@ -113,16 +115,22 @@ class MultihostLearner:
     # Counter psums run in float32 on device (the repo never enables x64),
     # where integers are exact only below 2**24 — far too small for pod
     # counters. Each value is therefore split into base-2**14 limbs before
-    # the collective: the low-limb sum stays < 2**23 for up to 512 hosts
-    # and the high-limb sum equals total // 2**14 (< 2**24 while the true
-    # total is < 2**38 ≈ 2.7e11), so recombination is EXACT up to 2**38.
+    # the collective: the low-limb SUM stays < 2**24 for up to 1024 hosts
+    # (each low limb < 2**14), and the high-limb SUM stays < 2**24 because
+    # each host's value is bounded by 2**38 // num_processes (so the summed
+    # high limbs total < 2**38 / 2**14 = 2**24) — recombination is EXACT
+    # for any GLOBAL total up to 2**38 ≈ 2.7e11.
     _LIMB = 1 << 14
 
     def agree(self, values: np.ndarray) -> np.ndarray:
         """Exact psum of small non-negative integer counters across
-        processes (values < 2**38; see limb note above). BLOCKS until every
-        process joins — see module docstring for why this makes agreement
-        calls pair 1:1."""
+        processes. BLOCKS until every process joins — see module docstring
+        for why this makes agreement calls pair 1:1 — but only up to
+        ``DQN_AGREE_TIMEOUT_S`` (default 600s): a peer that died with an
+        uncaught error would otherwise wedge every surviving host inside
+        the collective forever. On timeout the process raises (and exits),
+        which in turn times out the peers' agreements — the whole fleet
+        fails loudly instead of hanging silently."""
         jax = self.jax
         P = self.P
         if self._agree is None:
@@ -130,8 +138,19 @@ class MultihostLearner:
                 lambda x: jax.lax.psum(x, "dp"), mesh=self.mesh,
                 in_specs=P("dp"), out_specs=P(), check_vma=False))
         ints = np.asarray(values, np.int64)
-        if (ints < 0).any() or (ints >= 1 << 38).any():
-            raise ValueError(f"agree() counters out of range: {ints}")
+        # Low-limb exactness needs nprocs * 2**14 < 2**24 — enforce the
+        # documented 1024-host ceiling rather than silently rounding.
+        if self.nprocs > 1024:
+            raise ValueError(
+                f"agree() limb split is exact only up to 1024 hosts "
+                f"(group has {self.nprocs}); widen the limb split first")
+        # Per-host bound scaled by host count so the GLOBAL sum keeps the
+        # high-limb exactness guarantee (see limb note above).
+        limit = (1 << 38) // max(self.nprocs, 1)
+        if (ints < 0).any() or (ints >= limit).any():
+            raise ValueError(
+                f"agree() counters out of per-host range [0, {limit}): "
+                f"{ints}")
         limbs = np.stack([ints // self._LIMB, ints % self._LIMB]
                          ).astype(np.float32)  # [2, k]
         # Exactly one contributing row per PROCESS: device 0 carries the
@@ -140,7 +159,28 @@ class MultihostLearner:
         local[0] = limbs
         garr = self.jax.make_array_from_process_local_data(
             self.NamedSharding(self.mesh, P("dp")), local)
-        out = np.asarray(self.jax.device_get(self._agree(garr)))[0]
+        result: dict = {}
+
+        def collective():
+            try:
+                result["out"] = np.asarray(
+                    self.jax.device_get(self._agree(garr)))[0]
+            except Exception as e:  # noqa: BLE001 — re-raised on the caller
+                result["err"] = e
+
+        timeout_s = float(os.environ.get("DQN_AGREE_TIMEOUT_S", "600"))
+        worker = threading.Thread(target=collective, daemon=True)
+        worker.start()
+        # <= 0 means "no timeout" (block forever, the pre-fix behavior).
+        worker.join(timeout_s if timeout_s > 0 else None)
+        if worker.is_alive():
+            raise RuntimeError(
+                f"agreement collective incomplete after {timeout_s:.0f}s — "
+                "a peer host likely died; failing fast instead of wedging "
+                "the fleet (DQN_AGREE_TIMEOUT_S to tune)")
+        if "err" in result:
+            raise result["err"]
+        out = result["out"]
         return out[0].astype(np.int64) * self._LIMB \
             + out[1].astype(np.int64)
 

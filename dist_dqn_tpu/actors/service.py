@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 import uuid
 from collections import deque
@@ -296,6 +297,11 @@ class ApexLearnerService:
         self._ckpt = None
         self._eval_env = None
         self._next_eval = rt.eval_every_steps or float("inf")
+        # Async eval (multi-host): worker thread + its pending result and a
+        # dedicated rng so eval never races the main loop's key stream.
+        self._eval_thread: Optional[threading.Thread] = None
+        self._eval_result = None
+        self._eval_rng = None
         self.bad_records = 0
         self.actor_restarts = 0
         from dist_dqn_tpu.utils.trace import make_tracer
@@ -820,15 +826,21 @@ class ApexLearnerService:
         while self._in_flight:
             self._finalize_train()
 
-    def _evaluate(self) -> float:
-        """Greedy episodes on a service-owned env (mean undiscounted
-        return); the recurrent policy threads its own eval carry."""
+    def _evaluate_impl(self, params) -> tuple:
+        """Greedy episodes on a service-owned env; the recurrent policy
+        threads its own eval carry. Returns (mean undiscounted return,
+        step-capped episode count). Uses only eval-owned mutable state
+        (``_eval_env``/``_eval_rng``) plus the given param snapshot, so it
+        is safe to run from the async eval thread while the main loop keeps
+        training."""
         from dist_dqn_tpu.envs.gym_adapter import make_host_env
         jnp = self.jnp
         n = self.rt.eval_episodes
         if self._eval_env is None:
             self._eval_env = make_host_env(self.rt.host_env, n,
                                            seed=10_000 + self.cfg.seed)
+        if self._eval_rng is None:
+            self._eval_rng = self.jax.random.PRNGKey(self.cfg.seed + 991)
         env = self._eval_env
         obs = env.reset()
         carry = self.net.initial_state(n) if self.recurrent else None
@@ -836,13 +848,12 @@ class ApexLearnerService:
         alive = np.ones((n,), bool)
         eps = jnp.float32(0.001)
         for _ in range(10_000):
-            self._rng, k = self.jax.random.split(self._rng)
+            self._eval_rng, k = self.jax.random.split(self._eval_rng)
             if self.recurrent:
-                carry, actions, _, _ = self._act(self._policy_params, carry,
+                carry, actions, _, _ = self._act(params, carry,
                                                  jnp.asarray(obs), k, eps)
             else:
-                actions = self._act(self._policy_params, jnp.asarray(obs), k,
-                                    eps)
+                actions = self._act(params, jnp.asarray(obs), k, eps)
             obs, _, reward, term, trunc = env.step(np.asarray(actions))
             returns += np.asarray(reward) * alive
             done = np.logical_or(term, trunc)
@@ -852,11 +863,55 @@ class ApexLearnerService:
             alive &= ~done
             if not alive.any():
                 break
-        if alive.any():
+        return float(returns.mean()), float(alive.sum())
+
+    def _evaluate(self) -> float:
+        """Synchronous eval (single-host path)."""
+        ret, truncated = self._evaluate_impl(self._policy_params)
+        if truncated:
             # Step-capped: record the truncation so a downward-biased
             # eval_return is not mistaken for a policy regression.
-            self.log.record(eval_episodes_truncated=float(alive.sum()))
-        return float(returns.mean())
+            self.log.record(eval_episodes_truncated=truncated)
+        return ret
+
+    def _start_async_eval(self):
+        """Multi-host eval must not stall the pod: an inline eval on host 0
+        blocks every peer at its next agreement collective for the whole
+        eval (up to 10k env steps). Evaluate from the host param mirror in
+        a background thread instead; the collective cadence continues and
+        the result is logged when the thread finishes."""
+        if self._eval_thread is not None and self._eval_thread.is_alive():
+            self.log.record(eval_skipped=1.0)  # previous eval still running
+            return
+        params = self._policy_params  # mirror tuple is replaced, not mutated
+        at_steps = self._progress()
+
+        def work():
+            try:
+                self._eval_result = (at_steps, self._evaluate_impl(params))
+            except Exception as e:  # noqa: BLE001 — surfaced by the poller
+                self._eval_result = (at_steps, e)
+
+        self._eval_thread = threading.Thread(target=work, daemon=True,
+                                             name="apex-eval")
+        self._eval_thread.start()
+
+    def _poll_async_eval(self):
+        # Load-then-conditionally-clear: an unconditional swap could race
+        # the worker's single store and drop a just-finished result.
+        pending = self._eval_result
+        if pending is None:
+            return
+        self._eval_result = None
+        at_steps, res = pending
+        if isinstance(res, Exception):
+            self.log.log_fn(f"# async eval failed: {res!r}")
+            return
+        ret, truncated = res
+        if truncated:
+            self.log.record(eval_episodes_truncated=truncated)
+        self.log.record(env_steps=at_steps, eval_return=ret)
+        self.log.flush()
 
     def _progress(self) -> int:
         """Run-cursor: local env steps, or the group-agreed GLOBAL count in
@@ -915,16 +970,22 @@ class ApexLearnerService:
                         + self.rt.eval_every_steps
                     self._finalize_all_train()
                     # Eval is a process-local program: in multi-host mode
-                    # only the reporting host plays episodes; all hosts
-                    # advance _next_eval identically (agreed counter).
-                    if not self.distributed \
-                            or self.jax.process_index() == 0:
+                    # only the reporting host plays episodes — in a
+                    # BACKGROUND thread, so its peers are not stalled at
+                    # their next agreement collective for the eval's
+                    # duration; all hosts advance _next_eval identically
+                    # (agreed counter).
+                    if self.distributed:
+                        if self.jax.process_index() == 0:
+                            self._start_async_eval()
+                    else:
                         with self.tracer.span("eval"):
                             eval_return = self._evaluate()
                         self.log.record(env_steps=self._progress(),
                                         eval_return=eval_return)
                         self.log.flush()
                     last_log = time.perf_counter()
+                self._poll_async_eval()
                 if not drained:
                     time.sleep(0.0002)
                 now = time.perf_counter()
@@ -946,6 +1007,9 @@ class ApexLearnerService:
                     last_log = now
             self._flush_pending(force=True)
             self._finalize_all_train()
+            if self._eval_thread is not None:
+                self._eval_thread.join(timeout=60)
+                self._poll_async_eval()
             if self._ckpt is not None:
                 self._ckpt.save(self._progress(), self.state)
                 self._ckpt.close()

@@ -143,8 +143,12 @@ def make_sum_tree(capacity: int, native: Optional[bool] = None):
                 raise
             if not _fallback_warned:
                 _fallback_warned = True
-                print(f"# native sum-tree unavailable ({e!r}); "
-                      "using numpy tree")
+                # warnings (not print): multi-host / JSON-consuming runs
+                # must not get a bare stdout line from every process.
+                import warnings
+
+                warnings.warn(f"native sum-tree unavailable ({e!r}); "
+                              "using numpy tree", RuntimeWarning)
     return SumTree(capacity)
 
 
@@ -272,8 +276,17 @@ class DevicePrioritySampler:
         self._rng, k = self.jax.random.split(self._rng)
         idx, w = self._draw(self._plane, k, batch_size, np.float32(beta),
                             np.float32(size))
-        idx = np.minimum(np.asarray(idx, np.int64), size - 1)
-        return idx, np.asarray(w, np.float32)
+        idx = np.asarray(idx, np.int64)
+        w = np.asarray(w, np.float32)
+        # A draw can land past the written region only through fp boundary
+        # pathology on a zero-mass cell. Clamping alone would pair slot
+        # size-1 with the OUT-OF-RANGE cell's IS weight; zero the weight
+        # too so the substituted item contributes nothing to the loss.
+        oob = idx >= size
+        if oob.any():
+            idx = np.minimum(idx, size - 1)
+            w = np.where(oob, np.float32(0.0), w)
+        return idx, w
 
 
 class PrioritizedHostReplay:
