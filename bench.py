@@ -103,6 +103,9 @@ def main() -> int:
     guard = _watchdog("measurement", _env_float("BENCH_TOTAL_TIMEOUT_S",
                                                 900.0))
     try:
+        from dist_dqn_tpu.utils.device_cleanup import install
+
+        install()  # SIGTERM'd bench must release its device grant
         value, extras = _measure(jax, device, smoke)
     except Exception as e:  # noqa: BLE001
         _emit_error("measurement", repr(e))

@@ -1030,5 +1030,8 @@ class ApexLearnerService:
 
 def run_apex(cfg: ExperimentConfig, rt: ApexRuntimeConfig, log_fn=print):
     """Convenience entry: build the service, run to completion."""
+    from dist_dqn_tpu.utils.device_cleanup import install as _install_cleanup
+
+    _install_cleanup()  # SIGTERM'd service must release its device grant
     service = ApexLearnerService(cfg, rt, log_fn=log_fn)
     return service.run()

@@ -258,6 +258,10 @@ def main():
                              "workers started on other hosts via "
                              "python -m dist_dqn_tpu.actors.remote")
     args = parser.parse_args()
+    # SIGTERM/exit device release: a killed run must not orphan its device
+    # grant (the round-1 tunnel wedge, utils/device_cleanup.py).
+    from dist_dqn_tpu.utils.device_cleanup import install as _install_cleanup
+    _install_cleanup()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     if args.coordinator:

@@ -1,0 +1,104 @@
+"""Device-release hygiene for SIGTERM/exit: don't wedge the shared tunnel.
+
+Round 1 lost half its TPU evidence to one event: a SIGTERM'd device-side
+run left the remote (axon) tunnel's pool grant stuck, after which every
+``jax.devices()`` on this box hung for hours (BASELINE.md "Measurement
+note"). The runtime had no cleanup path at all.
+
+``install()`` registers a SIGTERM handler + atexit hook that drops every
+live device buffer, clears JAX's compiled/program caches and asks the
+backends to shut down before the process dies, so a politely-terminated
+run releases its device grant instead of orphaning it.
+
+Honest limits: a handler only runs when the main thread is executing
+Python bytecode — a process SIGTERM'd while *blocked inside* an
+uninterruptible device RPC cannot run it (SIGKILL never can). This makes
+the polite-kill path safe; un-wedging after a hard kill remains a
+pool-operator action (documented in .claude/skills/verify/SKILL.md).
+
+SIGINT is deliberately left alone: Ctrl-C should stay a KeyboardInterrupt
+(clean Python unwind through ``finally`` blocks), and the atexit hook
+still runs device cleanup on that path.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import threading
+
+_installed = False
+_lock = threading.Lock()
+
+
+def _release_devices(log_fn=None) -> None:
+    """Best-effort device release; every step tolerates a dead backend."""
+    import jax
+
+    try:
+        for arr in jax.live_arrays():
+            try:
+                arr.delete()
+            except Exception:  # noqa: BLE001 — deleted/donated already
+                pass
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Tears down backend clients (and with them any pool grants the
+        # client protocol releases on close). Present in current jax;
+        # guarded because it is not a stable API.
+        jax.clear_backends()
+    except Exception:  # noqa: BLE001
+        pass
+    if log_fn is not None:
+        try:
+            log_fn("# device buffers released")
+        except Exception:  # noqa: BLE001
+            pass
+    # The SIGTERM path ends in os._exit, which discards buffered stdio —
+    # flush here so the cleanup notice (and any buffered JSON log lines)
+    # survive on block-buffered stdout.
+    try:
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def install(log_fn=None) -> None:
+    """Idempotently register the SIGTERM handler + atexit release hook.
+
+    Call once near the top of any entry point that will touch an
+    accelerator (train CLI, apex service, benches).
+    """
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+
+    atexit.register(_release_devices, log_fn)
+
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def on_term(signum, frame):
+        _release_devices(log_fn)
+        # Chain a pre-existing Python-level handler; otherwise exit with
+        # the conventional fatal-signal status (atexit will not run —
+        # cleanup already did).
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+        else:
+            os._exit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:
+        # Not the main thread (e.g. installed from a worker): atexit-only.
+        pass

@@ -8,6 +8,10 @@ import numpy as np
 from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
 from dist_dqn_tpu.config import CONFIGS
 
+import pytest
+
+
+pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
 def test_apex_split_end_to_end():
     cfg = CONFIGS["apex"]

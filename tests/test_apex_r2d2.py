@@ -9,6 +9,8 @@ from dist_dqn_tpu.actors.assembler import SequenceAssembler
 from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
 from dist_dqn_tpu.config import CONFIGS
 
+import pytest
+
 
 def _feed(asm, steps, lanes=1, dones=(), lstm=4):
     for t in range(steps):
@@ -114,6 +116,7 @@ def test_sequence_assembler_multilane_independent():
     assert lane_of.sum() == 3              # both lanes emitted
 
 
+@pytest.mark.slow
 def test_apex_r2d2_split_end_to_end():
     cfg = CONFIGS["r2d2"]
     cfg = dataclasses.replace(

@@ -9,6 +9,10 @@ from dist_dqn_tpu.envs import make_jax_env
 from dist_dqn_tpu.models import build_network
 from dist_dqn_tpu.train_loop import make_fused_train
 
+import pytest
+
+
+pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
 def test_atari_config_fused_smoke():
     cfg = CONFIGS["atari"]

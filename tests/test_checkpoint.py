@@ -12,6 +12,8 @@ from dist_dqn_tpu.config import CONFIGS, LearnerConfig
 from dist_dqn_tpu.models.qnets import QNetwork
 from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
+import pytest
+
 
 def _learner_state(seed=0):
     net = QNetwork(num_actions=3, torso="mlp", mlp_features=(16,), hidden=0)
@@ -51,6 +53,7 @@ def test_checkpointer_retention_and_cadence(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_train_resumes_from_checkpoint(tmp_path):
     from dist_dqn_tpu.train import train
 
@@ -90,6 +93,7 @@ def test_train_resumes_from_checkpoint(tmp_path):
     assert resumed3 and resumed3[0]["resumed_at_frames"] == 6000
 
 
+@pytest.mark.slow
 def test_standalone_evaluate_checkpoint(tmp_path):
     """dist_dqn_tpu.evaluate loads what train() saved and plays greedy
     episodes with no training machinery (the deploy-side surface)."""
@@ -120,6 +124,7 @@ def test_standalone_evaluate_checkpoint(tmp_path):
     assert 1.0 <= out["eval_return"] <= 500.0
 
 
+@pytest.mark.slow
 def test_standalone_evaluate_checkpoint_recurrent(tmp_path):
     """The R2D2 branch of evaluate_checkpoint: restore an LSTM learner
     checkpoint and play carry-threaded greedy episodes."""

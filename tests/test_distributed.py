@@ -87,6 +87,7 @@ def _tiny_cartpole_cfg(prioritized: bool):
     )
 
 
+@pytest.mark.slow
 def test_mesh_r2d2_train_runs(mesh):
     """R2D2 across the mesh: sequence replay sharded, learner allreduced."""
     from dist_dqn_tpu.parallel import make_mesh_r2d2_train

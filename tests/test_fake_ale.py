@@ -67,6 +67,7 @@ def test_ale_without_alepy_raises_clear_error(monkeypatch):
         make_host_env("ale:Pong", num_envs=1)
 
 
+@pytest.mark.slow
 def test_apex_split_over_fake_ale(monkeypatch):
     """End-to-end driver config 3 shape on the ale: branch: actor processes
     step the fake emulator, stream preprocessed stacks through the native

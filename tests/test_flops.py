@@ -16,6 +16,8 @@ import jax.numpy as jnp
 
 from dist_dqn_tpu.utils import flops as flops_util
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -79,6 +81,7 @@ def _run_bench(env_overrides, timeout=560):
         capture_output=True, text=True, timeout=timeout)
 
 
+@pytest.mark.slow
 def test_bench_smoke_emits_contract_json():
     proc = _run_bench({"BENCH_SMOKE": "1"})
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -11,6 +11,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -34,6 +36,7 @@ def test_dryrun_multichip_inside_driver_budget():
     assert time.monotonic() - t0 < 120.0
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_survives_hostile_env():
     """Caller env pointing at a nonexistent platform must not matter."""
     env = dict(os.environ)

@@ -9,6 +9,8 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 _WORKER = textwrap.dedent("""
@@ -40,6 +42,8 @@ _WORKER = textwrap.dedent("""
     print("MULTIHOST_OK", {pid}, float(np.sum(p)), flush=True)
 """)
 
+
+pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
 def test_two_process_global_mesh_train():
     port = _free_port()

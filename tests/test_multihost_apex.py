@@ -10,6 +10,8 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 # A real script file, not `python -c`: the service spawns actor processes
@@ -79,10 +81,12 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_two_host_apex_split(tmp_path):
     _run_two_hosts(tmp_path, "dqn")
 
 
+@pytest.mark.slow
 def test_two_host_apex_r2d2(tmp_path):
     """Same lockstep machinery through the recurrent path: sequence-shard
     PartitionSpecs, q-plane seeding, stored-state batches."""

@@ -6,6 +6,8 @@ import numpy as np
 
 from dist_dqn_tpu.replay import device as ring
 from dist_dqn_tpu.replay import prioritized_device as pring
+import pytest
+
 from dist_dqn_tpu.replay.host import (NativeSumTree, PrioritizedHostReplay,
                                       SumTree, make_sum_tree)
 
@@ -282,6 +284,7 @@ def test_device_sample_payload_matches_uniform_semantics():
     np.testing.assert_allclose(s.batch.discount, ref.discount)
 
 
+@pytest.mark.slow
 def test_fused_loop_with_per_learns_cartpole():
     """PER-enabled fused loop end-to-end on CartPole (smoke + learning)."""
     import dataclasses

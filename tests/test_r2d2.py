@@ -13,6 +13,8 @@ from dist_dqn_tpu.models.recurrent import RecurrentQNetwork
 from dist_dqn_tpu.replay import sequence_device as sring
 from dist_dqn_tpu.types import SequenceSample
 
+import pytest
+
 
 def _tiny_net(num_actions=3, lstm=8):
     return RecurrentQNetwork(num_actions=num_actions, torso="mlp",
@@ -165,6 +167,7 @@ def test_r2d2_learner_td_matches_numpy():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_r2d2_fused_loop_learns_cartpole():
     cfg = CONFIGS["r2d2"]
     cfg = dataclasses.replace(
@@ -211,6 +214,7 @@ def test_sequence_sampler_pallas_agrees_with_xla():
                                atol=1e-3)
 
 
+@pytest.mark.slow
 def test_r2d2_sharded_train_step_matches_single_device():
     """8 sequence learners on batch shards + pmean == 1 learner full-batch."""
     import pytest
@@ -268,6 +272,7 @@ def test_r2d2_sharded_train_step_matches_single_device():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_r2d2_fused_loop_with_pallas_sampler_runs(monkeypatch):
     monkeypatch.setenv("DIST_DQN_PALLAS_INTERPRET", "1")
     cfg = CONFIGS["r2d2"]
@@ -298,6 +303,7 @@ def test_r2d2_fused_loop_with_pallas_sampler_runs(monkeypatch):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_remat_torso_same_params_and_grads():
     """remat is numerics- and checkpoint-transparent: identical param
     structure, outputs, and gradients with the flag on/off."""

@@ -56,6 +56,7 @@ def test_pixel_reacher_new_target_each_episode():
     assert not np.allclose(targets[1], targets[2])
 
 
+@pytest.mark.slow
 def test_rainbow_combination_learns_cartpole():
     """The full Rainbow stack (dueling + NoisyNet exploration + C51 + PER +
     n-step double-Q) must actually LEARN, pinned on CartPole where a random
@@ -89,6 +90,7 @@ def test_rainbow_combination_learns_cartpole():
     assert max(evals + returns) >= 100.0, (evals, returns)
 
 
+@pytest.mark.slow
 def test_rainbow_fused_loop_runs():
     """Dueling + noisy + C51 + prioritized through the fused pixel loop."""
     cfg = CONFIGS["rainbow"]

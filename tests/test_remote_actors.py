@@ -9,6 +9,8 @@ from dist_dqn_tpu.actors.transport import (TcpRecordClient, TcpRecordServer,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.config import CONFIGS
 
+import pytest
+
 
 def test_tcp_roundtrip_and_reply_routing():
     server = TcpRecordServer(host="127.0.0.1")
@@ -49,6 +51,7 @@ def test_tcp_roundtrip_and_reply_routing():
         server.close()
 
 
+@pytest.mark.slow
 def test_apex_mixed_local_and_remote_actors():
     cfg = CONFIGS["apex"]
     cfg = dataclasses.replace(
@@ -71,6 +74,7 @@ def test_apex_mixed_local_and_remote_actors():
     assert result["tcp_backpressure"] == 0
 
 
+@pytest.mark.slow
 def test_apex_remote_r2d2_actors():
     cfg = CONFIGS["r2d2"]
     cfg = dataclasses.replace(
@@ -209,6 +213,7 @@ def test_ingest_stall_watchdog_warns_once_and_clears():
         svc.shutdown()
 
 
+@pytest.mark.slow
 def test_actor_churn_supervision():
     """Kill an actor mid-run: the service restarts it and finishes."""
     import threading

@@ -4,6 +4,7 @@ import json
 import threading
 
 from dist_dqn_tpu.utils.trace import NullTracer, SpanTracer, make_tracer
+import pytest
 
 
 def test_span_tracer_records_chrome_events(tmp_path):
@@ -75,6 +76,7 @@ def test_make_tracer_disabled_is_noop():
     tr.close()  # no file side effects
 
 
+@pytest.mark.slow
 def test_apex_service_writes_trace(tmp_path):
     import dataclasses
 

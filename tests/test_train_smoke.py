@@ -3,6 +3,10 @@ driver's CPU-reference config exists precisely for this, BASELINE.json:7)."""
 from dist_dqn_tpu.config import CONFIGS
 from dist_dqn_tpu.train import train
 
+import pytest
+
+
+pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
 def test_cartpole_learns():
     cfg = CONFIGS["cartpole"]

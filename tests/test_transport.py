@@ -138,6 +138,7 @@ def _producer(name: str, pid: int, count: int):
             pass
 
 
+@pytest.mark.slow
 def test_ring_multiprocess_producers():
     name = _name()
     ring = ShmRing(name, capacity=1 << 16, create=True)
