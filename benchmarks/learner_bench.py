@@ -84,11 +84,12 @@ def _r2d2_case(cfg):
     return state, step, (sample,)
 
 
-def bench_config(name: str, iters: int) -> dict:
+def bench_config(name: str, iters: int, cfg=None) -> dict:
     from dist_dqn_tpu.config import CONFIGS
     from dist_dqn_tpu.utils import flops as flops_util
 
-    cfg = CONFIGS[name]
+    if cfg is None:
+        cfg = CONFIGS[name]
     if cfg.network.lstm_size:
         state, step, args = _r2d2_case(cfg)
     else:
@@ -116,15 +117,48 @@ def bench_config(name: str, iters: int) -> dict:
     return out
 
 
+def r2d2_sweep(iters: int):
+    """R2D2 learner-throughput sweep (VERDICT round 1, next #8): remat
+    on/off x LSTM gate dtype f32/bf16 x scan-unroll 1/8 on the full r2d2
+    config. Numerics of every knob are pinned by tests/test_recurrent_knobs
+    — this sweep is pure throughput. One JSON line per point; run on the
+    real chip to pick the winner (CPU ordering does not transfer)."""
+    import dataclasses
+
+    from dist_dqn_tpu.config import CONFIGS
+
+    base = CONFIGS["r2d2"]
+    for remat in (True, False):
+        for lstm_dtype in ("float32", "bfloat16"):
+            for unroll in (1, 8):
+                net = dataclasses.replace(
+                    base.network, remat_torso=remat, lstm_dtype=lstm_dtype,
+                    lstm_unroll=unroll)
+                cfg = dataclasses.replace(base, network=net)
+                out = bench_config("r2d2", iters, cfg=cfg)
+                out.update(remat_torso=remat, lstm_dtype=lstm_dtype,
+                           lstm_unroll=unroll)
+                print(json.dumps(out), flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--configs", nargs="*",
                    default=["atari", "apex", "r2d2", "rainbow"])
     p.add_argument("--iters", type=int, default=50)
     p.add_argument("--platform", default=None)
+    p.add_argument("--r2d2-sweep", action="store_true",
+                   help="sweep the R2D2 throughput knobs (remat, LSTM "
+                        "dtype, scan unroll) instead of --configs")
     args = p.parse_args()
+    from dist_dqn_tpu.utils.device_cleanup import install as _install_cleanup
+
+    _install_cleanup()  # SIGTERM'd bench must release its device grant
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.r2d2_sweep:
+        r2d2_sweep(args.iters)
+        return
     for name in args.configs:
         print(json.dumps(bench_config(name, args.iters)), flush=True)
 

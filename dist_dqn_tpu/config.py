@@ -25,6 +25,12 @@ class NetworkConfig:
     lstm_size: int = 0                 # >0 => recurrent core (R2D2)
     remat_torso: bool = False          # recompute torso acts in backward
     compute_dtype: str = "float32"     # "bfloat16" for the TPU MXU path
+    # R2D2 learner-throughput knobs (models/recurrent.py): gate-matmul
+    # dtype of the LSTM cell (carry stays float32 either way) and the
+    # lax.scan unroll factor of the time loop (XLA fuses k cell steps per
+    # scan iteration; the math is unchanged).
+    lstm_dtype: str = "float32"        # "bfloat16" runs cell matmuls on MXU
+    lstm_unroll: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -178,7 +178,10 @@ def build_network(cfg: NetworkConfig, num_actions: int) -> nn.Module:
             num_actions=num_actions, torso=cfg.torso,
             mlp_features=cfg.mlp_features, hidden=cfg.hidden,
             lstm_size=cfg.lstm_size, dueling=cfg.dueling,
-            remat_torso=cfg.remat_torso, compute_dtype=dtype)
+            remat_torso=cfg.remat_torso, compute_dtype=dtype,
+            lstm_dtype=(jnp.bfloat16 if cfg.lstm_dtype == "bfloat16"
+                        else jnp.float32),
+            lstm_unroll=cfg.lstm_unroll)
     return QNetwork(
         num_actions=num_actions, torso=cfg.torso,
         mlp_features=cfg.mlp_features, hidden=cfg.hidden,

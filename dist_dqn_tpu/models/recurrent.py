@@ -66,18 +66,25 @@ class _ResetCell(nn.Module):
     Scanned over time by ``RecurrentQNetwork.unroll``; the single-step path
     is a length-1 unroll of the same instance, so acting and learning share
     parameters by construction.
+
+    ``dtype`` sets the gate-matmul compute dtype (bfloat16 puts the cell's
+    [B, E+H] x [*, 4H] products on the MXU); the (c, h) carry is cast back
+    to float32 every step so the recurrence stays numerically stable and
+    the carry dtype is invariant across configs/checkpoints.
     """
 
     lstm_size: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, carry: LSTMCarry, inputs):
         x, reset = inputs  # x: [B, E] float32; reset: [B] bool
         keep = (~reset).astype(jnp.float32)[:, None]
         carry = (carry[0] * keep, carry[1] * keep)
-        new_carry, h = nn.OptimizedLSTMCell(self.lstm_size, name="lstm")(
-            carry, x)
-        return new_carry, h
+        new_carry, h = nn.OptimizedLSTMCell(
+            self.lstm_size, dtype=self.dtype, name="lstm")(carry, x)
+        new_carry = tuple(c.astype(jnp.float32) for c in new_carry)
+        return new_carry, h.astype(jnp.float32)
 
 
 class RecurrentQNetwork(nn.Module):
@@ -100,6 +107,10 @@ class RecurrentQNetwork(nn.Module):
     # Recompute torso activations in the backward pass (HBM for FLOPs) —
     # for long-unroll pixel configs where [T*B] conv activations dominate.
     remat_torso: bool = False
+    # Cell gate-matmul dtype (carry stays float32) and lax.scan unroll
+    # factor for the time loop — learner-throughput knobs, math unchanged.
+    lstm_dtype: jnp.dtype = jnp.float32
+    lstm_unroll: int = 1
     # Present for API parity with QNetwork (scalar-Q head only).
     num_atoms: int = 1
     noisy: bool = False
@@ -149,7 +160,9 @@ class RecurrentQNetwork(nn.Module):
         x = x.reshape((T, B, -1))
         core = nn.scan(_ResetCell, variable_broadcast="params",
                        split_rngs={"params": False},
-                       in_axes=0, out_axes=0)(self.lstm_size, name="core")
+                       in_axes=0, out_axes=0,
+                       unroll=self.lstm_unroll)(
+            self.lstm_size, dtype=self.lstm_dtype, name="core")
         carry, hs = core(carry, (x, reset))
         q = self._q_head(hs.reshape((T * B, -1)))
         return carry, q.reshape((T, B, self.num_actions))
