@@ -62,7 +62,8 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     tx = optax.chain(*tx_parts)
 
     num_atoms = getattr(net, "num_atoms", 1)
-    distributional = num_atoms > 1
+    quantile = num_atoms > 1 and getattr(net, "quantile", False)
+    distributional = num_atoms > 1 and not quantile
     noisy = getattr(net, "noisy", False)
 
     def init(rng: Array, obs_example: Array) -> LearnerState:
@@ -100,6 +101,28 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
                 atoms, next_probs, batch.reward, batch.discount)
             per_example = losses.categorical_td_loss(
                 logits, batch.action, target_probs)
+            priorities = per_example
+        elif quantile:
+            # QR-DQN (the second distributional family): quantile-Huber
+            # regression against Bellman-mapped target quantile samples.
+            theta = _apply(net, params, batch.obs, k_online, noisy)
+            theta_next_target = _apply(net, target_params, batch.next_obs,
+                                       k_target, noisy)
+            if cfg.double_dqn:
+                theta_next_online = _apply(net, params, batch.next_obs,
+                                           k_next, noisy)
+                selector = theta_next_online
+            else:
+                selector = theta_next_target
+            next_theta = losses.quantile_double_q_select(
+                selector, theta_next_target)                    # [B, N]
+            target_theta = (batch.reward[:, None]
+                            + batch.discount[:, None] * next_theta)
+            theta_a = jnp.take_along_axis(
+                theta, batch.action[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                                   # [B, N]
+            per_example = losses.quantile_huber_td(
+                theta_a, target_theta, cfg.huber_delta)
             priorities = per_example
         else:
             q = _apply(net, params, batch.obs, k_online, noisy)

@@ -19,9 +19,10 @@ class NetworkConfig:
     hidden: int = 512                  # post-torso embedding width
     dueling: bool = False              # dueling value/advantage streams
     noisy: bool = False                # NoisyNet exploration heads (Rainbow)
-    num_atoms: int = 1                 # >1 => C51 distributional head
+    num_atoms: int = 1                 # >1 => distributional head (C51/QR)
     v_min: float = -10.0
     v_max: float = 10.0
+    quantile: bool = False             # num_atoms>1: QR-DQN instead of C51
     lstm_size: int = 0                 # >0 => recurrent core (R2D2)
     remat_torso: bool = False          # recompute torso acts in backward
     compute_dtype: str = "float32"     # "bfloat16" for the TPU MXU path
@@ -202,6 +203,28 @@ RAINBOW = ExperimentConfig(
     train_every=4,
 )
 
+QRDQN = ExperimentConfig(
+    # Beyond the driver's five configs: QR-DQN (Dabney et al., 2018) — the
+    # quantile-regression distributional family on the Atari-shaped path,
+    # sharing the atari preset's schedule with the standard 200-quantile
+    # head (no fixed support, so no v_min/v_max tuning).
+    name="qrdqn",
+    env_name="pixel_pong",
+    network=NetworkConfig(torso="nature", hidden=512, num_atoms=200,
+                          quantile=True, compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=200_000, prioritized=True,
+                        priority_exponent=0.5, importance_exponent=0.4,
+                        min_fill=20_000),
+    learner=LearnerConfig(
+        learning_rate=5e-5, adam_eps=3.125e-4, gamma=0.99, n_step=3,
+        batch_size=256, double_dqn=True, target_update_period=2_000,
+        huber_delta=1.0,
+    ),
+    actor=ActorConfig(num_envs=64, epsilon_decay_steps=250_000),
+    total_env_steps=10_000_000,
+    train_every=4,
+)
+
 CONFIGS: Dict[str, ExperimentConfig] = {
-    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW)
+    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW, QRDQN)
 }

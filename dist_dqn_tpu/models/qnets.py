@@ -100,8 +100,12 @@ class MLPTorso(nn.Module):
 class QNetwork(nn.Module):
     """Configurable feed-forward Q-network.
 
-    Output: [B, A] Q-values when ``num_atoms == 1``, else [B, A, num_atoms]
-    C51 logits (use ``atoms()`` for the support and expected-Q reduction).
+    Output: [B, A] Q-values when ``num_atoms == 1``; otherwise
+    [B, A, num_atoms] — C51 categorical logits by default (use ``atoms()``
+    for the support and softmax expected-Q reduction), or raw quantile
+    VALUES when ``quantile`` is set (reduce with a plain mean; softmax/
+    atoms are meaningless there). ``q_values()`` does the right reduction
+    for every head type — prefer it over reducing by hand.
     """
 
     num_actions: int
@@ -113,6 +117,11 @@ class QNetwork(nn.Module):
     num_atoms: int = 1
     v_min: float = -10.0
     v_max: float = 10.0
+    # num_atoms > 1 selects the distributional head family: C51 categorical
+    # logits over a fixed v_min..v_max support by default, or — with
+    # ``quantile`` — QR-DQN quantile values (no fixed support; atoms() and
+    # v_min/v_max are unused).
+    quantile: bool = False
     compute_dtype: jnp.dtype = jnp.float32
 
     def atoms(self) -> Array:
@@ -161,6 +170,9 @@ class QNetwork(nn.Module):
         out = self(obs, add_noise=add_noise)
         if self.num_atoms == 1:
             return out
+        if self.quantile:
+            # QR head: expected return is the mean of the quantile values.
+            return jnp.mean(out, axis=-1)
         return jnp.sum(jax.nn.softmax(out, axis=-1) * self.atoms(), axis=-1)
 
 
@@ -186,4 +198,5 @@ def build_network(cfg: NetworkConfig, num_actions: int) -> nn.Module:
         num_actions=num_actions, torso=cfg.torso,
         mlp_features=cfg.mlp_features, hidden=cfg.hidden,
         dueling=cfg.dueling, noisy=cfg.noisy, num_atoms=cfg.num_atoms,
-        v_min=cfg.v_min, v_max=cfg.v_max, compute_dtype=dtype)
+        v_min=cfg.v_min, v_max=cfg.v_max, quantile=cfg.quantile,
+        compute_dtype=dtype)

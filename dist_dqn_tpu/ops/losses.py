@@ -146,6 +146,54 @@ def categorical_double_q_probs(
     return jax.nn.softmax(logits_t, axis=-1)
 
 
+# ---------------------------------------------------------------------------
+# QR-DQN / quantile-regression distributional RL (Dabney et al., 2018) —
+# the second distributional family next to C51: the head predicts N
+# quantile VALUES of the return distribution (no fixed support, no v_min/
+# v_max), trained with the asymmetric quantile-Huber regression below.
+# ---------------------------------------------------------------------------
+
+def quantile_midpoints(num_quantiles: int, dtype=jnp.float32) -> Array:
+    """tau-hat_i = (2i + 1) / 2N — the quantile targets of each output."""
+    return (jnp.arange(num_quantiles, dtype=dtype) + 0.5) / num_quantiles
+
+
+def quantile_double_q_select(theta_next_selector: Array,
+                             theta_next_target: Array) -> Array:
+    """Greedy action by the selector net's MEAN over quantiles; returns the
+    target net's quantile values at that action.
+
+    Args: theta [B, A, N]. Returns [B, N].
+    """
+    q_sel = jnp.mean(theta_next_selector, axis=-1)          # [B, A]
+    a_star = jnp.argmax(q_sel, axis=-1)                     # [B]
+    return jnp.take_along_axis(
+        theta_next_target, a_star[:, None, None], axis=1)[:, 0]
+
+
+def quantile_huber_td(theta_a: Array, target_theta: Array,
+                      kappa: float = 1.0) -> Array:
+    """Per-example quantile-Huber regression loss.
+
+    Args:
+      theta_a:      [B, N] predicted quantiles at the taken action.
+      target_theta: [B, M] Bellman-target quantile samples; stop-gradded
+                    HERE — no gradient ever flows into the target.
+      kappa: Huber threshold.
+
+    Returns: [B] losses — sum over predicted quantiles i of the mean over
+    target samples j of |tau_i - 1{u_ij < 0}| * Huber_kappa(u_ij) / kappa,
+    the Dabney et al. (2018) estimator.
+    """
+    n = theta_a.shape[-1]
+    u = (jax.lax.stop_gradient(target_theta)[:, None, :]
+         - theta_a[:, :, None])                              # [B, N, M]
+    tau = quantile_midpoints(n, theta_a.dtype)[None, :, None]
+    weight = jnp.abs(tau - (u < 0.0).astype(theta_a.dtype))
+    return jnp.sum(jnp.mean(weight * huber(u, kappa) / kappa, axis=2),
+                   axis=1)
+
+
 def categorical_td_loss(
     logits: Array,
     actions: Array,
