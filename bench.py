@@ -3,9 +3,14 @@
 Runs the fused on-device training loop (act -> PixelPong step -> replay ->
 learner update cadence) on whatever single accelerator is present and
 reports the driver's north-star metric (BASELINE.json:2,5):
-env-steps/sec/chip against the 50k/sec/chip Ape-X target, plus MFU
-(achieved model FLOP/s from XLA's cost analysis of the compiled chunk
-over the chip's bf16 peak — utils/flops.py).
+env-steps/sec/chip against the 50k/sec/chip Ape-X target, plus ``mfu`` —
+the conventional definition: learner fwd+bwd+optimizer FLOPs over chip
+bf16 peak, censused on a standalone compile of the train step (the same
+program benchmarks/learner_bench.py times). The census deliberately does
+NOT come from the fused chunk: XLA's cost analysis counts a ``lax.scan``
+body ONCE regardless of trip count (verified on this box — identical
+census for 5/20/40-iteration chunks), so a whole-chunk number would
+undercount by ~the chunk length; the standalone train step has no scan.
 
 Timing is fenced with ``device_get`` on a chunk metric: on the remote-
 tunnel (axon) platform ``block_until_ready`` returns before execution
@@ -119,6 +124,47 @@ def main() -> int:
     return 0
 
 
+def _learner_step_flops(jax, cfg, env, net):
+    """Op-census FLOPs of ONE learner grad step, compiled standalone.
+
+    The fused chunk's census also counts env physics, acting and replay
+    ops; the conventional MFU definition counts model fwd+bwd+optimizer
+    only (ADVICE round 2) — so the ``mfu`` field is derived from this
+    compile, exactly the program benchmarks/learner_bench.py times.
+    """
+    import numpy as np
+
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.types import Transition
+    from dist_dqn_tpu.utils import flops as flops_util
+
+    init, train_step = make_learner(net, cfg.learner)
+    obs_shape = env.observation_shape
+    obs_dtype = np.dtype(str(np.dtype(env.observation_dtype)))
+    state = init(jax.random.PRNGKey(0), jax.numpy.zeros(obs_shape, obs_dtype))
+    B = cfg.learner.batch_size
+    r = np.random.default_rng(0)
+
+    def obs():
+        if obs_dtype == np.uint8:
+            return jax.numpy.asarray(
+                r.integers(0, 255, (B,) + obs_shape, np.uint8))
+        return jax.numpy.asarray(r.normal(size=(B,) + obs_shape)
+                                 .astype(obs_dtype))
+
+    batch = Transition(
+        obs=obs(),
+        action=jax.numpy.asarray(r.integers(0, env.num_actions, B, np.int32)),
+        reward=jax.numpy.asarray(r.normal(size=B).astype(np.float32)),
+        discount=jax.numpy.full(B, cfg.learner.gamma ** cfg.learner.n_step,
+                                jax.numpy.float32),
+        next_obs=obs(),
+    )
+    compiled = jax.jit(train_step, donate_argnums=0).lower(
+        state, batch, jax.numpy.ones(B, jax.numpy.float32)).compile()
+    return flops_util.compiled_flops(compiled)
+
+
 def _measure(jax, device, smoke: bool):
     from dist_dqn_tpu.config import CONFIGS
     from dist_dqn_tpu.envs import make_jax_env
@@ -157,10 +203,7 @@ def _measure(jax, device, smoke: bool):
         return float(jax.device_get(metrics["loss"]))
 
     carry = init(jax.random.PRNGKey(0))
-    # AOT-compile so the same Compiled object yields the cost analysis the
-    # MFU number is derived from.
     compiled = run.lower(carry, chunk).compile()
-    flops_per_chunk = flops_util.compiled_flops(compiled)
     for _ in range(2):  # warmup + fill past min_fill into steady state
         carry, metrics = compiled(carry)
         fence(metrics)
@@ -174,8 +217,19 @@ def _measure(jax, device, smoke: bool):
     value = measure_chunks * chunk * num_envs / dt
     extras = {"platform": device.platform,
               "device_kind": getattr(device, "device_kind", "unknown")}
-    extras.update(flops_util.mfu_fields(flops_per_chunk, measure_chunks, dt,
-                                        device))
+    # Conventional MFU: learner fwd+bwd+optimizer FLOPs only. Grad-step
+    # count uses the last chunk's census — the cadence is deterministic in
+    # steady state, so every measured chunk ran the same number (reading
+    # each chunk's metric would insert a host fence into the timed loop).
+    grad_steps = float(jax.device_get(metrics["grad_steps_in_chunk"])) \
+        * measure_chunks
+    train_flops = _learner_step_flops(jax, cfg, env, net)
+    learner = flops_util.mfu_fields(train_flops, grad_steps, dt, device)
+    if "model_flops_per_sec" in learner:
+        extras["model_flops_per_sec"] = learner["model_flops_per_sec"]
+        extras["learner_grad_steps_per_sec"] = round(grad_steps / dt, 2)
+    if "mfu" in learner:
+        extras["mfu"] = learner["mfu"]
     return value, extras
 
 

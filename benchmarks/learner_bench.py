@@ -95,9 +95,21 @@ def bench_config(name: str, iters: int, cfg=None) -> dict:
     else:
         state, step, args = _feedforward_case(cfg)
     # AOT-compile so the timed Compiled object also yields the op-census
-    # FLOPs the MFU column is derived from (utils/flops.py).
+    # FLOPs the MFU column is derived from (utils/flops.py). The census
+    # counts a lax.scan body ONCE regardless of trip count, so for the
+    # recurrent configs (scanned time loop) the analytic R2D2 model is
+    # the honest source instead.
     compiled = step.lower(state, *args).compile()
-    flops_per_step = flops_util.compiled_flops(compiled)
+    if cfg.network.lstm_size:
+        T = (cfg.replay.burn_in + cfg.replay.unroll_length
+             + cfg.learner.n_step)
+        flops_per_step = flops_util.r2d2_grad_step_flops(
+            T, cfg.learner.batch_size, hidden=cfg.network.hidden,
+            lstm=cfg.network.lstm_size,
+            remat=cfg.network.remat_torso)["total"] \
+            if cfg.network.torso == "nature" else None
+    else:
+        flops_per_step = flops_util.compiled_flops(compiled)
     state, _ = compiled(state, *args)  # one cached-dispatch warmup
     jax.device_get(state.steps)    # fence before timing
     t0 = time.perf_counter()

@@ -50,6 +50,13 @@ class MultihostLearner:
         self.mesh = make_mesh(devices=jax.devices())  # dp over the pod
         self._repl = NamedSharding(self.mesh, P())
         self._agree = None
+        # Set when an agreement collective times out: the daemon worker
+        # thread is then permanently parked inside the psum, so issuing a
+        # SECOND collective from this process could interleave with the
+        # first and corrupt the group's collective ordering. Poisoning
+        # makes that structurally impossible instead of relying on the
+        # caller exiting promptly after the raise.
+        self._agree_poisoned = False
 
     # -- init ---------------------------------------------------------------
     def wrap_init(self, init):
@@ -133,6 +140,11 @@ class MultihostLearner:
         fails loudly instead of hanging silently."""
         jax = self.jax
         P = self.P
+        if self._agree_poisoned:
+            raise RuntimeError(
+                "agree() called after a previous agreement collective timed "
+                "out; the worker thread may still be blocked inside that "
+                "psum, so this learner is poisoned — restart the process")
         if self._agree is None:
             self._agree = jax.jit(jax.shard_map(
                 lambda x: jax.lax.psum(x, "dp"), mesh=self.mesh,
@@ -174,6 +186,7 @@ class MultihostLearner:
         # <= 0 means "no timeout" (block forever, the pre-fix behavior).
         worker.join(timeout_s if timeout_s > 0 else None)
         if worker.is_alive():
+            self._agree_poisoned = True
             raise RuntimeError(
                 f"agreement collective incomplete after {timeout_s:.0f}s — "
                 "a peer host likely died; failing fast instead of wedging "

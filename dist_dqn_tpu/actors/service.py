@@ -300,7 +300,10 @@ class ApexLearnerService:
         # Async eval (multi-host): worker thread + its pending result and a
         # dedicated rng so eval never races the main loop's key stream.
         self._eval_thread: Optional[threading.Thread] = None
-        self._eval_result = None
+        # Worker threads append, the main loop pops: deque ops are atomic,
+        # so a result finishing between the poller's load and clear cannot
+        # be silently erased (a single shared slot could drop one).
+        self._eval_results: deque = deque()
         self._eval_rng = None
         self.bad_records = 0
         self.actor_restarts = 0
@@ -838,6 +841,7 @@ class ApexLearnerService:
         n = self.rt.eval_episodes
         if self._eval_env is None:
             self._eval_env = make_host_env(self.rt.host_env, n,
+                                           for_eval=True,
                                            seed=10_000 + self.cfg.seed)
         if self._eval_rng is None:
             self._eval_rng = self.jax.random.PRNGKey(self.cfg.seed + 991)
@@ -888,30 +892,29 @@ class ApexLearnerService:
 
         def work():
             try:
-                self._eval_result = (at_steps, self._evaluate_impl(params))
+                self._eval_results.append(
+                    (at_steps, self._evaluate_impl(params)))
             except Exception as e:  # noqa: BLE001 — surfaced by the poller
-                self._eval_result = (at_steps, e)
+                self._eval_results.append((at_steps, e))
 
         self._eval_thread = threading.Thread(target=work, daemon=True,
                                              name="apex-eval")
         self._eval_thread.start()
 
     def _poll_async_eval(self):
-        # Load-then-conditionally-clear: an unconditional swap could race
-        # the worker's single store and drop a just-finished result.
-        pending = self._eval_result
-        if pending is None:
-            return
-        self._eval_result = None
-        at_steps, res = pending
-        if isinstance(res, Exception):
-            self.log.log_fn(f"# async eval failed: {res!r}")
-            return
-        ret, truncated = res
-        if truncated:
-            self.log.record(eval_episodes_truncated=truncated)
-        self.log.record(env_steps=at_steps, eval_return=ret)
-        self.log.flush()
+        while True:
+            try:
+                at_steps, res = self._eval_results.popleft()
+            except IndexError:
+                return
+            if isinstance(res, Exception):
+                self.log.log_fn(f"# async eval failed: {res!r}")
+                continue
+            ret, truncated = res
+            if truncated:
+                self.log.record(eval_episodes_truncated=truncated)
+            self.log.record(env_steps=at_steps, eval_return=ret)
+            self.log.flush()
 
     def _progress(self) -> int:
         """Run-cursor: local env steps, or the group-agreed GLOBAL count in
@@ -919,6 +922,43 @@ class ApexLearnerService:
         hosts make termination/eval/checkpoint decisions in the same
         order — the collective-pairing invariant)."""
         return self.global_env_steps if self.distributed else self.env_steps
+
+    def _drain_transports(self, burst: int = 256) -> bool:
+        """One ingest burst: pop up to ``burst`` records from the shm ring
+        and the TCP listener and route each through ``_handle_record``.
+        Returns whether anything arrived. This is the production ingest
+        path — the fan-in stress test (tests/test_fanin_stress.py) drives
+        it directly with synthesized 256-actor record streams."""
+        drained = False
+        for _ in range(burst):
+            rec = self.req_ring.pop()
+            if rec is None:
+                break
+            drained = True
+            with self.tracer.span("ingest.shm_record"):
+                self._handle_record(rec)
+        if self.tcp_server is not None:
+            for _ in range(burst):
+                rec = self.tcp_server.pop()
+                if rec is None:
+                    break
+                drained = True
+                conn_id, payload = rec
+                try:
+                    with self.tracer.span("ingest.tcp_record"):
+                        self._handle_record(payload, conn_id=conn_id)
+                except Exception as e:
+                    # Network input is untrusted (the listener may face
+                    # other hosts): a malformed or misrouted record must
+                    # not take down the training run. Logged (rate-
+                    # limited) so a genuine service bug surfacing here is
+                    # visible, not silently counted away.
+                    self.bad_records += 1
+                    if self.bad_records <= 5:
+                        self.log.log_fn(
+                            f"# bad TCP record ({self.bad_records})"
+                            f": {type(e).__name__}: {e}")
+        return drained
 
     def run(self):
         """Main service loop until total_env_steps processed."""
@@ -929,37 +969,7 @@ class ApexLearnerService:
         last_log = time.perf_counter()
         try:
             while self._progress() < self.rt.total_env_steps:
-                drained = False
-                for _ in range(256):
-                    rec = self.req_ring.pop()
-                    if rec is None:
-                        break
-                    drained = True
-                    with self.tracer.span("ingest.shm_record"):
-                        self._handle_record(rec)
-                if self.tcp_server is not None:
-                    for _ in range(256):
-                        rec = self.tcp_server.pop()
-                        if rec is None:
-                            break
-                        drained = True
-                        conn_id, payload = rec
-                        try:
-                            with self.tracer.span("ingest.tcp_record"):
-                                self._handle_record(payload,
-                                                    conn_id=conn_id)
-                        except Exception as e:
-                            # Network input is untrusted (the listener may
-                            # face other hosts): a malformed or misrouted
-                            # record must not take down the training run.
-                            # Logged (rate-limited) so a genuine service
-                            # bug surfacing here is visible, not silently
-                            # counted away.
-                            self.bad_records += 1
-                            if self.bad_records <= 5:
-                                self.log.log_fn(
-                                    f"# bad TCP record ({self.bad_records})"
-                                    f": {type(e).__name__}: {e}")
+                drained = self._drain_transports()
                 self._flush_act_queue()
                 self._flush_pending()
                 self._maybe_train()

@@ -170,7 +170,15 @@ R2D2 = ExperimentConfig(
                           # 120-step unrolls x batch of pixel frames: the
                           # torso activations dominate learner HBM; trade
                           # them for recompute (models/recurrent.py).
-                          remat_torso=True),
+                          remat_torso=True,
+                          # Throughput knobs, numerics pinned by
+                          # tests/test_recurrent_knobs.py. Defaults set by
+                          # the analytic time model (utils/flops.py
+                          # r2d2_time_model: bf16 gates ~-21% modeled step
+                          # time, unroll=8 a further ~-12%) — TPU sweep
+                          # confirmation pending tunnel recovery
+                          # (docs/performance.md).
+                          lstm_dtype="bfloat16", lstm_unroll=8),
     replay=ReplayConfig(capacity=100_000, prioritized=True,
                         priority_exponent=0.9, importance_exponent=0.6,
                         burn_in=40, unroll_length=80, sequence_stride=40,

@@ -139,6 +139,17 @@ _AGREE_WORKER = textwrap.dedent("""
             # RuntimeError from the watchdog timeout, or a collective
             # error surfaced by the dead peer — either is fail-fast.
             print("AGREE_FAILFAST_OK", type(e).__name__, flush=True)
+            if "incomplete after" in str(e):
+                # Timeout path: the learner must now be POISONED — the
+                # worker thread is still parked in the psum, so a second
+                # collective must be refused, not issued (ADVICE round 2).
+                try:
+                    mh.agree(np.array([5]))
+                    print("POISON_MISSING", flush=True)
+                except RuntimeError as e2:
+                    marker = ("POISON_OK" if "poisoned" in str(e2)
+                              else "POISON_MISSING")
+                    print(marker, flush=True)
         # NOTE: jax's coordination service may also detect the peer death
         # and fatally terminate this process right after the marker prints
         # (absl FATAL in client.h) — that too is fail-fast, so the parent
@@ -228,3 +239,6 @@ def test_agree_fails_fast_when_peer_dies(tmp_path):
     # service may fatally terminate the process once it notices the dead
     # peer, which is fail-fast too.
     assert "AGREE_FAILFAST_OK" in outs[1], outs[1][-2000:]
+    # If the fail-fast came from the watchdog timeout, the follow-up
+    # agree() must have been refused by the poison guard.
+    assert "POISON_MISSING" not in outs[1], outs[1][-2000:]
