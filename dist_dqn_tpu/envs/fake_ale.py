@@ -1,4 +1,4 @@
-"""In-repo fake ALE: a raw 210x160 RGB Atari-API env for offline CI.
+"""In-repo fake ALE: raw 210x160 RGB Atari-API envs for offline CI.
 
 ``ale-py`` is absent from this image (SURVEY.md §7 [ENV]), which left the
 ``ale:<Game>`` adapter branch — the one matching the reference workload's
@@ -6,16 +6,43 @@ real Atari path (BASELINE.json:8-9) — unexercisable offline (VERDICT round
 1, missing #1). This module fakes the layer the adapter actually consumes:
 the gymnasium env that ``gymnasium.make("<Game>NoFrameskip-v4")`` returns
 once ale-py has registered itself — raw 210x160x3 uint8 frames at one
-emulator frame per ``step()``, the 6-action minimal Pong set, gymnasium's
-5-tuple step API. Everything downstream (AtariPreprocessing frame-skip,
-max-pool, grayscale, 84x84 resize, stacking, reward clipping;
-HostVectorEnv; actors; assembler; replay) runs the SAME code a real ALE
-install would — dropping in ale-py requires zero code changes, it simply
-stops routing through this fake (envs/gym_adapter.py ``set_ale_factory``).
+emulator frame per ``step()``, gymnasium's 5-tuple step API. Everything
+downstream (AtariPreprocessing frame-skip, max-pool, grayscale, 84x84
+resize, stacking, reward clipping, episodic-life; HostVectorEnv; actors;
+assembler; replay) runs the SAME code a real ALE install would — dropping
+in ale-py requires zero code changes, it simply stops routing through this
+fake (envs/gym_adapter.py ``set_ale_factory``).
 
-Dynamics are the PixelPong family's (envs/host_pong.py) scaled to the
-210x160 court and slowed to per-emulator-frame speeds, so 4-frame skip
-recovers comparable per-decision motion.
+Real-ALE semantics modeled (VERDICT round 2, next #5 — the axes on which
+Atari-57 games actually differ from each other, so the adapter is
+exercised against the variation, not just one game):
+
+  * **Minimal action sets of different sizes**: Pong = the 6-action
+    minimal set (NOOP FIRE UP DOWN UPFIRE DOWNFIRE), Breakout = the
+    4-action minimal set (NOOP FIRE RIGHT LEFT) — matching ale-py's
+    ``full_action_space=False`` registration defaults.
+  * **Sticky actions** (``repeat_action_probability``, ALE-exact rule):
+    with probability p the env executes the PREVIOUS executed action and
+    ignores the one passed in. 0.0 matches the v4 registrations; 0.25 is
+    the ALE-recommended / v5 default.
+  * **Lives + episodic-life signal**: ``info["lives"]`` on every
+    reset/step, exactly where ale-py reports it. Breakout has 5 lives and
+    only terminates when they run out; Pong reports 0 (it has no lives) —
+    so the adapter's episodic-life handling sees both shapes.
+  * **Fire-to-serve**: Breakout holds the ball until FIRE, like the real
+    game — a policy (or the preprocessing's reset handling) must press
+    FIRE to start play.
+  * **Unclipped raw rewards**: Breakout brick rewards are 1/4/7 by row
+    depth (real Breakout scores 1/1/4/4/7/7), so reward clipping in the
+    preprocessing is exercised by values that need clipping.
+
+Not modeled (documented so nobody assumes otherwise): real game ROMs/
+graphics, full 18-action sets, mode/difficulty switches, and ALE's frame
+pooling quirks beyond what AtariPreprocessing itself applies.
+
+Pong dynamics are the PixelPong family's (envs/host_pong.py) scaled to
+the 210x160 court and slowed to per-emulator-frame speeds, so 4-frame
+skip recovers comparable per-decision motion.
 """
 from __future__ import annotations
 
@@ -47,23 +74,68 @@ class _DiscreteSpace:
         return int(np.random.randint(self.n))
 
 
-class FakeALEEnv:
-    """Pong-like raw-frame env with the gymnasium API the ale: branch uses.
-
-    ``game`` is accepted (and ignored beyond bookkeeping) so the factory
-    signature matches ``make_host_env``'s injection contract for any
-    ``ale:<Game>`` name.
-    """
+class _FakeALEBase:
+    """Shared fake-emulator chassis: sticky actions, lives reporting,
+    frame budget, gymnasium 5-tuple API."""
 
     metadata = {"render_modes": []}
 
-    def __init__(self, game: str = "Pong", max_frames: int = 20_000):
+    def __init__(self, game: str, num_actions: int, max_frames: int,
+                 repeat_action_probability: float):
         self.game = game
         self.max_frames = max_frames
-        self.action_space = _DiscreteSpace(6)
+        self.action_space = _DiscreteSpace(num_actions)
+        self.repeat_action_probability = float(repeat_action_probability)
         self._rng = np.random.default_rng(0)
+        self._last_action = 0
+        self._lives = 0
+        self._t = 0
 
-    # -- rendering ----------------------------------------------------------
+    # subclass hooks ---------------------------------------------------------
+    def _reset_game(self) -> None:
+        raise NotImplementedError
+
+    def _step_game(self, action: int):
+        """-> (reward, terminated). May decrement self._lives."""
+        raise NotImplementedError
+
+    def _frame(self) -> np.ndarray:
+        raise NotImplementedError
+
+    # gymnasium API ----------------------------------------------------------
+    def reset(self, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._last_action = 0
+        self._t = 0
+        self._reset_game()
+        return self._frame(), {"lives": self._lives}
+
+    def step(self, action: int):
+        action = min(max(int(action), 0), self.action_space.n - 1)
+        # ALE sticky rule: with prob p the PREVIOUS executed action runs
+        # and the incoming one is dropped (Machado et al. 2018).
+        if self.repeat_action_probability > 0.0 and \
+                self._rng.random() < self.repeat_action_probability:
+            action = self._last_action
+        self._last_action = action
+        reward, terminated = self._step_game(action)
+        self._t += 1
+        truncated = self._t >= self.max_frames and not terminated
+        return (self._frame(), float(reward), bool(terminated), truncated,
+                {"lives": self._lives})
+
+    def close(self):
+        pass
+
+
+class FakePongEnv(_FakeALEBase):
+    """Pong-like: 6-action minimal set, no lives (info lives = 0)."""
+
+    def __init__(self, game: str = "Pong", max_frames: int = 20_000,
+                 repeat_action_probability: float = 0.0):
+        super().__init__(game, 6, max_frames, repeat_action_probability)
+
     def _frame(self) -> np.ndarray:
         """Raw 210x160x3 uint8: dark court, light paddles, white ball."""
         img = np.full((_H, _W, 3), (30, 60, 30), np.uint8)
@@ -85,19 +157,15 @@ class FakeALEEnv:
         vx = _BALL_SPEED_X if toward_agent else -_BALL_SPEED_X
         return np.array([_W / 2.0, _H / 2.0, vx, vy], np.float32)
 
-    # -- gymnasium API --------------------------------------------------------
-    def reset(self, seed: Optional[int] = None, options=None):
-        if seed is not None:
-            self._rng = np.random.default_rng(seed)
+    def _reset_game(self) -> None:
         self._ball = self._serve(bool(self._rng.integers(0, 2)))
         self._pad_y = _H / 2.0
         self._opp_y = _H / 2.0
         self._score = [0, 0]
-        self._t = 0
-        return self._frame(), {}
+        self._lives = 0   # real ALE Pong reports lives() == 0
 
-    def step(self, action: int):
-        dy = _ACTION_DY[min(max(int(action), 0), 5)]
+    def _step_game(self, action: int):
+        dy = _ACTION_DY[action]
         self._pad_y = float(np.clip(self._pad_y + dy, _PAD_HALF,
                                     _H - 1 - _PAD_HALF))
         opp_dy = float(np.clip(self._ball[1] - self._opp_y, -_OPP_SPEED,
@@ -135,11 +203,124 @@ class FakeALEEnv:
             self._ball = self._serve(toward_agent=opp_point)
         else:
             self._ball = np.array([bx, by, vx, vy], np.float32)
+        return reward, max(self._score) >= _WIN_SCORE
 
-        self._t += 1
-        terminated = max(self._score) >= _WIN_SCORE
-        truncated = self._t >= self.max_frames and not terminated
-        return self._frame(), reward, terminated, truncated, {}
 
-    def close(self):
-        pass
+_BK_PAD_Y = 195.0           # paddle row (near the bottom of the court)
+_BK_PAD_HALF = 12.0
+_BK_PAD_SPEED = 2.0
+_BK_ROWS, _BK_COLS = 6, 16
+_BK_BRICK_TOP = 60.0        # brick band: rows of height 6 starting here
+_BK_BRICK_H = 6.0
+# Real Breakout scores 1/1/4/4/7/7 by row depth (bottom row pair = 1).
+_BK_ROW_REWARD = np.array([7, 7, 4, 4, 1, 1], np.float32)
+_BK_ROW_COLOR = [(200, 72, 72), (198, 108, 58), (180, 122, 48),
+                 (162, 162, 42), (72, 160, 72), (66, 72, 200)]
+_BK_LIVES = 5
+
+
+class FakeBreakoutEnv(_FakeALEBase):
+    """Breakout-like: 4-action minimal set (NOOP FIRE RIGHT LEFT), 5
+    lives with life-loss on a dropped ball, fire-to-serve, row-graded
+    unclipped rewards."""
+
+    def __init__(self, game: str = "Breakout", max_frames: int = 20_000,
+                 repeat_action_probability: float = 0.0):
+        super().__init__(game, 4, max_frames, repeat_action_probability)
+
+    def _frame(self) -> np.ndarray:
+        img = np.full((_H, _W, 3), (20, 20, 30), np.uint8)
+        bw = _W / _BK_COLS
+        for row in range(_BK_ROWS):
+            y0 = int(_BK_BRICK_TOP + row * _BK_BRICK_H)
+            color = _BK_ROW_COLOR[row]
+            for col in range(_BK_COLS):
+                if self._bricks[row, col]:
+                    x0 = int(col * bw)
+                    img[y0:y0 + int(_BK_BRICK_H) - 1,
+                        x0:x0 + int(bw) - 1] = color
+        px = self._pad_x
+        img[int(_BK_PAD_Y):int(_BK_PAD_Y) + 4,
+            int(max(px - _BK_PAD_HALF, 0)):
+            int(min(px + _BK_PAD_HALF, _W - 1))] = (200, 72, 72)
+        bx, by = float(self._ball[0]), float(self._ball[1])
+        img[int(max(by - 2, 0)):int(min(by + 2, _H - 1)),
+            int(max(bx - 2, 0)):int(min(bx + 2, _W - 1))] = (236, 236, 236)
+        return img
+
+    def _reset_game(self) -> None:
+        self._bricks = np.ones((_BK_ROWS, _BK_COLS), bool)
+        self._pad_x = _W / 2.0
+        self._lives = _BK_LIVES
+        self._held = True          # ball on the paddle until FIRE
+        self._ball = np.array([self._pad_x, _BK_PAD_Y - 4.0, 0.0, 0.0],
+                              np.float32)
+
+    def _serve(self) -> None:
+        vx = self._rng.uniform(0.5, 0.9) * (1 if self._rng.random() < 0.5
+                                            else -1)
+        self._ball = np.array([self._pad_x, _BK_PAD_Y - 4.0, vx, -1.0],
+                              np.float32)
+        self._held = False
+
+    def _step_game(self, action: int):
+        # Minimal Breakout set: 0 NOOP, 1 FIRE, 2 RIGHT, 3 LEFT.
+        dx = _BK_PAD_SPEED if action == 2 else \
+            (-_BK_PAD_SPEED if action == 3 else 0.0)
+        self._pad_x = float(np.clip(self._pad_x + dx, _BK_PAD_HALF,
+                                    _W - 1 - _BK_PAD_HALF))
+        if self._held:
+            if action == 1:
+                self._serve()
+            else:
+                self._ball[0] = self._pad_x  # ball rides the paddle
+                return 0.0, False
+        bx = float(self._ball[0] + self._ball[2])
+        by = float(self._ball[1] + self._ball[3])
+        vx, vy = float(self._ball[2]), float(self._ball[3])
+        if bx <= 2.0 or bx >= _W - 3.0:
+            vx = -vx
+            bx = float(np.clip(bx, 2.0, _W - 3.0))
+        if by <= 2.0:
+            vy, by = -vy, 2.0
+        reward = 0.0
+        # Brick collision at the ball's row/col in the brick band.
+        row = int((by - _BK_BRICK_TOP) // _BK_BRICK_H)
+        col = int(bx // (_W / _BK_COLS))
+        if 0 <= row < _BK_ROWS and 0 <= col < _BK_COLS \
+                and self._bricks[row, col]:
+            self._bricks[row, col] = False
+            reward = float(_BK_ROW_REWARD[row])
+            vy = -vy
+            if not self._bricks.any():      # level cleared: fresh wall
+                self._bricks[:] = True
+        # Paddle bounce (ball moving down through the paddle row).
+        if vy > 0 and by >= _BK_PAD_Y - 2.0 \
+                and abs(bx - self._pad_x) <= _BK_PAD_HALF + 2.0:
+            vy = -vy
+            vx += (bx - self._pad_x) / _BK_PAD_HALF * 0.6
+            vx = float(np.clip(vx, -1.5, 1.5))
+            by = _BK_PAD_Y - 2.0
+        terminated = False
+        if by >= _H - 3.0:                  # dropped ball: life lost
+            self._lives -= 1
+            terminated = self._lives <= 0
+            self._held = True
+            self._ball = np.array([self._pad_x, _BK_PAD_Y - 4.0, 0.0, 0.0],
+                                  np.float32)
+        else:
+            self._ball = np.array([bx, by, vx, vy], np.float32)
+        return reward, terminated
+
+
+_GAMES = {"Pong": FakePongEnv, "Breakout": FakeBreakoutEnv}
+
+
+def FakeALEEnv(game: str = "Pong", max_frames: int = 20_000,
+               repeat_action_probability: float = 0.0):
+    """Factory with the ``ale:`` injection contract (gym_adapter.py):
+    game name -> raw ALE-style env. Unknown games get Pong dynamics under
+    the requested name (any ``ale:<Game>`` string must keep working)."""
+    cls = _GAMES.get(game, FakePongEnv)
+    return cls(game, max_frames=max_frames,
+               repeat_action_probability=repeat_action_probability)

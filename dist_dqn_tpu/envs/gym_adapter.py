@@ -49,15 +49,29 @@ def _to_gray(frame: np.ndarray) -> np.ndarray:
 
 
 class AtariPreprocessing:
-    """Single-env Atari pipeline: skip/max-pool/gray/resize/stack/clip."""
+    """Single-env Atari pipeline: skip/max-pool/gray/resize/stack/clip,
+    plus optional episodic-life termination.
+
+    ``episodic_life=True`` implements the standard EpisodicLifeEnv
+    semantics on top of the ``info["lives"]`` counter ale-py reports: a
+    life loss is signaled to the agent as ``terminated`` (so value
+    bootstrapping stops at the life boundary), but the underlying game
+    is NOT reset — the next ``reset()`` continues the same game from the
+    life boundary (via a NOOP step) until the real game-over, which does
+    a full emulator reset. Games without lives (Pong reports 0) are
+    unaffected.
+    """
 
     def __init__(self, env, frame_skip: int = 4, stack: int = 4,
-                 clip_rewards: bool = True):
+                 clip_rewards: bool = True, episodic_life: bool = False):
         self.env = env
         self.frame_skip = frame_skip
         self.stack = stack
         self.clip_rewards = clip_rewards
+        self.episodic_life = episodic_life
         self._frames = np.zeros((84, 84, stack), np.uint8)
+        self._lives = 0
+        self._real_done = True   # first reset() is always a full reset
 
     @property
     def num_actions(self) -> int:
@@ -70,16 +84,26 @@ class AtariPreprocessing:
         return self._frames.copy()
 
     def reset(self, seed: Optional[int] = None) -> np.ndarray:
-        frame, _ = self.env.reset(seed=seed)
+        if self.episodic_life and not self._real_done:
+            # Life-loss boundary: continue the SAME game with a NOOP step
+            # (full reset would let the agent farm easy starts).
+            frame, _, term, trunc, info = self.env.step(0)
+            if term or trunc:    # game actually ended on that step
+                frame, info = self.env.reset(seed=seed)
+        else:
+            frame, info = self.env.reset(seed=seed)
+        self._lives = int(info.get("lives", 0) or 0)
+        self._real_done = False
         processed = _area_resize_84(_to_gray(np.asarray(frame)))
         self._frames = np.repeat(processed[:, :, None], self.stack, axis=2)
         return self._frames.copy()
 
     def step(self, action: int):
         total_r, terminated, truncated = 0.0, False, False
+        info: dict = {}
         last_two: List[np.ndarray] = []
         for _ in range(self.frame_skip):
-            frame, r, term, trunc, _ = self.env.step(action)
+            frame, r, term, trunc, info = self.env.step(action)
             total_r += float(r)
             last_two.append(np.asarray(frame))
             last_two = last_two[-2:]
@@ -90,6 +114,12 @@ class AtariPreprocessing:
                   else last_two[-1])
         if self.clip_rewards:
             total_r = float(np.clip(total_r, -1.0, 1.0))
+        self._real_done = terminated or truncated
+        if self.episodic_life:
+            lives = int(info.get("lives", 0) or 0)
+            if 0 < lives < self._lives and not terminated:
+                terminated = True   # life lost: episode ends for the agent
+            self._lives = lives
         return self._obs(pooled), total_r, terminated, truncated
 
 
@@ -188,7 +218,8 @@ def is_pixel_env(name: str) -> bool:
     return name == "pong" or name.startswith(("ale:", "dmc:"))
 
 
-def make_host_env(name: str, num_envs: int, seed: int = 0) -> HostVectorEnv:
+def make_host_env(name: str, num_envs: int, seed: int = 0,
+                  for_eval: bool = False) -> HostVectorEnv:
     """Build a host vector env by name.
 
     ``"CartPole-v1"`` etc. -> plain gymnasium; ``"ale:<Game>"`` -> ALE with
@@ -223,18 +254,36 @@ def make_host_env(name: str, num_envs: int, seed: int = 0) -> HostVectorEnv:
         game = name.split(":", 1)[1]
 
         def make_fn():
+            # ALE evaluation-protocol knobs, env-var routed so they reach
+            # multiprocessing-"spawn" actor processes (same design as
+            # DQN_FAKE_ALE): sticky actions (repeat_action_probability;
+            # 0 = the v4 registration default, 0.25 = ALE-recommended)
+            # and episodic-life termination. Episodic life is a TRAINING
+            # device (value bootstrapping stops at life boundaries) —
+            # eval envs (for_eval=True) keep whole-game episodes so
+            # eval_return stays the per-game score; sticky actions apply
+            # to eval too (the Machado et al. protocol evaluates under
+            # the same stochasticity).
+            import os
+
+            sticky = float(os.environ.get("DQN_ALE_STICKY", "0") or 0.0)
+            episodic = (os.environ.get("DQN_ALE_EPISODIC_LIFE") == "1"
+                        and not for_eval)
+            kwargs = ({"repeat_action_probability": sticky} if sticky
+                      else {})
             factory = _resolve_ale_factory()
             if factory is not None:
-                return AtariPreprocessing(factory(game))
+                return AtariPreprocessing(factory(game, **kwargs),
+                                          episodic_life=episodic)
             try:
-                env = gymnasium.make(f"{game}NoFrameskip-v4")
+                env = gymnasium.make(f"{game}NoFrameskip-v4", **kwargs)
             except gymnasium.error.Error as e:
                 raise NotImplementedError(
                     f"ALE Atari ({game}) needs ale-py, which is not in this "
                     "offline image; use the synthetic pixel_pong env, set "
                     "DQN_FAKE_ALE=1 for the in-repo fake, or install "
                     "ale-py") from e
-            return AtariPreprocessing(env)
+            return AtariPreprocessing(env, episodic_life=episodic)
     else:
         def make_fn():
             return gymnasium.make(name)

@@ -54,6 +54,130 @@ def test_ale_branch_full_preprocessing_pipeline():
         set_ale_factory(None)
 
 
+def test_breakout_minimal_action_set_and_lives():
+    """Atari-57 variation axis #1 (VERDICT round 2 next #5): a different
+    minimal action set (4 vs Pong's 6) and real lives accounting."""
+    env = FakeALEEnv("Breakout")
+    assert env.action_space.n == 4
+    frame, info = env.reset(seed=0)
+    assert frame.shape == (210, 160, 3) and info["lives"] == 5
+    # Fire-to-serve: without FIRE the ball never leaves the paddle and no
+    # life can be lost.
+    for _ in range(200):
+        _, r, term, trunc, info = env.step(0)
+        assert r == 0.0 and not term and info["lives"] == 5
+    # Serve, then run; lives must tick down to 0 and only then terminate.
+    seen_lives = set()
+    term = False
+    for t in range(60_000):
+        a = 1 if t % 50 == 0 else 0   # re-FIRE after each life loss
+        _, r, term, trunc, info = env.step(a)
+        seen_lives.add(info["lives"])
+        if term:
+            break
+    assert term and info["lives"] == 0
+    assert seen_lives == {0, 1, 2, 3, 4, 5}
+
+
+def test_breakout_rewards_are_row_graded_and_adapter_clips():
+    """Raw brick rewards are 1/4/7 by row (need clipping); the adapter's
+    clip keeps what the learner sees in [-1, 1]."""
+    env = FakeALEEnv("Breakout")
+    env.reset(seed=1)
+    raw = set()
+    for t in range(30_000):
+        _, r, term, _, _ = env.step(1 if t % 40 == 0 else (2 if t % 2 else 3))
+        if r:
+            raw.add(float(r))
+        if term:
+            env.reset()
+    assert raw & {1.0, 4.0, 7.0} and max(raw) > 1.0
+    set_ale_factory(FakeALEEnv)
+    try:
+        venv = make_host_env("ale:Breakout", num_envs=1, seed=2)
+        assert venv.num_actions == 4
+        venv.reset()
+        clipped = []
+        for t in range(2000):
+            _, _, rew, _, _ = venv.step(np.array(
+                [1 if t % 10 == 0 else (2 if t % 2 else 3)]))
+            clipped.append(float(rew[0]))
+        assert max(np.abs(clipped)) <= 1.0 and max(clipped) > 0.0
+    finally:
+        set_ale_factory(None)
+
+
+@pytest.mark.parametrize("game,n_actions", [("Pong", 6), ("Breakout", 4)])
+def test_sticky_actions_repeat_previous(game, n_actions):
+    """ALE sticky rule, both games: with p=1.0, after the first executed
+    action every later env transition repeats it — trajectories diverge
+    from the p=0.0 env fed the identical action stream."""
+    def run(p):
+        env = FakeALEEnv(game, repeat_action_probability=p)
+        env.reset(seed=7)
+        frames = []
+        for t in range(120):
+            f, _, term, trunc, _ = env.step(2 if t % 2 == 0 else 3)
+            frames.append(f)
+            if term or trunc:
+                break
+        return np.stack(frames)
+    a, b = run(0.0), run(1.0)
+    assert a.shape == b.shape
+    assert (a != b).any()
+    # And p=1.0 ignores the incoming action stream entirely (everything
+    # repeats the initial NOOP): two p=1.0 envs fed DIFFERENT action
+    # streams stay frame-identical.
+    env = FakeALEEnv(game, repeat_action_probability=1.0)
+    env.reset(seed=7)
+    env2 = FakeALEEnv(game, repeat_action_probability=1.0)
+    env2.reset(seed=7)
+    for t in range(60):
+        f1, *_ = env.step(2 if t % 2 == 0 else 3)
+        f2, *_ = env2.step(0)
+        assert (f1 == f2).all()
+
+
+@pytest.mark.parametrize("game", ["Breakout", "Pong"])
+def test_episodic_life_adapter_semantics(game, monkeypatch):
+    """Adapter-level episodic life on both lives shapes: Breakout (5
+    lives) must signal terminated at each life loss WITHOUT resetting the
+    underlying game; Pong (no lives, info lives=0) must be unaffected."""
+    from dist_dqn_tpu.envs.gym_adapter import AtariPreprocessing
+
+    raw = FakeALEEnv(game)
+    pre = AtariPreprocessing(raw, episodic_life=True)
+    pre.reset(seed=3)
+    if game == "Pong":
+        for t in range(500):
+            _, _, term, trunc = pre.step(t % 6)
+            assert not term or raw._score != [0, 0]  # only real game end
+            if term or trunc:
+                break
+        return
+    # Breakout: play until the first life loss.
+    term = False
+    for t in range(20_000):
+        _, _, term, trunc = pre.step(1 if t % 40 == 0 else 0)
+        if term:
+            break
+    assert term, "no life loss within budget"
+    assert raw._lives == 4          # life lost...
+    assert pre._real_done is False  # ...but the game is NOT over
+    # reset() must CONTINUE the same game (lives stay at 4, no full reset).
+    pre.reset()
+    assert raw._lives == 4
+    # Env-var routing through make_host_env (spawned-actor path).
+    monkeypatch.setenv("DQN_FAKE_ALE", "1")
+    monkeypatch.setenv("DQN_ALE_EPISODIC_LIFE", "1")
+    monkeypatch.setenv("DQN_ALE_STICKY", "0.25")
+    venv = make_host_env("ale:Breakout", num_envs=1, seed=4)
+    inner = venv.envs[0]
+    assert inner.episodic_life is True
+    assert inner.env.repeat_action_probability == 0.25
+    assert venv.reset().shape == (1, 84, 84, 4)
+
+
 def test_ale_env_var_routing(monkeypatch):
     monkeypatch.setenv("DQN_FAKE_ALE", "1")
     venv = make_host_env("ale:Breakout", num_envs=1)
