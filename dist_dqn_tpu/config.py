@@ -14,7 +14,8 @@ from typing import Dict, Tuple
 class NetworkConfig:
     """Q-network architecture knobs (models/qnets.py, models/recurrent.py)."""
 
-    torso: str = "nature"              # "mlp" | "nature" (84x84 Atari CNN)
+    torso: str = "nature"  # "mlp" | "nature" (84x84 Atari CNN) | "small"
+    #                        (cheap 84x84 CNN — models/qnets.py presets)
     mlp_features: Tuple[int, ...] = (256, 256)
     hidden: int = 512                  # post-torso embedding width
     dueling: bool = False              # dueling value/advantage streams

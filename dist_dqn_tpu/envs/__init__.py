@@ -18,6 +18,9 @@ def make_jax_env(name: str, **kwargs):
         return CartPole(**kwargs)
     if name == "pixel_pong":
         return PixelPong(**kwargs)
+    if name == "pixel_catch":
+        from dist_dqn_tpu.envs.pixel_catch import PixelCatch
+        return PixelCatch(**kwargs)
     if name == "dmc_pixels":
         # The fused on-device loop cannot host MuJoCo; it runs the synthetic
         # DMC-shaped reacher. Real dm_control pixels go through the host

@@ -69,20 +69,37 @@ class NoisyDense(nn.Module):
         return (y + b.astype(self.dtype)).astype(jnp.float32)
 
 
-class NatureCNN(nn.Module):
-    """The 84x84 Atari torso (Mnih et al., 2015): 8x8/4, 4x4/2, 3x3/1 convs."""
+# (features, kernel, stride) stacks for the named CNN torsos:
+#   nature — the 84x84 Atari torso (Mnih et al., 2015)
+#   small  — ~7x cheaper variant for dev boxes and fast pixel tests
+CNN_TORSO_LAYERS = {
+    "nature": ((32, 8, 4), (64, 4, 2), (64, 3, 1)),
+    "small": ((16, 8, 4), (32, 4, 2)),
+}
 
+
+class CNNTorso(nn.Module):
+    """Stacked VALID convs + flatten; ``layers`` holds one (features,
+    kernel, stride) tuple per conv (named presets: CNN_TORSO_LAYERS)."""
+
+    layers: Tuple[Tuple[int, int, int], ...] = CNN_TORSO_LAYERS["nature"]
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         # x: [B, 84, 84, C] float in [0, 1]
         x = x.astype(self.dtype)
-        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+        for features, kernel, stride in self.layers:
             x = nn.Conv(features, (kernel, kernel), strides=(stride, stride),
                         padding="VALID", dtype=self.dtype)(x)
             x = nn.relu(x)
         return x.reshape((x.shape[0], -1))
+
+
+def NatureCNN(dtype: jnp.dtype = jnp.float32) -> CNNTorso:
+    """The classic Atari torso as a CNNTorso preset (kept as the public
+    name other modules/tests import)."""
+    return CNNTorso(CNN_TORSO_LAYERS["nature"], dtype=dtype)
 
 
 class MLPTorso(nn.Module):
@@ -142,8 +159,9 @@ class QNetwork(nn.Module):
         x = obs
         if x.dtype == jnp.uint8:
             x = x.astype(self.compute_dtype) / 255.0
-        if self.torso == "nature":
-            x = NatureCNN(dtype=self.compute_dtype)(x)
+        if self.torso in CNN_TORSO_LAYERS:
+            x = CNNTorso(CNN_TORSO_LAYERS[self.torso],
+                         dtype=self.compute_dtype)(x)
         elif self.torso == "mlp":
             x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
         else:

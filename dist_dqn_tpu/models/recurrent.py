@@ -43,13 +43,15 @@ class _Embed(nn.Module):
 
     @nn.compact
     def __call__(self, obs: Array) -> Array:
-        from dist_dqn_tpu.models.qnets import MLPTorso, NatureCNN
+        from dist_dqn_tpu.models.qnets import (CNN_TORSO_LAYERS, CNNTorso,
+                                               MLPTorso)
 
         x = obs
         if x.dtype == jnp.uint8:
             x = x.astype(self.compute_dtype) / 255.0
-        if self.torso == "nature":
-            x = NatureCNN(dtype=self.compute_dtype)(x)
+        if self.torso in CNN_TORSO_LAYERS:
+            x = CNNTorso(CNN_TORSO_LAYERS[self.torso],
+                         dtype=self.compute_dtype)(x)
         elif self.torso == "mlp":
             x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
         else:

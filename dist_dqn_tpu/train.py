@@ -44,7 +44,7 @@ def _pick_mesh_devices(num_devices: int, multiprocess: bool):
 def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
           checkpoint_dir: str = None, save_every_frames: int = 0,
-          profile_dir: str = None, num_devices: int = 1):
+          profile_dir: str = None, num_devices: int = 1, stop_fn=None):
     """Run training; returns (final_carry, history list of metric dicts).
 
     With ``checkpoint_dir`` set, the learner state is checkpointed every
@@ -141,7 +141,9 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     B = cfg.actor.num_envs
     history = []
     frames = frame_offset
-    next_eval = frames
+    # 0 disables eval entirely (same convention as the apex runtime's
+    # eval_every_steps); otherwise the first chunk gets a baseline eval.
+    next_eval = frames if cfg.eval_every_steps else float("inf")
     chunk_index = 0
     # Trace the second chunk (the first is compile+warmup noise) — unless
     # the whole run fits in one chunk, then trace that one rather than none.
@@ -178,6 +180,12 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                            for k, v in row.items()}))
         if ckpt is not None:
             ckpt.maybe_save(frames, carry.learner)
+        # Early stop (single-process only: a data-dependent exit would
+        # desync multi-process lockstep): stop_fn sees each metric row —
+        # solve-detection for tests, target-return stops for users.
+        if stop_fn is not None and jax.process_count() == 1 \
+                and stop_fn(row):
+            break
     if ckpt is not None:
         ckpt.save(frames, carry.learner)
         ckpt.close()

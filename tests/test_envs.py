@@ -80,6 +80,38 @@ def test_pixel_pong_episode_ends():
     assert done
 
 
+def test_pixel_catch_contract_and_tracking_policy_wins():
+    """Contract checks + semantic sanity: a scripted track-the-ball policy
+    must catch (reward +1) every episode — if it can't, the learning test
+    in test_pixel_learning.py would be measuring a broken env."""
+    from dist_dqn_tpu.envs.pixel_catch import PixelCatch
+
+    env = PixelCatch()
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    assert obs.shape == (84, 84, 4) and obs.dtype == jnp.uint8
+    assert np.asarray(obs).max() == 255
+    step = jax.jit(env.step)
+    caught = missed = 0
+    for _ in range(200):
+        # Track: move toward the ball column (state is visible to the
+        # script; the LEARNER only ever sees pixels).
+        a = jnp.where(state.ball_x < state.pad_x - 1.0, 1,
+                      jnp.where(state.ball_x > state.pad_x + 1.0, 2, 0))
+        state, out = step(state, a)
+        if float(out.reward) > 0:
+            caught += 1
+        elif float(out.reward) < 0:
+            missed += 1
+    assert caught >= 5 and missed == 0, (caught, missed)
+    # And a always-NOOP policy must miss sometimes (the task is not free).
+    state, _ = env.reset(jax.random.PRNGKey(3))
+    rewards = []
+    for _ in range(300):
+        state, out = step(state, jnp.int32(0))
+        rewards.append(float(out.reward))
+    assert -1.0 in rewards
+
+
 def test_pixel_pong_framestack_shifts():
     env = PixelPong()
     state, obs = env.reset(jax.random.PRNGKey(2))

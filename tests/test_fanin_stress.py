@@ -85,6 +85,59 @@ class _SyntheticFleet:
             {"kind": "step", "actor": a, "t": self.t[a]})
 
 
+def _drive_fleet(service, fleet, ring, boxes, steps: int, lanes: int,
+                 flush_pending: bool = True, timeout_s: float = 600.0
+                 ) -> tuple:
+    """Shared shm drive loop for both fan-in stresses: staggered join
+    waves (wave A hellos first and advances a few steps before wave B, so
+    actor step counters desynchronize — a misrouted reply then shows up
+    as a version mismatch), full-ring retry exactly as real actors spin,
+    and the per-reply routing assertion. Returns (records, seconds)."""
+    ids = sorted(fleet.t)
+    wave_a, wave_b = ids[0::2], ids[1::2]
+    active = list(wave_a)
+    backlog = [(a, fleet.hello(a)) for a in wave_a]
+    wave_b_joined = False
+    t0 = time.perf_counter()
+    records = 0
+    deadline = time.monotonic() + timeout_s
+    while True:
+        still = []
+        for a, payload in backlog:
+            if not ring.push(payload):
+                still.append((a, payload))
+            else:
+                records += 1
+        backlog = still
+        service._drain_transports()
+        service._flush_act_queue()
+        if flush_pending:
+            service._flush_pending()
+        service._maybe_train()
+        for a in active:
+            data, ver = boxes[a].read()
+            if data is None or ver <= fleet.last_ver[a]:
+                continue
+            # THE routing assertion: this mailbox must only ever see
+            # the reply for ITS actor's current step.
+            assert ver == fleet.t[a] + 1, (a, ver, fleet.t[a])
+            arrays, _ = decode_arrays(data)
+            assert arrays["action"].shape == (lanes,)
+            fleet.last_ver[a] = ver
+            if fleet.sent_steps[a] < steps:
+                backlog.append((a, fleet.step_record(a)))
+        if not wave_b_joined and \
+                all(fleet.sent_steps[a] >= 2 for a in wave_a):
+            backlog.extend((a, fleet.hello(a)) for a in wave_b)
+            active.extend(wave_b)
+            wave_b_joined = True
+        if all(s >= steps for s in fleet.sent_steps.values()) \
+                and all(fleet.last_ver[a] == fleet.t[a] + 1
+                        for a in active) and not backlog:
+            return records, time.perf_counter() - t0
+        assert time.monotonic() < deadline, "fan-in stress timed out"
+
+
 @pytest.mark.slow
 def test_shm_fanin_256_actors():
     N, LANES, STEPS = 256, 16, 8
@@ -96,54 +149,8 @@ def test_shm_fanin_256_actors():
         ring = ShmRing(f"req_{service.run_id}")
         boxes = [ShmMailbox(f"act_{service.run_id}_{i}") for i in range(N)]
         fleet = _SyntheticFleet(range(N), LANES)
-        # Staggered join: wave A hellos first and advances a few steps
-        # before wave B joins, so actor step counters desynchronize —
-        # a misrouted reply then shows up as a version mismatch.
-        wave_a, wave_b = list(range(0, N, 2)), list(range(1, N, 2))
-        active = list(wave_a)
-        backlog = [(a, fleet.hello(a)) for a in wave_a]
-        wave_b_joined = False
-        t0 = time.perf_counter()
-        records = 0
-        deadline = time.monotonic() + 600
-        while True:
-            # Push what the "fleet" has ready (retrying on a full ring —
-            # real actors spin exactly the same way).
-            still = []
-            for a, payload in backlog:
-                if not ring.push(payload):
-                    still.append((a, payload))
-                else:
-                    records += 1
-            backlog = still
-            service._drain_transports()
-            service._flush_act_queue()
-            service._flush_pending()
-            service._maybe_train()
-            for a in active:
-                data, ver = boxes[a].read()
-                if data is None or ver <= fleet.last_ver[a]:
-                    continue
-                # THE routing assertion: this mailbox must only ever see
-                # the reply for ITS actor's current step.
-                assert ver == fleet.t[a] + 1, \
-                    (a, ver, fleet.t[a])
-                arrays, _ = decode_arrays(data)
-                assert arrays["action"].shape == (LANES,)
-                fleet.last_ver[a] = ver
-                if fleet.sent_steps[a] < STEPS:
-                    backlog.append((a, fleet.step_record(a)))
-            if not wave_b_joined and \
-                    all(fleet.sent_steps[a] >= 2 for a in wave_a):
-                backlog.extend((a, fleet.hello(a)) for a in wave_b)
-                active.extend(wave_b)
-                wave_b_joined = True
-            if all(s >= STEPS for s in fleet.sent_steps.values()) \
-                    and all(fleet.last_ver[a] == fleet.t[a] + 1
-                            for a in active) and not backlog:
-                break
-            assert time.monotonic() < deadline, "fan-in stress timed out"
-        dt = time.perf_counter() - t0
+        records, dt = _drive_fleet(service, fleet, ring, boxes, STEPS,
+                                   LANES)
         service._flush_pending(force=True)
         service._finalize_all_train()
 
@@ -161,6 +168,52 @@ def test_shm_fanin_256_actors():
         print(f"\nfanin-shm: {records} records ({service.env_steps} env "
               f"steps) in {dt:.1f}s = {rate:.0f} records/s host-side")
         assert rate > 0
+    finally:
+        service.shutdown()
+
+
+@pytest.mark.slow
+def test_shm_fanin_recurrent_64_actors():
+    """R2D2 variant of the fan-in stress: the recurrent service path keeps
+    per-actor LSTM carries and Q planes and routes them through the
+    batched act flush — a mis-slice there corrupts experience silently,
+    so drive it at fan-in scale (64 actors x 8 lanes) with the same
+    staggered-wave version-lockstep routing assertions."""
+    base = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        base,
+        network=dataclasses.replace(base.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    lstm_size=16, dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(base.replay, capacity=4096, min_fill=64,
+                                   burn_in=2, unroll_length=6,
+                                   sequence_stride=3),
+        learner=dataclasses.replace(base.learner, batch_size=16, n_step=2),
+    )
+    N, LANES, STEPS = 64, 8, 14
+    rt = ApexRuntimeConfig(num_actors=N, envs_per_actor=LANES,
+                           total_env_steps=10 ** 9, ring_mb=8,
+                           stall_warn_s=0.0, log_every_s=10 ** 9,
+                           inserts_per_grad_step=16)
+    service = ApexLearnerService(cfg, rt, log_fn=lambda *a: None)
+    try:
+        ring = ShmRing(f"req_{service.run_id}")
+        boxes = [ShmMailbox(f"act_{service.run_id}_{i}") for i in range(N)]
+        fleet = _SyntheticFleet(range(N), LANES)
+        # flush_pending is a no-op on the recurrent path (sequences insert
+        # directly in _handle_record) — skipped to keep the loop honest.
+        _drive_fleet(service, fleet, ring, boxes, STEPS, LANES,
+                     flush_pending=False)
+        service._finalize_all_train()
+        assert service.req_ring.dropped == 0
+        assert service.bad_records == 0
+        assert service.env_steps == N * LANES * STEPS
+        # Every actor's carry must exist and have its own lane count.
+        assert all(c is not None and c[0].shape == (LANES, 16)
+                   for c in service._carry)
+        assert len(service.replay) > 64     # sequence windows emitted
+        assert service.grad_steps > 0
     finally:
         service.shutdown()
 

@@ -1,0 +1,59 @@
+"""Pixel-path LEARNING evidence (VERDICT round 2, next #4).
+
+The Atari-shaped configs previously had only smoke/loss-finite tests; this
+pins actual return improvement through the full pixel pipeline — uint8
+frame-stack observations, CNN torso, n-step TD, replay ring (uniform and
+prioritized variants) — on PixelCatch, the cheap pixel task added for
+exactly this purpose (envs/pixel_catch.py; pixel Pong cannot beat random
+within any test budget on this 1-core box — measured 48k frames/500s with
+zero movement).
+
+Calibrated on this box: the uniform run reaches episode_return ~+0.9 by
+~96k frames (415s); the test early-stops at +0.5 (~56k frames, ~4 min).
+Random policy sits at ~-0.6 — the +0.5 bar is a >1.1 margin over random,
+unreachable without learning.
+"""
+import dataclasses
+
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.train import train
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+RANDOM_BASELINE = -0.6   # measured: eps~1 early chunks sit at -0.64..-0.58
+TARGET = 0.5
+
+
+def _catch_cfg(prioritized: bool):
+    cfg = CONFIGS["atari"]
+    return dataclasses.replace(
+        cfg,
+        env_name="pixel_catch",
+        network=dataclasses.replace(cfg.network, torso="small", hidden=128),
+        actor=dataclasses.replace(cfg.actor, num_envs=32,
+                                  epsilon_decay_steps=10_000),
+        replay=dataclasses.replace(cfg.replay, capacity=16_384,
+                                   min_fill=1_500,
+                                   prioritized=prioritized),
+        learner=dataclasses.replace(cfg.learner, batch_size=32,
+                                    learning_rate=1e-3, n_step=5,
+                                    target_update_period=250),
+        train_every=2,
+        eval_every_steps=0,   # off — eval rollouts are the expensive part
+    )
+
+
+@pytest.mark.parametrize("prioritized", [False, True],
+                         ids=["uniform", "per"])
+def test_pixel_catch_beats_random_by_clear_margin(prioritized):
+    stop = lambda row: row["episode_return"] >= TARGET  # noqa: E731
+    carry, history = train(_catch_cfg(prioritized), total_env_steps=96_000,
+                           chunk_iters=250, log_fn=lambda s: None,
+                           stop_fn=stop)
+    returns = [r["episode_return"] for r in history]
+    # Starts at the random baseline (sanity that the bar means something)...
+    assert returns[0] < RANDOM_BASELINE + 0.3, returns
+    # ...and ends clearly above it.
+    assert max(returns) >= TARGET, returns

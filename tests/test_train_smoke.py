@@ -8,11 +8,15 @@ import pytest
 
 pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
-def test_cartpole_learns():
+def test_cartpole_solves():
+    """The driver's CPU-reference config must actually SOLVE CartPole
+    (eval >= 475 of the 500 cap), not merely trend upward — pinning the
+    BASELINE.md claim (VERDICT round 2, next #4). Early-stops at solve;
+    calibrated on this box: solve at ~176k frames, ~35s."""
     cfg = CONFIGS["cartpole"]
-    carry, history = train(cfg, total_env_steps=64_000, chunk_iters=1000,
-                           log_fn=lambda s: None)
+    stop = lambda row: row.get("eval_return", 0.0) >= 475.0  # noqa: E731
+    carry, history = train(cfg, total_env_steps=360_000, chunk_iters=1000,
+                           log_fn=lambda s: None, stop_fn=stop)
     evals = [row["eval_return"] for row in history if "eval_return" in row]
-    returns = [row["episode_return"] for row in history]
-    assert max(evals + returns) >= 150.0, (evals, returns)
+    assert evals and max(evals) >= 475.0, evals
     assert all(abs(r["loss"]) < 1e3 for r in history)
