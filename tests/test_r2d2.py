@@ -186,15 +186,22 @@ def test_r2d2_fused_loop_learns_cartpole():
                                     value_rescale=True),
         actor=dataclasses.replace(cfg.actor, num_envs=16,
                                   epsilon_decay_steps=15_000),
-        total_env_steps=60_000,
+        total_env_steps=480_000,
         eval_every_steps=20_000,
     )
     from dist_dqn_tpu.train import train
-    carry, history = train(cfg, chunk_iters=500, log_fn=lambda s: None)
-    returns = [row["episode_return"] for row in history]
+    # SOLVE bar (VERDICT round 2, next #4: lenient bars prove "learning
+    # happens", not "works"). Calibrated: eval 500.0 at ~176k frames
+    # (~85s) outside pytest; the pytest import environment compiles
+    # slightly different float programs and the chaotic trajectory
+    # diverges (455.9 max by 240k frames on one run), so the budget
+    # carries 2x headroom — verified green UNDER pytest at this budget
+    # (passed in 2:05, early-stopped). Early-stops at the bar.
+    stop = lambda row: row.get("eval_return", 0.0) >= 475.0  # noqa: E731
+    carry, history = train(cfg, chunk_iters=500, log_fn=lambda s: None,
+                           stop_fn=stop)
     evals = [row["eval_return"] for row in history if "eval_return" in row]
-    # Learning smoke: clearly above the ~20-step random-policy return.
-    assert max(returns + evals) >= 80.0, (returns, evals)
+    assert evals and max(evals) >= 475.0, evals
     assert all(abs(r["loss"]) < 1e3 for r in history)
 
 

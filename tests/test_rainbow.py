@@ -83,11 +83,13 @@ def test_rainbow_combination_learns_cartpole():
     )
     assert cfg.network.noisy and cfg.network.dueling \
         and cfg.network.num_atoms > 1 and cfg.replay.prioritized
-    carry, history = train(cfg, total_env_steps=64_000, chunk_iters=1000,
-                           log_fn=lambda s: None)
-    evals = [r.get("eval_return", 0) for r in history]
-    returns = [r["episode_return"] for r in history]
-    assert max(evals + returns) >= 100.0, (evals, returns)
+    # SOLVE bar (VERDICT round 2, next #4). Calibrated: eval 488.6 at
+    # ~144k frames, ~41s on this box; early-stops at the bar.
+    stop = lambda row: row.get("eval_return", 0.0) >= 475.0  # noqa: E731
+    carry, history = train(cfg, total_env_steps=300_000, chunk_iters=1000,
+                           log_fn=lambda s: None, stop_fn=stop)
+    evals = [r["eval_return"] for r in history if "eval_return" in r]
+    assert evals and max(evals) >= 475.0, evals
 
 
 @pytest.mark.slow
