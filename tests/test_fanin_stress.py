@@ -86,18 +86,29 @@ class _SyntheticFleet:
 
 
 def _drive_fleet(service, fleet, ring, boxes, steps: int, lanes: int,
-                 flush_pending: bool = True, timeout_s: float = 600.0
-                 ) -> tuple:
+                 flush_pending: bool = True, timeout_s: float = 600.0,
+                 join: bool = True) -> tuple:
     """Shared shm drive loop for both fan-in stresses: staggered join
     waves (wave A hellos first and advances a few steps before wave B, so
     actor step counters desynchronize — a misrouted reply then shows up
     as a version mismatch), full-ring retry exactly as real actors spin,
-    and the per-reply routing assertion. Returns (records, seconds)."""
+    and the per-reply routing assertion. Returns (records, seconds).
+
+    ``join=False`` continues an already-joined fleet (every actor has
+    consumed the reply to its previous record) without re-helloing —
+    the steady-state measurement phase, past the jit-compile warmup.
+    """
     ids = sorted(fleet.t)
-    wave_a, wave_b = ids[0::2], ids[1::2]
-    active = list(wave_a)
-    backlog = [(a, fleet.hello(a)) for a in wave_a]
-    wave_b_joined = False
+    if join:
+        wave_a, wave_b = ids[0::2], ids[1::2]
+        active = list(wave_a)
+        backlog = [(a, fleet.hello(a)) for a in wave_a]
+        wave_b_joined = False
+    else:
+        wave_a, wave_b = ids, []
+        active = list(ids)
+        backlog = [(a, fleet.step_record(a)) for a in ids]
+        wave_b_joined = True
     t0 = time.perf_counter()
     records = 0
     deadline = time.monotonic() + timeout_s
@@ -149,14 +160,20 @@ def test_shm_fanin_256_actors():
         ring = ShmRing(f"req_{service.run_id}")
         boxes = [ShmMailbox(f"act_{service.run_id}_{i}") for i in range(N)]
         fleet = _SyntheticFleet(range(N), LANES)
+        # Phase 1 (cold): joins + every jit-compile variant (~6.6s of a
+        # ~13s cold drive is XLA compilation, profiled round 3).
         records, dt = _drive_fleet(service, fleet, ring, boxes, STEPS,
                                    LANES)
+        # Phase 2 (steady state): same fleet keeps stepping — this is
+        # the rate that corresponds to production ingestion.
+        records2, dt2 = _drive_fleet(service, fleet, ring, boxes,
+                                     2 * STEPS, LANES, join=False)
         service._flush_pending(force=True)
         service._finalize_all_train()
 
         assert service.req_ring.dropped == 0
         assert service.bad_records == 0
-        assert service.env_steps == N * LANES * STEPS
+        assert service.env_steps == N * LANES * 2 * STEPS
         assert len(service.replay) > service.cfg.replay.min_fill
         assert service.grad_steps > 0
         # Power-of-two act bucketing: the jit cache must hold O(log N)
@@ -164,10 +181,15 @@ def test_shm_fanin_256_actors():
         cache_size = getattr(service._act, "_cache_size", None)
         if callable(cache_size):
             assert cache_size() <= 14, cache_size()
-        rate = records / dt
-        print(f"\nfanin-shm: {records} records ({service.env_steps} env "
-              f"steps) in {dt:.1f}s = {rate:.0f} records/s host-side")
-        assert rate > 0
+        print(f"\nfanin-shm cold: {records} records in {dt:.1f}s = "
+              f"{records / dt:.0f} rec/s; steady: {records2} records "
+              f"({records2 * LANES} env steps) in {dt2:.1f}s = "
+              f"{records2 / dt2:.0f} rec/s host-side")
+        # No cold-vs-steady rate comparison: with a warm compile cache
+        # the phases measure the same loop and a strict '>' would be a
+        # wall-clock race. The rates are informational; correctness is
+        # the accounting above.
+        assert records2 / dt2 > 0
     finally:
         service.shutdown()
 
