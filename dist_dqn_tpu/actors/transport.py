@@ -150,6 +150,7 @@ class ShmMailbox:
                           f"failed: {self.path.decode()}")
         self._lib = lib
         self._cap = max_size
+        self._read_buf = None   # lazily sized, reused across read() calls
 
     def write(self, payload: bytes, version: int) -> None:
         rc = self._lib.dqn_box_write(self._h, payload, len(payload), version)
@@ -157,7 +158,13 @@ class ShmMailbox:
             raise ValueError("payload exceeds mailbox size")
 
     def read(self, max_size: int = 1 << 20) -> Tuple[Optional[bytes], int]:
-        buf = ctypes.create_string_buffer(max_size)
+        # The scratch buffer is reused: actors poll their mailbox every
+        # few hundred microseconds, and a fresh 1 MB allocation per poll
+        # was a measurable share of the steady-state ingest profile. One
+        # reader per mailbox by protocol, so reuse is race-free.
+        buf = self._read_buf
+        if buf is None or ctypes.sizeof(buf) < max_size:
+            self._read_buf = buf = ctypes.create_string_buffer(max_size)
         ver = ctypes.c_uint64(0)
         n = self._lib.dqn_box_read(self._h, buf, max_size,
                                    ctypes.byref(ver))
