@@ -1,0 +1,124 @@
+"""One-command TPU measurement battery (VERDICT round 2/3, next #1).
+
+The axon tunnel has been wedged since mid-round-1, so every round's TPU
+measurement plan is "capture everything the moment it returns". This
+script IS that capture: it probes the backend first (bounded, wedge-safe)
+and then runs, in order of value-per-second and with per-stage timeouts:
+
+  1. bench.py                      — headline env-steps/sec/chip + mfu
+  2. learner_bench (all configs)   — grad-steps/sec + per-config MFU
+  3. learner_bench --r2d2-sweep    — remat x lstm_dtype x unroll
+  4. sampler_bench                 — Pallas vs XLA vs C++ tree crossover
+
+Every stage runs in its own subprocess so a wedge mid-battery loses only
+the remaining stages, and each writes its raw JSON lines to
+``--out-dir`` (default docs/tpu_runs/<timestamp>/) for BASELINE.md.
+
+Wedge discipline (see .claude/skills/verify/SKILL.md): stages are sized
+to finish rather than need interruption, SIGTERM (never SIGKILL) is used
+on timeout so utils/device_cleanup.py can release the grant, and the
+probe runs FIRST so a wedged tunnel exits in 60s with a clear message.
+
+Usage:  python benchmarks/tpu_battery.py [--probe-only] [--out-dir DIR]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+STAGES = [
+    ("bench", [sys.executable, "bench.py"], 1200),
+    ("learner_bench", [sys.executable, "benchmarks/learner_bench.py"], 1200),
+    ("r2d2_sweep", [sys.executable, "benchmarks/learner_bench.py",
+                    "--r2d2-sweep", "--iters", "30"], 1800),
+    ("sampler_bench", [sys.executable, "benchmarks/sampler_bench.py"], 1200),
+]
+
+
+def probe(timeout_s: float = 60.0) -> bool:
+    """Bounded backend probe in a subprocess; True iff devices respond."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print([d.platform for d in jax.devices()])"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode == 0 and bool(out.strip())
+    except subprocess.TimeoutExpired:
+        proc.terminate()     # SIGTERM: device_cleanup releases the grant
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return False
+
+
+def run_stage(name: str, cmd: list, timeout_s: int, out_dir: Path) -> dict:
+    log = out_dir / f"{name}.jsonl"
+    t0 = time.time()
+    with open(log, "w") as fh:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=fh,
+                                stderr=subprocess.STDOUT)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGTERM)   # polite: grant release
+            try:
+                rc = proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = -9
+    return {"stage": name, "rc": rc, "seconds": round(time.time() - t0, 1),
+            "log": str(log)}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--probe-only", action="store_true")
+    p.add_argument("--out-dir", default=None)
+    args = p.parse_args()
+
+    ok = probe()
+    print(json.dumps({"probe": "ok" if ok else "wedged",
+                      "ts": time.strftime("%Y-%m-%d %H:%M:%S")}),
+          flush=True)
+    if not ok:
+        print(json.dumps({"battery": "skipped",
+                          "reason": "tunnel wedged — probe hung/failed; "
+                                    "re-run when jax.devices() responds"}),
+              flush=True)
+        return 3
+    if args.probe_only:
+        return 0
+
+    out_dir = Path(args.out_dir or
+                   REPO / "docs" / "tpu_runs" / time.strftime("%Y%m%d_%H%M"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+    results = []
+    for name, cmd, timeout_s in STAGES:
+        res = run_stage(name, cmd, timeout_s, out_dir)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        if res["rc"] not in (0,):
+            # A wedge mid-battery poisons every later device touch; stop
+            # rather than queue three more hangs.
+            print(json.dumps({"battery": "aborted_after", "stage": name}),
+                  flush=True)
+            break
+    (out_dir / "summary.json").write_text(json.dumps(results, indent=2))
+    print(json.dumps({"battery": "done", "out_dir": str(out_dir)}),
+          flush=True)
+    return 0 if all(r["rc"] == 0 for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
