@@ -43,23 +43,30 @@ STAGES = [
 ]
 
 
-def probe(timeout_s: float = 60.0) -> bool:
-    """Bounded backend probe in a subprocess; True iff devices respond."""
+def probe(timeout_s: float = 60.0) -> tuple:
+    """Bounded backend probe in a subprocess.
+
+    Returns (responded, platforms): a wedged tunnel yields (False, "");
+    a silent CPU fallback yields (True, "cpu") — the caller must check
+    the platform, or the battery would spend an hour recording CPU
+    numbers that BASELINE.md would cite as TPU measurements.
+    """
     proc = subprocess.Popen(
         [sys.executable, "-c",
-         "import jax; print([d.platform for d in jax.devices()])"],
+         "import jax; print(','.join(sorted({d.platform "
+         "for d in jax.devices()})))"],
         cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         text=True)
     try:
         out, _ = proc.communicate(timeout=timeout_s)
-        return proc.returncode == 0 and bool(out.strip())
+        return proc.returncode == 0 and bool(out.strip()), out.strip()
     except subprocess.TimeoutExpired:
         proc.terminate()     # SIGTERM: device_cleanup releases the grant
         try:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
-        return False
+        return False, ""
 
 
 def run_stage(name: str, cmd: list, timeout_s: int, out_dir: Path) -> dict:
@@ -85,18 +92,31 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--probe-only", action="store_true")
     p.add_argument("--out-dir", default=None)
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="run the battery even on a CPU-only backend "
+                        "(smoke-testing the harness; NOT for BASELINE "
+                        "numbers)")
     args = p.parse_args()
 
-    ok = probe()
-    print(json.dumps({"probe": "ok" if ok else "wedged",
+    responded, platforms = probe()
+    print(json.dumps({"probe": "ok" if responded else "wedged",
+                      "platforms": platforms,
                       "ts": time.strftime("%Y-%m-%d %H:%M:%S")}),
           flush=True)
-    if not ok:
+    if not responded:
         print(json.dumps({"battery": "skipped",
                           "reason": "tunnel wedged — probe hung/failed; "
                                     "re-run when jax.devices() responds"}),
               flush=True)
         return 3
+    if "tpu" not in platforms and not args.allow_cpu:
+        print(json.dumps({"battery": "skipped",
+                          "reason": f"backend is {platforms!r}, not TPU — "
+                                    "a silent CPU fallback must not be "
+                                    "recorded as TPU numbers "
+                                    "(--allow-cpu to smoke-test)"}),
+              flush=True)
+        return 4
     if args.probe_only:
         return 0
 
@@ -104,20 +124,22 @@ def main() -> int:
                    REPO / "docs" / "tpu_runs" / time.strftime("%Y%m%d_%H%M"))
     out_dir.mkdir(parents=True, exist_ok=True)
     results = []
+    aborted = None
     for name, cmd, timeout_s in STAGES:
         res = run_stage(name, cmd, timeout_s, out_dir)
         results.append(res)
         print(json.dumps(res), flush=True)
-        if res["rc"] not in (0,):
+        if res["rc"] != 0:
             # A wedge mid-battery poisons every later device touch; stop
             # rather than queue three more hangs.
-            print(json.dumps({"battery": "aborted_after", "stage": name}),
-                  flush=True)
+            aborted = name
             break
-    (out_dir / "summary.json").write_text(json.dumps(results, indent=2))
-    print(json.dumps({"battery": "done", "out_dir": str(out_dir)}),
-          flush=True)
-    return 0 if all(r["rc"] == 0 for r in results) else 1
+    (out_dir / "summary.json").write_text(json.dumps(
+        {"stages": results, "aborted_after": aborted}, indent=2))
+    status = ({"battery": "aborted_after", "stage": aborted}
+              if aborted else {"battery": "done"})
+    print(json.dumps({**status, "out_dir": str(out_dir)}), flush=True)
+    return 0 if aborted is None else 1
 
 
 if __name__ == "__main__":

@@ -161,7 +161,12 @@ class ShmMailbox:
         # The scratch buffer is reused: actors poll their mailbox every
         # few hundred microseconds, and a fresh 1 MB allocation per poll
         # was a measurable share of the steady-state ingest profile. One
-        # reader per mailbox by protocol, so reuse is race-free.
+        # reader per mailbox by protocol, so reuse is race-free. The
+        # scratch is clamped to the creation-time capacity when known
+        # (a 1 KB mailbox must not pin a 1 MB scratch for its lifetime);
+        # attach-side readers (capacity unknown) size to the request.
+        if self._cap:
+            max_size = min(max_size, self._cap)
         buf = self._read_buf
         if buf is None or ctypes.sizeof(buf) < max_size:
             self._read_buf = buf = ctypes.create_string_buffer(max_size)

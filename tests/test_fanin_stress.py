@@ -126,7 +126,10 @@ def _drive_fleet(service, fleet, ring, boxes, steps: int, lanes: int,
             service._flush_pending()
         service._maybe_train()
         for a in active:
-            data, ver = boxes[a].read()
+            # 64 KB read cap: action replies are ~1 KB, and the reused
+            # scratch would otherwise pin 1 MB x 256 attached boxes in
+            # this single harness process.
+            data, ver = boxes[a].read(max_size=1 << 16)
             if data is None or ver <= fleet.last_ver[a]:
                 continue
             # THE routing assertion: this mailbox must only ever see
