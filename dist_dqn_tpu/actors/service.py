@@ -837,7 +837,6 @@ class ApexLearnerService:
         is safe to run from the async eval thread while the main loop keeps
         training."""
         from dist_dqn_tpu.envs.gym_adapter import make_host_env
-        jnp = self.jnp
         n = self.rt.eval_episodes
         if self._eval_env is None:
             self._eval_env = make_host_env(self.rt.host_env, n,
@@ -845,29 +844,13 @@ class ApexLearnerService:
                                            seed=10_000 + self.cfg.seed)
         if self._eval_rng is None:
             self._eval_rng = self.jax.random.PRNGKey(self.cfg.seed + 991)
-        env = self._eval_env
-        obs = env.reset()
-        carry = self.net.initial_state(n) if self.recurrent else None
-        returns = np.zeros((n,), np.float64)
-        alive = np.ones((n,), bool)
-        eps = jnp.float32(0.001)
-        for _ in range(10_000):
-            self._eval_rng, k = self.jax.random.split(self._eval_rng)
-            if self.recurrent:
-                carry, actions, _, _ = self._act(params, carry,
-                                                 jnp.asarray(obs), k, eps)
-            else:
-                actions = self._act(params, jnp.asarray(obs), k, eps)
-            obs, _, reward, term, trunc = env.step(np.asarray(actions))
-            returns += np.asarray(reward) * alive
-            done = np.logical_or(term, trunc)
-            if self.recurrent and done.any():
-                keep = jnp.asarray(~done, jnp.float32)[:, None]
-                carry = (carry[0] * keep, carry[1] * keep)
-            alive &= ~done
-            if not alive.any():
-                break
-        return float(returns.mean()), float(alive.sum())
+        from dist_dqn_tpu.utils.host_eval import run_greedy_episodes
+
+        returns, truncated, self._eval_rng = run_greedy_episodes(
+            self._eval_env, self._act, params, self._eval_rng, episodes=n,
+            recurrent_carry=(self.net.initial_state(n) if self.recurrent
+                             else None))
+        return float(returns.mean()), float(truncated)
 
     def _evaluate(self) -> float:
         """Synchronous eval (single-host path)."""

@@ -258,12 +258,13 @@ def make_host_env(name: str, num_envs: int, seed: int = 0,
             # multiprocessing-"spawn" actor processes (same design as
             # DQN_FAKE_ALE): sticky actions (repeat_action_probability;
             # 0 = the v4 registration default, 0.25 = ALE-recommended)
-            # and episodic-life termination. Episodic life is a TRAINING
-            # device (value bootstrapping stops at life boundaries) —
-            # eval envs (for_eval=True) keep whole-game episodes so
-            # eval_return stays the per-game score; sticky actions apply
-            # to eval too (the Machado et al. protocol evaluates under
-            # the same stochasticity).
+            # and episodic-life termination. Episodic life and reward
+            # clipping are TRAINING devices (bootstrapping stops at life
+            # boundaries; TD targets stay bounded) — eval envs
+            # (for_eval=True) keep whole-game episodes and RAW scores so
+            # eval_return is the per-game score comparable to published
+            # numbers; sticky actions apply to eval too (the Machado et
+            # al. protocol evaluates under the same stochasticity).
             import os
 
             sticky = float(os.environ.get("DQN_ALE_STICKY", "0") or 0.0)
@@ -274,6 +275,7 @@ def make_host_env(name: str, num_envs: int, seed: int = 0,
             factory = _resolve_ale_factory()
             if factory is not None:
                 return AtariPreprocessing(factory(game, **kwargs),
+                                          clip_rewards=not for_eval,
                                           episodic_life=episodic)
             try:
                 env = gymnasium.make(f"{game}NoFrameskip-v4", **kwargs)
@@ -283,7 +285,8 @@ def make_host_env(name: str, num_envs: int, seed: int = 0,
                     "offline image; use the synthetic pixel_pong env, set "
                     "DQN_FAKE_ALE=1 for the in-repo fake, or install "
                     "ale-py") from e
-            return AtariPreprocessing(env, episodic_life=episodic)
+            return AtariPreprocessing(env, clip_rewards=not for_eval,
+                                      episodic_life=episodic)
     else:
         def make_fn():
             return gymnasium.make(name)

@@ -18,6 +18,26 @@ import jax
 from dist_dqn_tpu.config import CONFIGS, ExperimentConfig
 
 
+def _restore_latest(checkpoint_dir: str, example):
+    """(frames, learner) from the newest checkpoint. Read-only surface:
+    never create the directory on a typo'd path, and release the orbax
+    manager after the one restore."""
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    if not os.path.isdir(checkpoint_dir):
+        raise FileNotFoundError(
+            f"no checkpoint found under {checkpoint_dir!r}")
+    ckpt = TrainCheckpointer(checkpoint_dir)
+    try:
+        restored = ckpt.restore_latest(example)
+    finally:
+        ckpt.close()
+    if restored is None:
+        raise FileNotFoundError(
+            f"no checkpoint found under {checkpoint_dir!r}")
+    return restored
+
+
 def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
                         episodes: int = 10, seed: int = 0,
                         epsilon: float = 0.001) -> dict:
@@ -28,7 +48,6 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
-    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
     env = make_jax_env(cfg.env_name)
     net = build_network(cfg.network, env.num_actions)
@@ -52,23 +71,58 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     obs_example = jax.numpy.zeros(env.observation_shape,
                                   env.observation_dtype)
     example = init(k_init, obs_example)
-    # Read-only surface: never create the directory on a typo'd path, and
-    # release the orbax manager after the one restore.
-    if not os.path.isdir(checkpoint_dir):
-        raise FileNotFoundError(
-            f"no checkpoint found under {checkpoint_dir!r}")
-    ckpt = TrainCheckpointer(checkpoint_dir)
-    try:
-        restored = ckpt.restore_latest(example)
-    finally:
-        ckpt.close()
-    if restored is None:
-        raise FileNotFoundError(
-            f"no checkpoint found under {checkpoint_dir!r}")
-    frames, learner = restored
+    frames, learner = _restore_latest(checkpoint_dir, example)
     mean_return = float(jax.jit(evaluator)(learner.params, k_eval))
     return {"eval_return": mean_return, "frames": frames,
             "episodes": episodes, "config": cfg.name}
+
+
+def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
+                             host_env: str, episodes: int = 10,
+                             seed: int = 0, epsilon: float = 0.001,
+                             max_steps: int = 20_000) -> dict:
+    """Greedy checkpoint episodes on a HOST env (real ALE / DM-Control /
+    gymnasium) — the deploy-side counterpart of an Ape-X split training
+    run, which steps host envs the JAX stand-ins only approximate.
+
+    The network is built with the HOST env's action count (an ale:
+    checkpoint trained on Breakout has 4 heads, not the stand-in's 6),
+    one vectorized env instance per episode, whole-game episodes and RAW
+    (unclipped) game scores (``for_eval=True``: episodic-life and reward
+    clipping are training devices, not scoring rules).
+    """
+    from dist_dqn_tpu.envs.gym_adapter import make_host_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.host_eval import run_greedy_episodes
+
+    env = make_host_env(host_env, episodes, seed=10_000 + seed,
+                        for_eval=True)
+    net = build_network(cfg.network, env.num_actions)
+    obs = env.reset()
+    recurrent = cfg.network.lstm_size > 0
+    if recurrent:
+        from dist_dqn_tpu.agents.r2d2 import (make_r2d2_learner,
+                                              make_recurrent_actor_step)
+        init, _ = make_r2d2_learner(net, cfg.learner, cfg.replay)
+        act = jax.jit(make_recurrent_actor_step(net))
+        carry = net.initial_state(episodes)
+    else:
+        from dist_dqn_tpu.agents.dqn import make_actor_step, make_learner
+        init, _ = make_learner(net, cfg.learner)
+        act = jax.jit(make_actor_step(net))
+
+    rng = jax.random.PRNGKey(seed)
+    rng, k_init = jax.random.split(rng)
+    example = init(k_init, jax.numpy.asarray(obs[0]))
+    frames, learner = _restore_latest(checkpoint_dir, example)
+
+    returns, truncated, _ = run_greedy_episodes(
+        env, act, learner.params, rng, episodes=episodes,
+        recurrent_carry=carry if recurrent else None, epsilon=epsilon,
+        max_steps=max_steps)
+    return {"eval_return": float(returns.mean()), "frames": frames,
+            "episodes": episodes, "config": cfg.name, "host_env": host_env,
+            "episodes_truncated": truncated}
 
 
 def main():
@@ -79,12 +133,22 @@ def main():
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu)")
+    parser.add_argument("--host-env", default=None,
+                        help="evaluate on a HOST env (e.g. ale:Breakout, "
+                             "CartPole-v1, dmc:reacher:easy) instead of "
+                             "the config's JAX stand-in env")
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    print(json.dumps(evaluate_checkpoint(
-        CONFIGS[args.config], args.checkpoint_dir,
-        episodes=args.episodes, seed=args.seed)))
+    if args.host_env:
+        out = evaluate_checkpoint_host(
+            CONFIGS[args.config], args.checkpoint_dir, args.host_env,
+            episodes=args.episodes, seed=args.seed)
+    else:
+        out = evaluate_checkpoint(
+            CONFIGS[args.config], args.checkpoint_dir,
+            episodes=args.episodes, seed=args.seed)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -124,6 +124,67 @@ def test_standalone_evaluate_checkpoint(tmp_path):
     assert 1.0 <= out["eval_return"] <= 500.0
 
 
+def test_standalone_evaluate_checkpoint_on_host_env(tmp_path):
+    """--host-env: a checkpoint trained on the JAX env evaluates on the
+    REAL host env (here gymnasium CartPole-v1 against the JAX cartpole
+    twin) — the deploy-side path for ale:/dmc: training runs."""
+    from dist_dqn_tpu.evaluate import evaluate_checkpoint_host
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(32,)),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=128),
+        learner=dataclasses.replace(cfg.learner, batch_size=32),
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        eval_every_steps=10**9,
+    )
+    ckpt_dir = str(tmp_path / "run")
+    with pytest.raises(FileNotFoundError):
+        evaluate_checkpoint_host(cfg, ckpt_dir, "CartPole-v1", episodes=2)
+    train(cfg, total_env_steps=3000, chunk_iters=250,
+          log_fn=lambda s: None, checkpoint_dir=ckpt_dir)
+    out = evaluate_checkpoint_host(cfg, ckpt_dir, "CartPole-v1",
+                                   episodes=4, seed=1)
+    assert out["frames"] >= 3000 and out["host_env"] == "CartPole-v1"
+    assert 1.0 <= out["eval_return"] <= 500.0
+    assert out["episodes_truncated"] == 0
+
+
+def test_evaluate_host_env_uses_host_action_count(tmp_path, monkeypatch):
+    """The ale: deploy path must size the Q-head from the HOST env (fake
+    Breakout: 4 actions), not the config's 6-action JAX stand-in — a
+    checkpoint saved with 4 heads restores and plays."""
+    import numpy as np
+
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.evaluate import evaluate_checkpoint_host
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    monkeypatch.setenv("DQN_FAKE_ALE", "1")
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="small", hidden=32,
+                                    compute_dtype="float32"))
+    # Save an (untrained) 4-action learner state, exactly what an
+    # ale:Breakout apex run would checkpoint.
+    net = build_network(cfg.network, 4)
+    init, _ = make_learner(net, cfg.learner)
+    state = init(jax.random.PRNGKey(0),
+                 jnp.zeros((84, 84, 4), jnp.uint8))
+    ckpt_dir = str(tmp_path / "bk")
+    ckpt = TrainCheckpointer(ckpt_dir)
+    ckpt.save(1234, state)
+    ckpt.close()
+    out = evaluate_checkpoint_host(cfg, ckpt_dir, "ale:Breakout",
+                                   episodes=2, seed=0, max_steps=300)
+    assert out["frames"] == 1234
+    assert np.isfinite(out["eval_return"])
+
+
 @pytest.mark.slow
 def test_standalone_evaluate_checkpoint_recurrent(tmp_path):
     """The R2D2 branch of evaluate_checkpoint: restore an LSTM learner
