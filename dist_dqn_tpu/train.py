@@ -234,6 +234,10 @@ def main():
                         help="multi-host: total process count")
     parser.add_argument("--process-id", type=int, default=0,
                         help="multi-host: this process's id (0-based)")
+    parser.add_argument("--stop-at-return", type=float, default=None,
+                        help="fused runtime, single-process: stop early "
+                             "once eval_return reaches this value (e.g. "
+                             "475 = CartPole solved)")
     parser.add_argument("--runtime", choices=("fused", "apex"),
                         default="fused",
                         help="fused: on-device Anakin loop (JAX envs); "
@@ -288,6 +292,9 @@ def main():
         if args.mesh_devices != 1:
             print("# --mesh-devices applies to the fused runtime only; "
                   "use --learner-devices for apex batch sharding")
+        if args.stop_at_return is not None:
+            print("# --stop-at-return applies to the fused runtime only; "
+                  "ignored under --runtime apex")
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
@@ -315,10 +322,16 @@ def main():
             device_sampling=args.device_sampling)
         print(json.dumps(run_apex(cfg, rt)))
         return
+    stop_fn = None
+    if args.stop_at_return is not None:
+        target = args.stop_at_return
+        stop_fn = lambda row: row.get("eval_return",  # noqa: E731
+                                      -float("inf")) >= target
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
           chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
           save_every_frames=args.save_every_frames,
-          profile_dir=args.profile_dir, num_devices=args.mesh_devices)
+          profile_dir=args.profile_dir, num_devices=args.mesh_devices,
+          stop_fn=stop_fn)
 
 
 if __name__ == "__main__":
