@@ -1,0 +1,121 @@
+"""Atari-57 suite runner: game list, HNS rollup math, per-game eval over
+the fake ALE (both modeled games, different action counts), CLI list
+mode."""
+import dataclasses
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.atari57 import (ATARI_57, EXAMPLE_SCORES,
+                                  evaluate_suite, normalized_scores,
+                                  train_suite)
+from dist_dqn_tpu.config import CONFIGS
+
+
+def test_atari57_list_is_the_canonical_set():
+    assert len(ATARI_57) == 57
+    assert len(set(ATARI_57)) == 57
+    # Spot anchors every published 57-game table contains.
+    for g in ("Pong", "Breakout", "MontezumaRevenge", "Seaquest",
+              "YarsRevenge", "Zaxxon"):
+        assert g in ATARI_57
+
+
+def test_normalized_scores_math_and_aggregates():
+    ref = EXAMPLE_SCORES
+    out = normalized_scores({"Pong": 14.6, "Breakout": 1.7,
+                             "NoRef": 100.0}, ref)
+    assert out["per_game"]["Pong"] == pytest.approx(100.0)   # human level
+    assert out["per_game"]["Breakout"] == pytest.approx(0.0)  # random level
+    assert out["unreferenced"] == ["NoRef"]
+    assert out["games"] == 2
+    assert out["median_hns"] == pytest.approx(50.0)
+    assert out["mean_hns"] == pytest.approx(50.0)
+    # Empty intersection: aggregates absent, not crashing.
+    empty = normalized_scores({"X": 1.0}, ref)
+    assert empty["games"] == 0 and "median_hns" not in empty
+
+
+def _save_untrained_checkpoint(cfg, num_actions, path):
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    net = build_network(cfg.network, num_actions)
+    init, _ = make_learner(net, cfg.learner)
+    state = init(jax.random.PRNGKey(0), jnp.zeros((84, 84, 4), jnp.uint8))
+    ckpt = TrainCheckpointer(str(path))
+    ckpt.save(1, state)
+    ckpt.close()
+
+
+@pytest.mark.slow
+def test_evaluate_suite_over_fake_ale(tmp_path, monkeypatch):
+    """Per-game eval across BOTH fake games — 6-action Pong and 4-action
+    Breakout checkpoints under one root — plus skip accounting for a
+    game with no checkpoint."""
+    monkeypatch.setenv("DQN_FAKE_ALE", "1")
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="small", hidden=32,
+                                    compute_dtype="float32"))
+    _save_untrained_checkpoint(cfg, 6, tmp_path / "Pong")
+    _save_untrained_checkpoint(cfg, 4, tmp_path / "Breakout")
+    logs = []
+    returns = evaluate_suite(cfg, str(tmp_path),
+                             games=("Pong", "Breakout", "Seaquest"),
+                             episodes=2, log_fn=logs.append)
+    assert set(returns) == {"Pong", "Breakout"}
+    assert all(np.isfinite(v) for v in returns.values())
+    skipped = [json.loads(s) for s in logs if "skipped" in s]
+    assert skipped and skipped[0]["game"] == "Seaquest"
+    # The rollup composes with the example reference table.
+    hns = normalized_scores(returns, EXAMPLE_SCORES)
+    assert hns["games"] == 2 and "median_hns" in hns
+    # missing_ok=False raises on the absent game.
+    with pytest.raises(FileNotFoundError):
+        evaluate_suite(cfg, str(tmp_path), games=("Seaquest",),
+                       episodes=1, missing_ok=False)
+
+
+@pytest.mark.slow
+def test_train_suite_roundtrips_into_evaluate_suite(tmp_path, monkeypatch):
+    """One fake game through the whole protocol: train_suite writes the
+    per-game checkpoint via a real Ape-X split run, evaluate_suite then
+    scores it — the exact layout the CLI's train->eval flow produces."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig
+
+    monkeypatch.setenv("DQN_FAKE_ALE", "1")
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="small", hidden=32,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=64,
+                                   pallas_sampler=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=8))
+    rt = ApexRuntimeConfig(num_actors=1, envs_per_actor=2,
+                           total_env_steps=150, inserts_per_grad_step=64)
+    summaries = train_suite(cfg, rt, str(tmp_path), games=("Pong",),
+                            log_fn=lambda s: None)
+    assert summaries["Pong"]["env_steps"] >= 150
+    assert summaries["Pong"]["ring_dropped"] == 0
+    returns = evaluate_suite(cfg, str(tmp_path), games=("Pong",),
+                             episodes=2, log_fn=lambda s: None)
+    assert np.isfinite(returns["Pong"])
+
+
+def test_cli_list_mode():
+    out = subprocess.run(
+        [sys.executable, "-m", "dist_dqn_tpu.atari57", "--mode", "list"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["count"] == 57 and "Pong" in payload["games"]
