@@ -140,7 +140,7 @@ def _learner_step_flops(jax, cfg, env, net):
 
     init, train_step = make_learner(net, cfg.learner)
     obs_shape = env.observation_shape
-    obs_dtype = np.dtype(str(np.dtype(env.observation_dtype)))
+    obs_dtype = np.dtype(env.observation_dtype)
     state = init(jax.random.PRNGKey(0), jax.numpy.zeros(obs_shape, obs_dtype))
     B = cfg.learner.batch_size
     r = np.random.default_rng(0)
