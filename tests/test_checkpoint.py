@@ -185,6 +185,38 @@ def test_evaluate_host_env_uses_host_action_count(tmp_path, monkeypatch):
     assert np.isfinite(out["eval_return"])
 
 
+def test_evaluate_host_env_recurrent_branch(tmp_path):
+    """The recurrent branch of evaluate_checkpoint_host: LSTM checkpoint,
+    carry threaded and zeroed on episode ends, host CartPole-v1."""
+    from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner
+    from dist_dqn_tpu.evaluate import evaluate_checkpoint_host
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    cfg = CONFIGS["r2d2"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    lstm_size=8, dueling=False,
+                                    remat_torso=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, burn_in=2, unroll_length=4,
+                                   sequence_stride=2),
+        learner=dataclasses.replace(cfg.learner, n_step=2, batch_size=8))
+    net = build_network(cfg.network, 2)
+    init, _ = make_r2d2_learner(net, cfg.learner, cfg.replay)
+    state = init(jax.random.PRNGKey(0), jnp.zeros((4,), jnp.float32))
+    ckpt_dir = str(tmp_path / "r2d2host")
+    ckpt = TrainCheckpointer(ckpt_dir)
+    ckpt.save(7, state)
+    ckpt.close()
+    out = evaluate_checkpoint_host(cfg, ckpt_dir, "CartPole-v1",
+                                   episodes=3, seed=0, max_steps=600)
+    assert out["frames"] == 7
+    assert 1.0 <= out["eval_return"] <= 500.0
+
+
 @pytest.mark.slow
 def test_standalone_evaluate_checkpoint_recurrent(tmp_path):
     """The R2D2 branch of evaluate_checkpoint: restore an LSTM learner
