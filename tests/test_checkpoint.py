@@ -185,6 +185,48 @@ def test_evaluate_host_env_uses_host_action_count(tmp_path, monkeypatch):
     assert np.isfinite(out["eval_return"])
 
 
+def test_r2d2_checkpoint_restores_across_throughput_knobs(tmp_path):
+    """Flipping the R2D2 throughput knobs (lstm_unroll, lstm_dtype,
+    remat_torso) must not orphan existing checkpoints: the param tree is
+    knob-invariant (tests/test_recurrent_knobs.py pins the math), so an
+    orbax save under one knob setting restores under another."""
+    from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    base = CONFIGS["r2d2"]
+    base = dataclasses.replace(
+        base,
+        network=dataclasses.replace(base.network, torso="mlp",
+                                    mlp_features=(16,), hidden=0,
+                                    lstm_size=8, dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(base.replay, burn_in=2, unroll_length=4,
+                                   sequence_stride=2),
+        learner=dataclasses.replace(base.learner, n_step=2, batch_size=8))
+
+    def learner_state(net_cfg, seed):
+        net = build_network(net_cfg, 2)
+        init, _ = make_r2d2_learner(net, base.learner, base.replay)
+        return init(jax.random.PRNGKey(seed), jnp.zeros((4,), jnp.float32))
+
+    cfg_a = dataclasses.replace(base.network, lstm_unroll=1,
+                                lstm_dtype="float32", remat_torso=False)
+    cfg_b = dataclasses.replace(base.network, lstm_unroll=8,
+                                lstm_dtype="bfloat16", remat_torso=True)
+    saved = learner_state(cfg_a, seed=3)
+    ckpt_dir = str(tmp_path / "knobs")
+    ckpt = TrainCheckpointer(ckpt_dir)
+    ckpt.save(42, saved)
+    ckpt.close()
+    ckpt = TrainCheckpointer(ckpt_dir)
+    restored = ckpt.restore_latest(learner_state(cfg_b, seed=9))
+    ckpt.close()
+    assert restored is not None and restored[0] == 42
+    jax.tree.map(np.testing.assert_array_equal, restored[1].params,
+                 saved.params)
+
+
 def test_evaluate_host_env_recurrent_branch(tmp_path):
     """The recurrent branch of evaluate_checkpoint_host: LSTM checkpoint,
     carry threaded and zeroed on episode ends, host CartPole-v1."""
