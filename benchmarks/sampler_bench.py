@@ -68,7 +68,7 @@ def _timed(fn, iters: int) -> dict:
 
 
 def bench_device(jax, cells: int, batch: int, iters: int,
-                 use_pallas: bool) -> dict:
+                 use_pallas: bool, amortize: int = 1) -> dict:
     import jax.numpy as jnp
 
     from dist_dqn_tpu.ops.pallas_sampler import stratified_sample
@@ -79,19 +79,57 @@ def bench_device(jax, cells: int, batch: int, iters: int,
     w = jnp.asarray(np.abs(r.standard_cauchy((T, LANES)))
                     .astype(np.float32) ** 0.6)
 
-    @jax.jit
-    def draw(w, rng):
-        return stratified_sample(w, rng, batch, use_pallas=use_pallas)
+    def make_draw(n_draws: int):
+        if n_draws == 1:
+            @jax.jit
+            def draw(w, rng):
+                return stratified_sample(w, rng, batch,
+                                         use_pallas=use_pallas)[0]
+            return draw
 
-    keys = [jax.random.PRNGKey(i) for i in range(iters + 2)]
-    for k in keys[:2]:  # compile + cached-dispatch warmup
-        jax.device_get(draw(w, k)[0])
-    it = iter(keys[2:])
+        # Chain ``n_draws`` sample+priority-write-back steps (the
+        # learner-step pattern) inside ONE jit: the scan body compiles
+        # once, data never leaves the device, and carrying ``w`` keeps the
+        # mass plane loop-variant so XLA cannot hoist the cumsum out of
+        # the scan (standalone it is loop-invariant, which would
+        # unrealistically favor the XLA path).
+        @jax.jit
+        def draw(w, rng):
+            def body(w, k):
+                t_idx, b_idx, p_sel, _ = stratified_sample(
+                    w, k, batch, use_pallas=use_pallas)
+                return w.at[t_idx, b_idx].set(p_sel * 0.999), None
+            w, _ = jax.lax.scan(body, w, jax.random.split(rng, n_draws))
+            return w[0, 0]
+        return draw
 
-    def one():
-        jax.device_get(draw(w, next(it))[0])  # fence on an output
+    def timed_at(n_draws: int) -> dict:
+        draw = make_draw(n_draws)
+        keys = [jax.random.PRNGKey(1000 * n_draws + i)
+                for i in range(iters + 2)]
+        for k in keys[:2]:  # compile + cached-dispatch warmup
+            jax.device_get(draw(w, k))
+        it = iter(keys[2:])
 
-    return _timed(one, iters)
+        def one():
+            jax.device_get(draw(w, next(it)))  # fence on an output
+
+        return _timed(one, iters)
+
+    if amortize <= 1:
+        return timed_at(1)
+
+    # A single dispatch+fence through the axon tunnel costs ~70ms —
+    # dividing one K-draw scan's time by K just reports dispatch/K (at
+    # K=50 a 50-draw scan measured *faster* than one unamortized call).
+    # Two-point marginal cost subtracts the dispatch constant exactly:
+    # time the scan at K and 2K draws, report (t_2K - t_K) / K per draw.
+    lo, hi = timed_at(amortize), timed_at(2 * amortize)
+    return {
+        "marginal_s": round((hi["median_s"] - lo["median_s"]) / amortize, 8),
+        "dispatch_s": round(2 * lo["median_s"] - hi["median_s"], 6),
+        "median_lo_s": lo["median_s"], "median_hi_s": hi["median_s"],
+    }
 
 
 def bench_host_cpp(cells: int, batch: int, iters: int) -> dict:
@@ -123,6 +161,11 @@ def main():
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--platform", default=None)
+    p.add_argument("--amortize", type=int, default=1,
+                   help="two-point marginal mode: time K- and 2K-draw "
+                        "scans per dispatch and report (t_2K-t_K)/K as "
+                        "marginal_s — per-draw kernel time with the ~70ms "
+                        "axon-tunnel dispatch constant subtracted exactly")
     p.add_argument("--impls", nargs="*",
                    default=["pallas", "xla", "host_cpp"])
     args = p.parse_args()
@@ -148,7 +191,10 @@ def main():
                 out = bench_host_cpp(cells, args.batch, args.iters)
             else:
                 out = bench_device(jax, cells, args.batch, args.iters,
-                                   use_pallas=(impl == "pallas"))
+                                   use_pallas=(impl == "pallas"),
+                                   amortize=args.amortize)
+                if args.amortize > 1:
+                    out["amortize"] = args.amortize
             guard.cancel()
             out.update(impl=impl, cells=cells, lanes=LANES,
                        batch=args.batch, platform=platform)

@@ -9,6 +9,8 @@ and then runs, in order of value-per-second and with per-stage timeouts:
   2. learner_bench (all configs)   — grad-steps/sec + per-config MFU
   3. learner_bench --r2d2-sweep    — remat x lstm_dtype x unroll
   4. sampler_bench                 — Pallas vs XLA vs C++ tree crossover
+  5. sampler_bench --amortize 500  — dispatch-free per-draw marginal
+                                     (the headline Pallas-vs-XLA ratio)
 
 Every stage runs in its own subprocess so a wedge mid-battery loses only
 the remaining stages, and each writes its raw JSON lines to
@@ -40,6 +42,13 @@ STAGES = [
     ("r2d2_sweep", [sys.executable, "benchmarks/learner_bench.py",
                     "--r2d2-sweep", "--iters", "30"], 1800),
     ("sampler_bench", [sys.executable, "benchmarks/sampler_bench.py"], 1200),
+    # Two-point marginal mode is the stage that reproduces the headline
+    # Pallas-vs-XLA ratio (BASELINE.md): per-draw kernel cost with the
+    # ~70ms/call tunnel dispatch constant subtracted exactly.
+    ("sampler_bench_marginal",
+     [sys.executable, "benchmarks/sampler_bench.py",
+      "--iters", "10", "--amortize", "500", "--impls", "pallas", "xla"],
+     1200),
 ]
 
 

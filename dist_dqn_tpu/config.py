@@ -168,17 +168,17 @@ R2D2 = ExperimentConfig(
     env_name="pixel_pong",
     network=NetworkConfig(torso="nature", hidden=512, dueling=True,
                           lstm_size=512, compute_dtype="bfloat16",
-                          # 120-step unrolls x batch of pixel frames: the
-                          # torso activations dominate learner HBM; trade
-                          # them for recompute (models/recurrent.py).
-                          remat_torso=True,
                           # Throughput knobs, numerics pinned by
-                          # tests/test_recurrent_knobs.py. Defaults set by
-                          # the analytic time model (utils/flops.py
-                          # r2d2_time_model: bf16 gates ~-21% modeled step
-                          # time, unroll=8 a further ~-12%) — TPU sweep
-                          # confirmation pending tunnel recovery
-                          # (docs/performance.md).
+                          # tests/test_recurrent_knobs.py. Defaults are the
+                          # round-3 TPU sweep winner (v5e, learner_bench
+                          # --r2d2-sweep, docs/tpu_runs/20260731_0100):
+                          # no-remat + bf16 gates + unroll 8 = 58.8
+                          # grad-steps/s vs 53.4 for remat+f32+unroll 1
+                          # (+10%; +24% over the round-1 47.4/s). The
+                          # 120-step x B=64 pixel unroll fits v5e HBM
+                          # without remat; set remat_torso=True on
+                          # HBM-constrained configs (models/recurrent.py).
+                          remat_torso=False,
                           lstm_dtype="bfloat16", lstm_unroll=8),
     replay=ReplayConfig(capacity=100_000, prioritized=True,
                         priority_exponent=0.9, importance_exponent=0.6,
