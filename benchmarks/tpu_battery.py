@@ -11,6 +11,8 @@ and then runs, in order of value-per-second and with per-stage timeouts:
   4. sampler_bench                 — Pallas vs XLA vs C++ tree crossover
   5. sampler_bench --amortize 500  — dispatch-free per-draw marginal
                                      (the headline Pallas-vs-XLA ratio)
+  6. r2d2_pixel_learning           — recurrent pixel-path learning bar
+                                     (chip-only; CPU can't reach the frames)
 
 Every stage runs in its own subprocess so a wedge mid-battery loses only
 the remaining stages, and each writes its raw JSON lines to
@@ -49,6 +51,10 @@ STAGES = [
      [sys.executable, "benchmarks/sampler_bench.py",
       "--iters", "10", "--amortize", "500", "--impls", "pallas", "xla"],
      1200),
+    # Learning-evidence leg: the R2D2 pixel run is only feasible on the
+    # chip (BASELINE.md); ~110s measured, exit 0 iff the +0.5 bar clears.
+    ("r2d2_pixel_learning",
+     [sys.executable, "benchmarks/r2d2_pixel_learning.py"], 600),
 ]
 
 
