@@ -66,6 +66,16 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _env_int(name: str, default: int) -> int:
+    """Int env override with the same never-break-the-contract fallback.
+    Used by benchmarks/bench_sweep.py to explore lane/batch/ring variants
+    without forking this file; defaults are the tuned headline config."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _watchdog(stage: str, seconds: float) -> threading.Timer:
     """Arm a timer that emits an error line and hard-exits; caller cancels.
 
@@ -175,11 +185,11 @@ def _measure(jax, device, smoke: bool):
     # BENCH_SMOKE=1 shrinks every dimension; default sizes target a real TPU
     # chip (512 env lanes saturate the v5e MXU on the Nature-CNN batch,
     # measured ~487k env-steps/sec/chip in round 1).
-    num_envs = 8 if smoke else 512
-    chunk = 20 if smoke else 200
+    num_envs = _env_int("BENCH_NUM_ENVS", 8 if smoke else 512)
+    chunk = _env_int("BENCH_CHUNK", 20 if smoke else 200)
     # ~25 chunks x 200 iters x 512 envs ~= 2.5M env steps: several seconds
     # of measured work, long enough to average out dispatch/clock jitter.
-    measure_chunks = 2 if smoke else 25
+    measure_chunks = _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25)
 
     cfg = CONFIGS["atari"]
     cfg = dataclasses.replace(
@@ -188,11 +198,14 @@ def _measure(jax, device, smoke: bool):
         # 65536 pixel slots ~= 1.8 GB of HBM for the obs ring: big enough to
         # exercise real sampling, small enough to leave the chip headroom
         # (a 131k ring was measurably slower on a 16 GB v5e).
-        replay=dataclasses.replace(cfg.replay,
-                                   capacity=2_048 if smoke else 65_536,
-                                   min_fill=128 if smoke else 4_096),
-        learner=dataclasses.replace(cfg.learner,
-                                    batch_size=32 if smoke else 256),
+        replay=dataclasses.replace(
+            cfg.replay,
+            capacity=_env_int("BENCH_RING", 2_048 if smoke else 65_536),
+            min_fill=128 if smoke else 4_096),
+        learner=dataclasses.replace(
+            cfg.learner,
+            batch_size=_env_int("BENCH_BATCH", 32 if smoke else 256)),
+        train_every=_env_int("BENCH_TRAIN_EVERY", cfg.train_every),
     )
     env = make_jax_env(cfg.env_name)
     net = build_network(cfg.network, env.num_actions)

@@ -1,0 +1,135 @@
+"""Headline-bench tuning sweep: lane/batch/cadence variants of bench.py.
+
+The headline metric (env-steps/sec/chip, bench.py) measured 524,892 at
+the round-1-tuned config (512 lanes, batch 256, 64k ring, train_every 4)
+with learner MFU at 2.9% — i.e. the chip has compute headroom and the
+fused loop is dominated by per-iteration/bandwidth costs. This sweep
+explores the obvious scaling axes while HOLDING THE REPLAY RATIO FIXED
+(examples-per-frame = batch / (lanes x train_every) = 0.125, the tuned
+config's value) so every variant is the same learning setup, just
+batched differently — a bigger number here is a real throughput win,
+not a training-quality trade.
+
+Wedge discipline: same staging as tpu_battery.py (probe first, one
+subprocess per variant via bench.py env overrides, SIGTERM on timeout,
+per-variant logs). Each variant is sized to finish in ~2-4 min
+(compile-dominated; measured work is ~2M env steps).
+
+Usage:  python benchmarks/bench_sweep.py [--out-dir DIR] [--allow-cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from tpu_battery import REPO, probe, run_stage  # noqa: E402
+
+# name -> bench.py env overrides. examples/frame = batch/(lanes*te) =
+# 0.125 everywhere (see module docstring).
+VARIANTS = {
+    "default_512x256":   {"BENCH_NUM_ENVS": "512", "BENCH_BATCH": "256",
+                          "BENCH_TRAIN_EVERY": "4"},
+    "lanes1024_b512":    {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                          "BENCH_TRAIN_EVERY": "4"},
+    "lanes1024_b256te2": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "256",
+                          "BENCH_TRAIN_EVERY": "2"},
+    "lanes2048_b1024":   {"BENCH_NUM_ENVS": "2048", "BENCH_BATCH": "1024",
+                          "BENCH_TRAIN_EVERY": "4"},
+    "lanes256_b128":     {"BENCH_NUM_ENVS": "256", "BENCH_BATCH": "128",
+                          "BENCH_TRAIN_EVERY": "4"},
+}
+MEASURE_CHUNKS = "10"   # ~2M env steps per variant at 1024 lanes
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-dir", default=None)
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="smoke the sweep harness on CPU (BENCH_SMOKE "
+                        "sizes; NOT for BASELINE numbers)")
+    p.add_argument("--variants", nargs="*", default=list(VARIANTS))
+    args = p.parse_args()
+    unknown = [v for v in args.variants if v not in VARIANTS]
+    if unknown:
+        print(json.dumps({"sweep": "bad_args", "unknown": unknown,
+                          "known": list(VARIANTS)}), flush=True)
+        return 2
+
+    if args.allow_cpu:
+        # Smoke mode must not touch (and possibly hang on) the tunnel;
+        # BENCH_SMOKE below forces each bench subprocess onto CPU anyway.
+        platforms = "cpu"
+    else:
+        responded, platforms = probe()
+        print(json.dumps({"probe": "ok" if responded else "wedged",
+                          "platforms": platforms}), flush=True)
+        if not responded:
+            return 3
+        if "tpu" not in platforms:
+            print(json.dumps({"sweep": "skipped",
+                              "reason": f"backend is {platforms!r}, "
+                                        "not TPU"}), flush=True)
+            return 4
+
+    out_dir = Path(args.out_dir or
+                   REPO / "docs" / "tpu_runs" /
+                   (time.strftime("%Y%m%d_%H%M") + "_sweep"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+    results = []
+    aborted = None
+    for name in args.variants:
+        # Stage timeout (540) must exceed bench.py's internal watchdog so
+        # a hang still yields the one-JSON-line error contract in the log.
+        env = dict(os.environ, BENCH_MEASURE_CHUNKS=MEASURE_CHUNKS,
+                   BENCH_TOTAL_TIMEOUT_S="450", BENCH_BACKEND_TIMEOUT_S="120",
+                   **VARIANTS[name])
+        if args.allow_cpu:
+            env["BENCH_SMOKE"] = "1"
+            # Smoke mode still honors explicit overrides; shrink them.
+            env.update(BENCH_NUM_ENVS="8", BENCH_BATCH="16",
+                       BENCH_MEASURE_CHUNKS="2")
+        res = run_stage(name, [sys.executable, "bench.py"], 540, out_dir,
+                        env=env)
+        # Pull the JSON contract line out of the log for the summary.
+        value = None
+        for line in Path(res["log"]).read_text().splitlines():
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("metric"):
+                value = row.get("value")
+                res["bench"] = row
+        res["value"] = value
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        # A negative rc means the stage timed out and was signalled — a
+        # likely tunnel wedge that poisons every later device touch, so
+        # stop. A clean nonzero exit (e.g. one variant OOMs) only skips
+        # that variant; the next one may well succeed.
+        if res["rc"] < 0:
+            aborted = name
+            print(json.dumps({"sweep": "aborted_after", "stage": name}),
+                  flush=True)
+            break
+    ok = [r for r in results if r.get("value")]
+    best = max(ok, key=lambda r: r["value"]) if ok else None
+    (out_dir / "summary.json").write_text(json.dumps(
+        {"results": results, "aborted_after": aborted,
+         "best": best and {"stage": best["stage"], "value": best["value"]}},
+        indent=2))
+    print(json.dumps({"sweep": "aborted" if aborted else "done",
+                      "best": best and best["stage"],
+                      "best_value": best and best["value"],
+                      "out_dir": str(out_dir)}), flush=True)
+    return 0 if ok and not aborted else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

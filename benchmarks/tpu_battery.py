@@ -84,12 +84,13 @@ def probe(timeout_s: float = 60.0) -> tuple:
         return False, ""
 
 
-def run_stage(name: str, cmd: list, timeout_s: int, out_dir: Path) -> dict:
+def run_stage(name: str, cmd: list, timeout_s: int, out_dir: Path,
+              env: dict = None) -> dict:
     log = out_dir / f"{name}.jsonl"
     t0 = time.time()
     with open(log, "w") as fh:
         proc = subprocess.Popen(cmd, cwd=REPO, stdout=fh,
-                                stderr=subprocess.STDOUT)
+                                stderr=subprocess.STDOUT, env=env)
         try:
             rc = proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
