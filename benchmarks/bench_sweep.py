@@ -28,7 +28,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from tpu_battery import REPO, probe, run_stage  # noqa: E402
+from tpu_battery import REPO, gate_backend, run_stage  # noqa: E402
 
 # name -> bench.py env overrides. examples/frame = batch/(lanes*te) =
 # 0.125 everywhere (see module docstring).
@@ -66,16 +66,9 @@ def main() -> int:
         # BENCH_SMOKE below forces each bench subprocess onto CPU anyway.
         platforms = "cpu"
     else:
-        responded, platforms = probe()
-        print(json.dumps({"probe": "ok" if responded else "wedged",
-                          "platforms": platforms}), flush=True)
-        if not responded:
-            return 3
-        if "tpu" not in platforms:
-            print(json.dumps({"sweep": "skipped",
-                              "reason": f"backend is {platforms!r}, "
-                                        "not TPU"}), flush=True)
-            return 4
+        platforms, gate_rc = gate_backend(allow_cpu=False, tool="sweep")
+        if gate_rc is not None:
+            return gate_rc
 
     out_dir = Path(args.out_dir or
                    REPO / "docs" / "tpu_runs" /
@@ -121,7 +114,8 @@ def main() -> int:
     ok = [r for r in results if r.get("value")]
     best = max(ok, key=lambda r: r["value"]) if ok else None
     (out_dir / "summary.json").write_text(json.dumps(
-        {"results": results, "aborted_after": aborted,
+        {"platforms": platforms, "results": results,
+         "aborted_after": aborted,
          "best": best and {"stage": best["stage"], "value": best["value"]}},
         indent=2))
     print(json.dumps({"sweep": "aborted" if aborted else "done",

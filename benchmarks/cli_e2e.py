@@ -1,0 +1,121 @@
+"""End-to-end user-surface proof on the chip: train CLI -> checkpoint ->
+standalone evaluate.
+
+The round-3 battery proved the fused loop, learner, sampler, and R2D2
+learning on TPU, but the actual USER surface — `python -m
+dist_dqn_tpu.train` with checkpointing, then `python -m
+dist_dqn_tpu.evaluate` restoring that checkpoint — has only ever run on
+CPU. An oversized ad-hoc attempt (10M frames + eval under a 560s
+timeout) is what re-wedged the tunnel on 2026-07-31 (see
+.claude/skills/verify/SKILL.md wedge incident #2), so this script is the
+properly sized version: probe first, small bounded stages, battery
+staging throughout.
+
+Stages (each a subprocess, sized to finish well inside its timeout):
+  1. train_cli — atari config, 128k frames (4 chunks of 500x64), one
+     eval period, orbax checkpoint on exit.
+  2. evaluate_cli — restore the newest checkpoint, 5 greedy episodes.
+
+Usage:  python benchmarks/cli_e2e.py [--out-dir DIR] [--allow-cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from tpu_battery import REPO, gate_backend, run_stage  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-dir", default=None)
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="smoke the harness on CPU (tiny sizes; NOT for "
+                        "BASELINE numbers)")
+    args = p.parse_args()
+
+    platform_flags = []
+    platforms = "cpu"
+    if args.allow_cpu:
+        # Smoke must not touch (and possibly hang on) the tunnel; force
+        # the subprocesses onto CPU instead.
+        platform_flags = ["--platform", "cpu"]
+    else:
+        platforms, gate_rc = gate_backend(allow_cpu=False, tool="e2e")
+        if gate_rc is not None:
+            return gate_rc
+
+    # CPU smoke artifacts must not land in the docs/tpu_runs/ baseline
+    # directory, where they could later be cited as chip numbers.
+    default_dir = (Path(tempfile.mkdtemp(prefix="cli_e2e_smoke_"))
+                   if args.allow_cpu else
+                   REPO / "docs" / "tpu_runs" /
+                   (time.strftime("%Y%m%d_%H%M") + "_cli_e2e"))
+    out_dir = Path(args.out_dir) if args.out_dir else default_dir
+    out_dir.mkdir(parents=True, exist_ok=True)
+    ckpt_dir = Path(tempfile.mkdtemp(prefix="cli_e2e_ckpt_"))
+
+    # CPU smoke shrinks the run ~100x (the CartPole MLP config instead of
+    # the Nature CNN: pixel compiles alone exceed any smoke budget).
+    config = "cartpole" if args.allow_cpu else "atari"
+    total = "16000" if args.allow_cpu else "128000"
+    chunk = "250" if args.allow_cpu else "500"
+    eval_every = "8000" if args.allow_cpu else "64000"
+
+    try:
+        stages = [
+            ("train_cli",
+             [sys.executable, "-m", "dist_dqn_tpu.train", "--config", config,
+              "--total-env-steps", total, "--chunk-iters", chunk,
+              "--eval-every-steps", eval_every,
+              "--checkpoint-dir", str(ckpt_dir)] + platform_flags,
+             420),
+            ("evaluate_cli",
+             [sys.executable, "-m", "dist_dqn_tpu.evaluate",
+              "--config", config, "--checkpoint-dir", str(ckpt_dir),
+              "--episodes", "5"] + platform_flags,
+             300),
+        ]
+        results = []
+        for name, cmd, timeout_s in stages:
+            res = run_stage(name, cmd, timeout_s, out_dir)
+            results.append(res)
+            print(json.dumps(res), flush=True)
+            if res["rc"] != 0:
+                print(json.dumps({"e2e": "aborted_after", "stage": name}),
+                      flush=True)
+                break
+        ok = all(r["rc"] == 0 for r in results) and len(results) == 2
+        # The point of stage 2: evaluate restored a REAL checkpoint and
+        # reported a finite return — pull that line for the summary.
+        eval_row = None
+        if ok:
+            for line in (out_dir / "evaluate_cli.jsonl").read_text() \
+                    .splitlines():
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if "eval_return" in row:
+                    eval_row = row
+        (out_dir / "summary.json").write_text(json.dumps(
+            {"platforms": platforms, "config": config,
+             "smoke": args.allow_cpu, "stages": results,
+             "ok": bool(ok and eval_row), "eval": eval_row}, indent=2))
+        print(json.dumps({"e2e": "done" if ok and eval_row else "failed",
+                          "eval": eval_row, "out_dir": str(out_dir)}),
+              flush=True)
+        return 0 if ok and eval_row else 1
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,36 @@ def probe(timeout_s: float = 60.0) -> tuple:
         return False, ""
 
 
+def gate_backend(allow_cpu: bool, tool: str) -> tuple:
+    """Shared probe gate for every wedge-safe harness in this directory.
+
+    Returns (platforms, exit_code): exit_code is None when the caller may
+    proceed, 3 when the tunnel is wedged, 4 when the backend is a
+    non-TPU platform and ``allow_cpu`` wasn't passed (a silent CPU
+    fallback must never be recorded as TPU numbers).
+    """
+    responded, platforms = probe()
+    print(json.dumps({"probe": "ok" if responded else "wedged",
+                      "platforms": platforms,
+                      "ts": time.strftime("%Y-%m-%d %H:%M:%S")}),
+          flush=True)
+    if not responded:
+        print(json.dumps({tool: "skipped",
+                          "reason": "tunnel wedged — probe hung/failed; "
+                                    "re-run when jax.devices() responds"}),
+              flush=True)
+        return platforms, 3
+    if "tpu" not in platforms and not allow_cpu:
+        print(json.dumps({tool: "skipped",
+                          "reason": f"backend is {platforms!r}, not TPU — "
+                                    "a silent CPU fallback must not be "
+                                    "recorded as TPU numbers "
+                                    "(--allow-cpu to smoke-test)"}),
+              flush=True)
+        return platforms, 4
+    return platforms, None
+
+
 def run_stage(name: str, cmd: list, timeout_s: int, out_dir: Path,
               env: dict = None) -> dict:
     log = out_dir / f"{name}.jsonl"
@@ -114,25 +144,9 @@ def main() -> int:
                         "numbers)")
     args = p.parse_args()
 
-    responded, platforms = probe()
-    print(json.dumps({"probe": "ok" if responded else "wedged",
-                      "platforms": platforms,
-                      "ts": time.strftime("%Y-%m-%d %H:%M:%S")}),
-          flush=True)
-    if not responded:
-        print(json.dumps({"battery": "skipped",
-                          "reason": "tunnel wedged — probe hung/failed; "
-                                    "re-run when jax.devices() responds"}),
-              flush=True)
-        return 3
-    if "tpu" not in platforms and not args.allow_cpu:
-        print(json.dumps({"battery": "skipped",
-                          "reason": f"backend is {platforms!r}, not TPU — "
-                                    "a silent CPU fallback must not be "
-                                    "recorded as TPU numbers "
-                                    "(--allow-cpu to smoke-test)"}),
-              flush=True)
-        return 4
+    platforms, gate_rc = gate_backend(args.allow_cpu, "battery")
+    if gate_rc is not None:
+        return gate_rc
     if args.probe_only:
         return 0
 
