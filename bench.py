@@ -183,11 +183,14 @@ def _measure(jax, device, smoke: bool):
     from dist_dqn_tpu.utils import flops as flops_util
 
     # BENCH_SMOKE=1 shrinks every dimension; default sizes target a real TPU
-    # chip (512 env lanes saturate the v5e MXU on the Nature-CNN batch,
-    # measured ~487k env-steps/sec/chip in round 1).
-    num_envs = _env_int("BENCH_NUM_ENVS", 8 if smoke else 512)
+    # chip. The round-3 sweep (benchmarks/bench_sweep.py, fixed 0.125
+    # examples/frame) measured 1024 lanes x batch 512 at 569,049
+    # env-steps/sec/chip vs 510-525k for the round-1 512x256 default, so
+    # 1024x512 is the default; 2048x1024 exceeded the 450s watchdog
+    # (docs/tpu_runs/20260731_0316_sweep/).
+    num_envs = _env_int("BENCH_NUM_ENVS", 8 if smoke else 1024)
     chunk = _env_int("BENCH_CHUNK", 20 if smoke else 200)
-    # ~25 chunks x 200 iters x 512 envs ~= 2.5M env steps: several seconds
+    # ~25 chunks x 200 iters x 1024 envs ~= 5M env steps: several seconds
     # of measured work, long enough to average out dispatch/clock jitter.
     measure_chunks = _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25)
 
@@ -204,7 +207,7 @@ def _measure(jax, device, smoke: bool):
             min_fill=128 if smoke else 4_096),
         learner=dataclasses.replace(
             cfg.learner,
-            batch_size=_env_int("BENCH_BATCH", 32 if smoke else 256)),
+            batch_size=_env_int("BENCH_BATCH", 32 if smoke else 512)),
         train_every=_env_int("BENCH_TRAIN_EVERY", cfg.train_every),
     )
     env = make_jax_env(cfg.env_name)

@@ -23,6 +23,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -31,7 +32,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 from tpu_battery import REPO, gate_backend, run_stage  # noqa: E402
 
 # name -> bench.py env overrides. examples/frame = batch/(lanes*te) =
-# 0.125 everywhere (see module docstring).
+# 0.125 everywhere (see module docstring). Ordered safest-first: on
+# 2026-07-31 the 2048-lane variant exceeded the 450s watchdog and its
+# exit mid-device-op wedged the tunnel, killing the rest of the window
+# (verify-skill incident #3) — so unproven sizes are NOT in the default
+# list and anything risky must come last.
 VARIANTS = {
     "default_512x256":   {"BENCH_NUM_ENVS": "512", "BENCH_BATCH": "256",
                           "BENCH_TRAIN_EVERY": "4"},
@@ -39,11 +44,16 @@ VARIANTS = {
                           "BENCH_TRAIN_EVERY": "4"},
     "lanes1024_b256te2": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "256",
                           "BENCH_TRAIN_EVERY": "2"},
-    "lanes2048_b1024":   {"BENCH_NUM_ENVS": "2048", "BENCH_BATCH": "1024",
-                          "BENCH_TRAIN_EVERY": "4"},
     "lanes256_b128":     {"BENCH_NUM_ENVS": "256", "BENCH_BATCH": "128",
                           "BENCH_TRAIN_EVERY": "4"},
+    # Proven OVERSIZED on v5e (watchdog timeout + tunnel wedge
+    # 2026-07-31); excluded from the default run — opt in explicitly
+    # with --variants lanes2048_b1024, and only run it LAST.
+    "lanes2048_b1024":   {"BENCH_NUM_ENVS": "2048", "BENCH_BATCH": "1024",
+                          "BENCH_TRAIN_EVERY": "4"},
 }
+OVERSIZED = ("lanes2048_b1024",)
+DEFAULT_VARIANTS = [v for v in VARIANTS if v not in OVERSIZED]
 MEASURE_CHUNKS = "10"   # ~2M env steps per variant at 1024 lanes
 
 
@@ -53,13 +63,18 @@ def main() -> int:
     p.add_argument("--allow-cpu", action="store_true",
                    help="smoke the sweep harness on CPU (BENCH_SMOKE "
                         "sizes; NOT for BASELINE numbers)")
-    p.add_argument("--variants", nargs="*", default=list(VARIANTS))
+    p.add_argument("--variants", nargs="*", default=DEFAULT_VARIANTS)
     args = p.parse_args()
     unknown = [v for v in args.variants if v not in VARIANTS]
     if unknown:
         print(json.dumps({"sweep": "bad_args", "unknown": unknown,
                           "known": list(VARIANTS)}), flush=True)
         return 2
+    # Incident-#3 rule, enforced mechanically (not just by comment): a
+    # known-oversized variant can wedge the tunnel and end the window,
+    # so it always runs AFTER every proven variant, whatever order the
+    # caller typed.
+    args.variants.sort(key=lambda v: v in OVERSIZED)
 
     if args.allow_cpu:
         # Smoke mode must not touch (and possibly hang on) the tunnel;
@@ -70,8 +85,14 @@ def main() -> int:
         if gate_rc is not None:
             return gate_rc
 
-    out_dir = Path(args.out_dir or
-                   REPO / "docs" / "tpu_runs" /
+    # CPU smoke artifacts must not land in the docs/tpu_runs/ baseline
+    # directory, where they could later be cited as chip numbers.
+    if args.out_dir:
+        out_dir = Path(args.out_dir)
+    elif args.allow_cpu:
+        out_dir = Path(tempfile.mkdtemp(prefix="bench_sweep_smoke_"))
+    else:
+        out_dir = (REPO / "docs" / "tpu_runs" /
                    (time.strftime("%Y%m%d_%H%M") + "_sweep"))
     out_dir.mkdir(parents=True, exist_ok=True)
     results = []
@@ -102,13 +123,19 @@ def main() -> int:
         res["value"] = value
         results.append(res)
         print(json.dumps(res), flush=True)
-        # A negative rc means the stage timed out and was signalled — a
-        # likely tunnel wedge that poisons every later device touch, so
-        # stop. A clean nonzero exit (e.g. one variant OOMs) only skips
-        # that variant; the next one may well succeed.
-        if res["rc"] < 0:
+        # Stop the sweep on any wedge signature: a negative rc (stage
+        # timeout -> signalled mid-device-op) OR bench.py's own
+        # watchdog/error contract (rc=3, "no progress within ..."). The
+        # 2026-07-31 run proved the latter poisons the tunnel exactly
+        # like a SIGTERM — the rest of the window would just burn stage
+        # timeouts against a dead tunnel (incident #3). A clean nonzero
+        # exit without the error contract (e.g. an import error) still
+        # only skips that variant.
+        bench_err = (res.get("bench") or {}).get("error", "")
+        if res["rc"] < 0 or res["rc"] == 3 or "no progress" in bench_err:
             aborted = name
-            print(json.dumps({"sweep": "aborted_after", "stage": name}),
+            print(json.dumps({"sweep": "aborted_after", "stage": name,
+                              "error": bench_err or f"rc={res['rc']}"}),
                   flush=True)
             break
     ok = [r for r in results if r.get("value")]
