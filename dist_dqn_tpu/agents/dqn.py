@@ -5,8 +5,9 @@ TD loss (scalar or C51), backward, optimizer update and target-network Polyak
 sync are all traced into one XLA program; ``donate_argnums`` lets XLA update
 parameters and optimizer state in place on device.
 
-The same ``train_step`` serves vanilla DQN, double-DQN, dueling, NoisyNet and
-C51 (BASELINE.json:7-9,11) — the variant is fixed at trace time by the
+The same ``train_step`` serves vanilla DQN, double-DQN, dueling, NoisyNet,
+C51, QR-DQN and IQN (BASELINE.json:7-9,11) — the variant is fixed at trace
+time by the
 network module and ``LearnerConfig``, so there is zero runtime dispatch in the
 compiled program. Per-example TD magnitudes are always returned as
 ``priorities`` for the prioritized replay path (Ape-X, BASELINE.json:9).
@@ -65,6 +66,7 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     quantile = num_atoms > 1 and getattr(net, "quantile", False)
     distributional = num_atoms > 1 and not quantile
     noisy = getattr(net, "noisy", False)
+    iqn = getattr(net, "iqn", False)
 
     def init(rng: Array, obs_example: Array) -> LearnerState:
         rng, k_param, k_noise = jax.random.split(rng, 3)
@@ -123,6 +125,34 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
                 axis=1)[:, 0]                                   # [B, N]
             per_example = losses.quantile_huber_td(
                 theta_a, target_theta, cfg.huber_delta)
+            priorities = per_example
+        elif iqn:
+            # IQN: quantile-Huber regression at SAMPLED fractions — N
+            # online draws conditioned into the net, N' independent
+            # target draws as Bellman samples (Dabney et al., 2018b).
+            theta, taus = net.apply(
+                params, batch.obs, net.num_tau,
+                method=net.sample_quantiles, rngs={"tau": k_online})
+            theta_next_target, _ = net.apply(
+                target_params, batch.next_obs, net.num_tau_target,
+                method=net.sample_quantiles, rngs={"tau": k_target})
+            if cfg.double_dqn:
+                # Greedy selection by the online net's deterministic
+                # acting fractions (risk-neutral mean at eta=1).
+                q_sel = net.apply(params, batch.next_obs,
+                                  method=net.q_values)
+            else:
+                q_sel = jnp.mean(theta_next_target, axis=-1)
+            a_star = jnp.argmax(q_sel, axis=-1)
+            next_theta = jnp.take_along_axis(
+                theta_next_target, a_star[:, None, None], axis=1)[:, 0]
+            target_theta = (batch.reward[:, None]
+                            + batch.discount[:, None] * next_theta)
+            theta_a = jnp.take_along_axis(
+                theta, batch.action[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                                   # [B, N]
+            per_example = losses.iqn_quantile_huber_td(
+                theta_a, taus, target_theta, cfg.huber_delta)
             priorities = per_example
         else:
             q = _apply(net, params, batch.obs, k_online, noisy)

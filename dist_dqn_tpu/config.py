@@ -24,6 +24,20 @@ class NetworkConfig:
     v_min: float = -10.0
     v_max: float = 10.0
     quantile: bool = False             # num_atoms>1: QR-DQN instead of C51
+    # IQN (Dabney et al., 2018b) — the third distributional family: the
+    # head is CONDITIONED on sampled quantile fractions via a cosine
+    # embedding instead of outputting a fixed set (models/qnets.py
+    # ImplicitQuantileNetwork). Mutually exclusive with noisy /
+    # num_atoms>1 / lstm_size.
+    iqn: bool = False
+    iqn_embed_dim: int = 64            # cosine embedding width
+    iqn_tau_samples: int = 64          # N: online tau draws per loss
+    iqn_tau_target_samples: int = 64   # N': target tau draws per loss
+    iqn_tau_act: int = 32              # K: fixed acting fractions
+    # Acting-time risk distortion: q_values averages the lower
+    # risk_cvar_eta tail of the return distribution (CVaR_eta); 1.0 is
+    # the risk-neutral mean.
+    risk_cvar_eta: float = 1.0
     lstm_size: int = 0                 # >0 => recurrent core (R2D2)
     remat_torso: bool = False          # recompute torso acts in backward
     compute_dtype: str = "float32"     # "bfloat16" for the TPU MXU path
@@ -234,6 +248,30 @@ QRDQN = ExperimentConfig(
     train_every=4,
 )
 
+IQN = ExperimentConfig(
+    # Beyond the driver's five configs: IQN (Dabney et al., 2018b) — the
+    # implicit-quantile distributional family on the Atari-shaped path.
+    # Shares the qrdqn preset's schedule; the head samples 64 online /
+    # 64 target quantile fractions per loss and acts on 32 fixed
+    # fractions (risk-neutral by default; set network.risk_cvar_eta < 1
+    # for CVaR risk-averse control).
+    name="iqn",
+    env_name="pixel_pong",
+    network=NetworkConfig(torso="nature", hidden=512, iqn=True,
+                          compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=200_000, prioritized=True,
+                        priority_exponent=0.5, importance_exponent=0.4,
+                        min_fill=20_000),
+    learner=LearnerConfig(
+        learning_rate=5e-5, adam_eps=3.125e-4, gamma=0.99, n_step=3,
+        batch_size=256, double_dqn=True, target_update_period=2_000,
+        huber_delta=1.0,
+    ),
+    actor=ActorConfig(num_envs=64, epsilon_decay_steps=250_000),
+    total_env_steps=10_000_000,
+    train_every=4,
+)
+
 CONFIGS: Dict[str, ExperimentConfig] = {
-    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW, QRDQN)
+    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW, QRDQN, IQN)
 }

@@ -194,9 +194,131 @@ class QNetwork(nn.Module):
         return jnp.sum(jax.nn.softmax(out, axis=-1) * self.atoms(), axis=-1)
 
 
+class ImplicitQuantileNetwork(nn.Module):
+    """IQN head (Dabney et al., 2018b): Z_tau(s, a) for sampled tau.
+
+    The third distributional family next to C51 and QR-DQN. Instead of a
+    fixed set of output quantiles, the network is CONDITIONED on quantile
+    fractions tau ~ U(0, 1): a cosine embedding of tau is mixed
+    (Hadamard) into the state features, so one set of parameters
+    represents the full return distribution. TPU notes: the embedding is
+    a [B*K, E] x [E, H] matmul and the heads are [B*K, H] x [H, A]
+    matmuls — all MXU work, batch-flattened over the tau-sample axis; no
+    gather/scatter, static shapes throughout.
+
+    Methods:
+      __call__(obs, taus=None)      -> [B, A, K] quantile values; with
+        taus=None uses the fixed, deterministic acting fractions from
+        ``act_taus()`` (K = num_tau_act).
+      sample_quantiles(obs, num)    -> ([B, A, num], [B, num]) at fresh
+        tau ~ U(0, 1) draws from the "tau" rng collection (training).
+      q_values(obs)                 -> [B, A] mean over the acting
+        fractions — with ``risk_cvar_eta`` < 1 this is CVaR_eta, a
+        risk-averse policy that only averages the lower eta tail of the
+        return distribution (risk-sensitive control comes free with IQN).
+
+    NoisyNet heads are not supported (build_network rejects the combo);
+    exploration is epsilon-greedy. ``add_noise`` is accepted and ignored
+    so the module is call-compatible with QNetwork in the shared
+    learner/actor/eval paths.
+    """
+
+    num_actions: int
+    torso: str = "nature"
+    mlp_features: Tuple[int, ...] = (256, 256)
+    hidden: int = 512
+    dueling: bool = False
+    embed_dim: int = 64
+    num_tau: int = 64          # N: online tau draws per loss term
+    num_tau_target: int = 64   # N': target tau draws per loss term
+    num_tau_act: int = 32
+    risk_cvar_eta: float = 1.0
+    compute_dtype: jnp.dtype = jnp.float32
+    iqn: bool = True  # marker for make_learner's loss dispatch
+
+    def act_taus(self) -> Array:
+        """Deterministic acting fractions: num_tau_act midpoints of
+        (0, risk_cvar_eta] — uniform over the full distribution at
+        eta=1.0, the lower-tail CVaR_eta fractions otherwise."""
+        k = self.num_tau_act
+        mids = (jnp.arange(k, dtype=jnp.float32) + 0.5) / k
+        return mids * self.risk_cvar_eta
+
+    @nn.compact
+    def __call__(self, obs: Array, *, taus: Array = None,
+                 add_noise: bool = False) -> Array:
+        del add_noise  # accepted for QNetwork call-compat; no noisy heads
+        x = obs
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        if self.torso in CNN_TORSO_LAYERS:
+            x = CNNTorso(CNN_TORSO_LAYERS[self.torso],
+                         dtype=self.compute_dtype)(x)
+        elif self.torso == "mlp":
+            x = MLPTorso(self.mlp_features, dtype=self.compute_dtype)(x)
+        else:
+            raise ValueError(f"unknown torso {self.torso!r}")
+        if self.hidden:
+            x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype)(x))
+
+        if taus is None:
+            taus = jnp.broadcast_to(self.act_taus()[None, :],
+                                    (x.shape[0], self.num_tau_act))
+        k = taus.shape[-1]
+        # Cosine embedding phi(tau)_e = relu(W cos(pi * e * tau) + b),
+        # e = 0..E-1, projected to the feature width and Hadamard-mixed.
+        freqs = jnp.arange(self.embed_dim, dtype=jnp.float32)
+        emb = jnp.cos(jnp.pi * freqs[None, None, :]
+                      * taus[..., None].astype(jnp.float32))   # [B, K, E]
+        emb = nn.relu(nn.Dense(x.shape[-1], dtype=self.compute_dtype,
+                               name="tau_embed")(emb.astype(
+                                   self.compute_dtype)))       # [B, K, H]
+        z = x[:, None, :] * emb                                # [B, K, H]
+
+        a_out = self.num_actions
+        adv = nn.Dense(a_out, dtype=self.compute_dtype,
+                       name="advantage")(z).astype(jnp.float32)  # [B, K, A]
+        if self.dueling:
+            val = nn.Dense(1, dtype=self.compute_dtype,
+                           name="value")(z).astype(jnp.float32)  # [B, K, 1]
+            q = val + adv - jnp.mean(adv, axis=-1, keepdims=True)
+        else:
+            q = adv
+        return jnp.transpose(q, (0, 2, 1))                     # [B, A, K]
+
+    def sample_quantiles(self, obs: Array, num: int,
+                         *, add_noise: bool = False):
+        """([B, A, num] values, [B, num] taus) at fresh U(0, 1) draws."""
+        taus = jax.random.uniform(self.make_rng("tau"),
+                                  (obs.shape[0], num))
+        return self(obs, add_noise=add_noise, taus=taus), taus
+
+    def q_values(self, obs: Array, *, add_noise: bool = False) -> Array:
+        """[B, A] expected (eta=1) or CVaR_eta (eta<1) action values."""
+        return jnp.mean(self(obs, add_noise=add_noise), axis=-1)
+
+
 def build_network(cfg: NetworkConfig, num_actions: int) -> nn.Module:
     """Build the Q-network for a config; recurrent if cfg.lstm_size > 0."""
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    if cfg.iqn:
+        if cfg.lstm_size or cfg.noisy or cfg.num_atoms > 1:
+            raise ValueError(
+                "the IQN head is feed-forward, epsilon-greedy and already "
+                "distributional; unset lstm_size/noisy/num_atoms or iqn")
+        if not 0.0 < cfg.risk_cvar_eta <= 1.0:
+            raise ValueError(
+                f"risk_cvar_eta must be in (0, 1], got "
+                f"{cfg.risk_cvar_eta} — 1.0 is risk-neutral, smaller "
+                "values average only the lower CVaR tail")
+        return ImplicitQuantileNetwork(
+            num_actions=num_actions, torso=cfg.torso,
+            mlp_features=cfg.mlp_features, hidden=cfg.hidden,
+            dueling=cfg.dueling, embed_dim=cfg.iqn_embed_dim,
+            num_tau=cfg.iqn_tau_samples,
+            num_tau_target=cfg.iqn_tau_target_samples,
+            num_tau_act=cfg.iqn_tau_act,
+            risk_cvar_eta=cfg.risk_cvar_eta, compute_dtype=dtype)
     if cfg.lstm_size:
         if cfg.noisy or cfg.num_atoms > 1:
             raise ValueError(

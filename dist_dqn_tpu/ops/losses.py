@@ -183,12 +183,40 @@ def quantile_huber_td(theta_a: Array, target_theta: Array,
 
     Returns: [B] losses — sum over predicted quantiles i of the mean over
     target samples j of |tau_i - 1{u_ij < 0}| * Huber_kappa(u_ij) / kappa,
-    the Dabney et al. (2018) estimator.
+    the Dabney et al. (2018) estimator. This is the fixed-midpoint
+    special case of ``iqn_quantile_huber_td``.
     """
     n = theta_a.shape[-1]
+    taus = jnp.broadcast_to(quantile_midpoints(n, theta_a.dtype)[None, :],
+                            theta_a.shape)
+    return iqn_quantile_huber_td(theta_a, taus, target_theta, kappa)
+
+
+def iqn_quantile_huber_td(theta_a: Array, taus: Array, target_theta: Array,
+                          kappa: float = 1.0) -> Array:
+    """Per-example quantile-Huber loss at SAMPLED quantile fractions (IQN).
+
+    Generalizes ``quantile_huber_td`` from the fixed QR-DQN midpoints to
+    per-example sampled taus (Dabney et al., 2018b "Implicit Quantile
+    Networks"): each predicted quantile value theta_a[b, i] is trained
+    toward the taus[b, i] fraction of the target sample distribution.
+
+    Args:
+      theta_a:      [B, N] predicted quantile values at the taken action.
+      taus:         [B, N] the quantile fractions those predictions were
+                    conditioned on (in (0, 1)).
+      target_theta: [B, M] Bellman-target quantile samples; stop-gradded
+                    here — no gradient ever flows into the target.
+      kappa: Huber threshold.
+
+    Returns: [B] losses — sum over predicted quantiles i of the mean over
+    target samples j of |tau_i - 1{u_ij < 0}| * Huber_kappa(u_ij) / kappa.
+    Reduces exactly to ``quantile_huber_td`` when taus are the fixed
+    midpoints (pinned by tests/test_iqn.py).
+    """
     u = (jax.lax.stop_gradient(target_theta)[:, None, :]
          - theta_a[:, :, None])                              # [B, N, M]
-    tau = quantile_midpoints(n, theta_a.dtype)[None, :, None]
+    tau = jax.lax.stop_gradient(taus)[:, :, None]            # [B, N, 1]
     weight = jnp.abs(tau - (u < 0.0).astype(theta_a.dtype))
     return jnp.sum(jnp.mean(weight * huber(u, kappa) / kappa, axis=2),
                    axis=1)
