@@ -46,6 +46,17 @@ VARIANTS = {
                           "BENCH_TRAIN_EVERY": "2"},
     "lanes256_b128":     {"BENCH_NUM_ENVS": "256", "BENCH_BATCH": "128",
                           "BENCH_TRAIN_EVERY": "4"},
+    # Ring-size axis at the winning 1024x512 point (both ring sizes ran
+    # on-chip before: 131k in round 1, 64k default everywhere).
+    "lanes1024_ring32k": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                          "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "32768"},
+    "lanes1024_ring131k": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                           "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "131072"},
+    # 1.5x the proven 1024 lanes — inside the <=2x-of-proven sizing rule
+    # (verify skill incident #3), but still the riskiest of the defaults,
+    # so DEFAULT_VARIANTS runs it after every proven size.
+    "lanes1536_b768":    {"BENCH_NUM_ENVS": "1536", "BENCH_BATCH": "768",
+                          "BENCH_TRAIN_EVERY": "4"},
     # Proven OVERSIZED on v5e (watchdog timeout + tunnel wedge
     # 2026-07-31); excluded from the default run — opt in explicitly
     # with --variants lanes2048_b1024, and only run it LAST.
@@ -53,7 +64,15 @@ VARIANTS = {
                           "BENCH_TRAIN_EVERY": "4"},
 }
 OVERSIZED = ("lanes2048_b1024",)
-DEFAULT_VARIANTS = [v for v in VARIANTS if v not in OVERSIZED]
+# Highest information-per-minute first (the unmeasured ring axis at the
+# winning point), re-measurements of known points after, the one
+# unproven size last.
+DEFAULT_VARIANTS = [
+    "lanes1024_b512", "lanes1024_ring32k", "lanes1024_ring131k",
+    "default_512x256", "lanes1024_b256te2", "lanes256_b128",
+    "lanes1536_b768",
+]
+assert set(DEFAULT_VARIANTS) == set(VARIANTS) - set(OVERSIZED)
 MEASURE_CHUNKS = "10"   # ~2M env steps per variant at 1024 lanes
 
 
@@ -107,7 +126,7 @@ def main() -> int:
             env["BENCH_SMOKE"] = "1"
             # Smoke mode still honors explicit overrides; shrink them.
             env.update(BENCH_NUM_ENVS="8", BENCH_BATCH="16",
-                       BENCH_MEASURE_CHUNKS="2")
+                       BENCH_MEASURE_CHUNKS="2", BENCH_RING="2048")
         res = run_stage(name, [sys.executable, "bench.py"], 540, out_dir,
                         env=env)
         # Pull the JSON contract line out of the log for the summary.

@@ -13,14 +13,16 @@ import pytest
 
 pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
-def test_apex_split_end_to_end():
-    cfg = CONFIGS["apex"]
+def _run_split_and_assert_plumbing(config_name, **net_overrides):
+    """Tiny CartPole split on a head variant; asserts the shared result
+    contract (steps flowed, replay filled, learner stepped, no drops)."""
+    cfg = CONFIGS[config_name]
     cfg = dataclasses.replace(
         cfg,
         network=dataclasses.replace(cfg.network, torso="mlp",
                                     mlp_features=(32,), hidden=0,
-                                    dueling=False,
-                                    compute_dtype="float32"),
+                                    compute_dtype="float32",
+                                    **net_overrides),
         replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=200),
         learner=dataclasses.replace(cfg.learner, batch_size=32, n_step=3),
     )
@@ -32,6 +34,20 @@ def test_apex_split_end_to_end():
     assert result["replay_size"] > 500
     assert result["grad_steps"] >= 10
     assert result["ring_dropped"] == 0
+
+
+def test_apex_split_end_to_end():
+    _run_split_and_assert_plumbing("apex", dueling=False)
+
+
+def test_apex_split_iqn_head():
+    """The newest head family through the real actor/learner split: the
+    service's batched inference acts on the IQN head's deterministic
+    fraction means and the learner's sampled-tau quantile loss feeds the
+    PER priority write-backs — same plumbing invariants as the DQN run."""
+    _run_split_and_assert_plumbing(
+        "iqn", iqn_embed_dim=16, iqn_tau_samples=8,
+        iqn_tau_target_samples=8, iqn_tau_act=4)
 
 
 def test_apex_split_learns_cartpole():

@@ -80,10 +80,12 @@ def test_iqn_regression_recovers_distribution_quantiles():
 
 
 def _small_net(num_actions=4, **kw):
-    cfg = dataclasses.replace(
-        CONFIGS["iqn"].network, torso="mlp", mlp_features=(16,), hidden=0,
-        iqn_embed_dim=8, iqn_tau_samples=5, iqn_tau_target_samples=6,
-        iqn_tau_act=4, compute_dtype="float32", **kw)
+    fields = dict(torso="mlp", mlp_features=(16,), hidden=0,
+                  iqn_embed_dim=8, iqn_tau_samples=5,
+                  iqn_tau_target_samples=6, iqn_tau_act=4,
+                  compute_dtype="float32")
+    fields.update(kw)
+    cfg = dataclasses.replace(CONFIGS["iqn"].network, **fields)
     return build_network(cfg, num_actions)
 
 
@@ -156,6 +158,56 @@ def test_iqn_cvar_acting_fractions():
     lo = np.asarray(net_averse.act_taus())
     np.testing.assert_allclose(lo, mids * 0.25, rtol=1e-6)
     assert lo.max() <= 0.25
+
+
+def test_iqn_cvar_policy_is_risk_averse_after_training():
+    """Risk-sensitive control end-to-end: train the IQN learner on a
+    two-armed bandit — arm 0 pays 0.5 always, arm 1 pays 1.0 w.p. 0.8 /
+    -1.0 w.p. 0.2 (mean 0.6, heavy left tail) — then act with the SAME
+    trained params under both acting profiles. The risk-neutral mean
+    prefers the risky arm; CVaR_0.2 (averaging only the worst fifth of
+    the learned return distribution) must flip to the safe arm."""
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.types import Transition
+
+    net = _small_net(num_actions=2, iqn_tau_samples=32,
+                     iqn_tau_target_samples=32, iqn_tau_act=16)
+    lcfg = dataclasses.replace(
+        CONFIGS["iqn"].learner, learning_rate=3e-3, batch_size=128,
+        double_dqn=False, target_update_period=50)
+    init, train_step = make_learner(net, lcfg)
+    train_step = jax.jit(train_step, donate_argnums=0)
+
+    obs = np.ones((128, 6), np.float32)
+    r = np.random.default_rng(0)
+    state = init(jax.random.PRNGKey(0), jnp.ones((6,)))
+    for _ in range(300):
+        actions = r.integers(0, 2, 128)
+        risky = np.where(r.uniform(size=128) < 0.8, 1.0, -1.0)
+        rewards = np.where(actions == 0, 0.5, risky).astype(np.float32)
+        batch = Transition(
+            obs=jnp.asarray(obs), action=jnp.asarray(actions, jnp.int32),
+            reward=jnp.asarray(rewards),
+            discount=jnp.zeros(128),          # one-step episodes
+            next_obs=jnp.asarray(obs))
+        state, _ = train_step(state, batch)
+
+    averse = _small_net(num_actions=2, iqn_tau_samples=32,
+                        iqn_tau_target_samples=32, iqn_tau_act=16,
+                        risk_cvar_eta=0.2)
+    one = jnp.ones((1, 6))
+    q_neutral = np.asarray(net.apply(state.params, one,
+                                     method=net.q_values))[0]
+    q_averse = np.asarray(averse.apply(state.params, one,
+                                       method=averse.q_values))[0]
+    # Learned means are near the true ones and rank the risky arm first…
+    assert abs(q_neutral[0] - 0.5) < 0.15, q_neutral
+    assert abs(q_neutral[1] - 0.6) < 0.15, q_neutral
+    assert q_neutral.argmax() == 1, q_neutral
+    # …while the CVaR_0.2 profile flips to the safe arm: the risky arm's
+    # lower tail is dominated by the -1 outcome.
+    assert q_averse.argmax() == 0, q_averse
+    assert q_averse[1] < -0.3, q_averse
 
 
 def test_iqn_rejects_incompatible_heads():
