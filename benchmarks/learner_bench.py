@@ -153,6 +153,28 @@ def r2d2_sweep(iters: int):
                 print(json.dumps(out), flush=True)
 
 
+def batch_sweep(iters: int, config_name: str = "apex"):
+    """Learner batch-size scaling (next perf lever after the lane sweep):
+    the feed-forward heads measure 2-5% MFU at their config batch sizes —
+    latency/bandwidth-bound, not MXU-bound — so grad-steps/s should fall
+    sublinearly while examples/s and MFU climb as B doubles. Sizes up to
+    2048 = 4x the proven B=512 chip run, stepped through 1024 first, so
+    each point is <=2x the previously measured size (verify-skill
+    incident-#3 rule; run order is smallest-first)."""
+    import dataclasses
+
+    from dist_dqn_tpu.config import CONFIGS
+
+    base = CONFIGS[config_name]
+    for batch in (256, 512, 1024, 2048):
+        cfg = dataclasses.replace(
+            base, learner=dataclasses.replace(base.learner,
+                                              batch_size=batch))
+        out = bench_config(config_name, iters, cfg=cfg)
+        out.update(batch_sweep_point=batch)
+        print(json.dumps(out), flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--configs", nargs="*",
@@ -163,6 +185,9 @@ def main():
     p.add_argument("--r2d2-sweep", action="store_true",
                    help="sweep the R2D2 throughput knobs (remat, LSTM "
                         "dtype, scan unroll) instead of --configs")
+    p.add_argument("--batch-sweep", action="store_true",
+                   help="sweep learner batch size 256..2048 on the apex "
+                        "config instead of --configs")
     args = p.parse_args()
     from dist_dqn_tpu.utils.device_cleanup import install as _install_cleanup
 
@@ -171,6 +196,9 @@ def main():
         jax.config.update("jax_platforms", args.platform)
     if args.r2d2_sweep:
         r2d2_sweep(args.iters)
+        return
+    if args.batch_sweep:
+        batch_sweep(args.iters)
         return
     for name in args.configs:
         print(json.dumps(bench_config(name, args.iters)), flush=True)

@@ -67,6 +67,19 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     distributional = num_atoms > 1 and not quantile
     noisy = getattr(net, "noisy", False)
     iqn = getattr(net, "iqn", False)
+    if cfg.munchausen and (distributional or quantile or iqn):
+        raise ValueError(
+            "munchausen targets are scalar-head only; unset munchausen "
+            "or use a non-distributional network")
+    if cfg.munchausen and cfg.value_rescale:
+        raise ValueError(
+            "munchausen and value_rescale both transform the target; "
+            "set only one")
+    if cfg.munchausen and cfg.n_step != 1:
+        raise ValueError(
+            "munchausen requires n_step=1: replay folds n-step rewards "
+            "at sample time, so the per-step log-policy bonuses the "
+            "soft recursion needs cannot be applied for n_step > 1")
 
     def init(rng: Array, obs_example: Array) -> LearnerState:
         rng, k_param, k_noise = jax.random.split(rng, 3)
@@ -158,17 +171,32 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
             q = _apply(net, params, batch.obs, k_online, noisy)
             q_next_target = _apply(net, target_params, batch.next_obs,
                                    k_target, noisy)
-            if cfg.double_dqn:
-                q_next_online = _apply(net, params, batch.next_obs, k_next,
-                                       noisy)
-                boot = losses.double_q_bootstrap(q_next_online, q_next_target)
+            if cfg.munchausen:
+                # M-DQN (Vieillard et al., 2020): soft bootstrap replaces
+                # the max/double-Q bootstrap, and the clipped scaled
+                # log-policy of the taken action (target net at the
+                # STORED obs) is added to the reward.
+                boot = losses.munchausen_soft_bootstrap(
+                    q_next_target, cfg.munchausen_tau)
+                q_obs_target = _apply(net, target_params, batch.obs,
+                                      k_next, noisy)
+                bonus = losses.munchausen_bonus(
+                    q_obs_target, batch.action, cfg.munchausen_alpha,
+                    cfg.munchausen_tau, cfg.munchausen_clip)
+                target = batch.reward + bonus + batch.discount * boot
             else:
-                boot = jnp.max(q_next_target, axis=-1)
-            if cfg.value_rescale:
-                boot = losses.inv_value_rescale(boot)
-            target = batch.reward + batch.discount * boot
-            if cfg.value_rescale:
-                target = losses.value_rescale(target)
+                if cfg.double_dqn:
+                    q_next_online = _apply(net, params, batch.next_obs,
+                                           k_next, noisy)
+                    boot = losses.double_q_bootstrap(q_next_online,
+                                                     q_next_target)
+                else:
+                    boot = jnp.max(q_next_target, axis=-1)
+                if cfg.value_rescale:
+                    boot = losses.inv_value_rescale(boot)
+                target = batch.reward + batch.discount * boot
+                if cfg.value_rescale:
+                    target = losses.value_rescale(target)
             qa = jnp.take_along_axis(
                 q, batch.action[:, None].astype(jnp.int32), axis=-1)[:, 0]
             td = qa - jax.lax.stop_gradient(target)

@@ -47,6 +47,11 @@ def make_r2d2_learner(net, cfg: LearnerConfig, rcfg: ReplayConfig,
     eta = rcfg.priority_mix
     if unroll <= 0:
         raise ValueError("R2D2 learner needs replay.unroll_length > 0")
+    if cfg.munchausen:
+        raise ValueError(
+            "munchausen targets are implemented on the feed-forward "
+            "scalar head only (agents/dqn.py); unset munchausen or "
+            "lstm_size")
 
     tx_parts = []
     if cfg.max_grad_norm:

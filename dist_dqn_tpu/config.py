@@ -88,6 +88,18 @@ class LearnerConfig:
     target_update_period: int = 500    # hard copy every N steps (if tau == 0)
     target_tau: float = 0.0            # >0 => soft Polyak every step
     value_rescale: bool = False        # R2D2 h/h^-1 transform
+    # Munchausen-DQN (Vieillard et al., 2020): entropy-regularized soft
+    # bootstrap plus a clipped scaled log-policy bonus on the reward.
+    # Scalar-head only (agents/dqn.py); replaces the max/double-Q
+    # bootstrap when set. Use with n_step=1: replay folds n-step rewards
+    # at sample time, so the intermediate per-step log-policy bonuses
+    # the telescoped soft recursion needs are not recoverable — with
+    # n_step>1 only the first step's bonus is applied (make_learner
+    # rejects the combination rather than silently approximating).
+    munchausen: bool = False
+    munchausen_alpha: float = 0.9      # bonus scale
+    munchausen_tau: float = 0.03       # entropy temperature
+    munchausen_clip: float = -1.0      # lower clip l0 on log pi(a|s)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -272,6 +284,32 @@ IQN = ExperimentConfig(
     train_every=4,
 )
 
+MDQN = ExperimentConfig(
+    # Beyond the driver's five configs: Munchausen-DQN (Vieillard et
+    # al., 2020) — the atari preset's schedule with the soft
+    # entropy-regularized bootstrap and the clipped log-policy reward
+    # bonus (paper defaults alpha 0.9, tau 0.03, l0 -1) plus PER.
+    name="mdqn",
+    env_name="pixel_pong",
+    network=NetworkConfig(torso="nature", hidden=512,
+                          compute_dtype="bfloat16"),
+    replay=ReplayConfig(capacity=200_000, prioritized=True,
+                        priority_exponent=0.5, importance_exponent=0.4,
+                        min_fill=20_000),
+    learner=LearnerConfig(
+        # n_step=1: the Munchausen recursion needs every step's
+        # log-policy bonus, which folded n-step rewards can't carry
+        # (see LearnerConfig.munchausen).
+        learning_rate=6.25e-5, adam_eps=1.5e-4, gamma=0.99, n_step=1,
+        batch_size=256, target_update_period=2_000,
+        munchausen=True,
+    ),
+    actor=ActorConfig(num_envs=64, epsilon_decay_steps=250_000),
+    total_env_steps=10_000_000,
+    train_every=4,
+)
+
 CONFIGS: Dict[str, ExperimentConfig] = {
-    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW, QRDQN, IQN)
+    c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW, QRDQN, IQN,
+                        MDQN)
 }

@@ -68,6 +68,38 @@ def q_learning_error(
 
 
 # ---------------------------------------------------------------------------
+# Munchausen-DQN (Vieillard et al., 2020): entropy-regularized soft
+# bootstrap + clipped scaled log-policy reward bonus, on the scalar head.
+# ---------------------------------------------------------------------------
+
+def munchausen_soft_bootstrap(q_next_target: Array, tau: float) -> Array:
+    """Soft state value from the target net: sum_a' pi(a'|s')(q(s',a') -
+    tau log pi(a'|s')) with pi = softmax(q/tau).
+
+    Computed in the numerically stable log-sum-exp form
+    tau * logsumexp(q/tau) (the two are algebraically identical).
+    Args: q_next_target [B, A]. Returns [B].
+    """
+    return tau * jax.scipy.special.logsumexp(q_next_target / tau, axis=-1)
+
+
+def munchausen_bonus(q_obs_target: Array, actions: Array, alpha: float,
+                     tau: float, clip_low: float) -> Array:
+    """The Munchausen reward bonus alpha * clip(tau * log pi(a|s), l0, 0).
+
+    pi = softmax(q/tau) from the TARGET net at the stored observation;
+    the log-policy of the action the actor actually took is scaled and
+    clipped below at ``clip_low`` (paper l0 = -1) to bound the penalty
+    for very off-policy actions.
+    Args: q_obs_target [B, A]; actions [B]. Returns [B].
+    """
+    log_pi = jax.nn.log_softmax(q_obs_target / tau, axis=-1)
+    log_pi_a = jnp.take_along_axis(
+        log_pi, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return alpha * jnp.clip(tau * log_pi_a, clip_low, 0.0)
+
+
+# ---------------------------------------------------------------------------
 # R2D2 value rescaling (BASELINE.json:10): h(x) = sign(x)(sqrt(|x|+1)-1)+eps*x
 # ---------------------------------------------------------------------------
 

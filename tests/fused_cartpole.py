@@ -1,0 +1,51 @@
+"""Shared scaled-down CartPole fused-loop learning harness.
+
+The QR-DQN / IQN / M-DQN convergence tests all run the same protocol —
+shrink the preset to a CartPole MLP, run the fused on-device loop for
+150k frames, greedy-eval — and assert a clearly-better-than-random
+return. One implementation here so the protocol can't drift between
+head families.
+"""
+import dataclasses
+
+import jax
+
+from dist_dqn_tpu.envs import make_jax_env
+from dist_dqn_tpu.models import build_network
+from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
+
+
+def run_scaled_cartpole(cfg, net_overrides, chunks=10, seed=0):
+    """Shrink ``cfg`` to a CartPole MLP variant (applying the extra
+    ``net_overrides``), run ``chunks`` fused 1000-iter chunks (x16 env
+    lanes = 160k frames at the default), return the greedy eval return
+    (and the last chunk's metrics for failure messages)."""
+    total_env_steps = 150_000
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="cartpole",
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(64, 64), hidden=0,
+                                    compute_dtype="float32",
+                                    **net_overrides),
+        replay=dataclasses.replace(cfg.replay, capacity=20_000,
+                                   min_fill=1_000, pallas_sampler=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=128,
+                                    learning_rate=1e-3,
+                                    target_update_period=250),
+        actor=dataclasses.replace(cfg.actor, num_envs=16,
+                                  epsilon_decay_steps=20_000),
+        total_env_steps=total_env_steps,
+        train_every=1,
+    )
+    env = make_jax_env("cartpole")
+    net = build_network(cfg.network, env.num_actions)
+    init, run = make_fused_train(cfg, env, net)
+    run = jax.jit(run, static_argnums=1, donate_argnums=0)
+    evaluate = jax.jit(make_evaluator(cfg, env, net))
+    carry = init(jax.random.PRNGKey(seed))
+    metrics = None
+    for _ in range(chunks):
+        carry, metrics = run(carry, 1000)
+    ret = float(evaluate(carry.learner.params, jax.random.PRNGKey(1)))
+    return ret, jax.device_get(metrics)

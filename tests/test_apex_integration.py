@@ -24,7 +24,8 @@ def _run_split_and_assert_plumbing(config_name, **net_overrides):
                                     compute_dtype="float32",
                                     **net_overrides),
         replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=200),
-        learner=dataclasses.replace(cfg.learner, batch_size=32, n_step=3),
+        # n_step inherits from the preset (mdqn requires 1; others use 3).
+        learner=dataclasses.replace(cfg.learner, batch_size=32),
     )
     rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=2,
                            envs_per_actor=4, total_env_steps=1200,
@@ -48,6 +49,12 @@ def test_apex_split_iqn_head():
     _run_split_and_assert_plumbing(
         "iqn", iqn_embed_dim=16, iqn_tau_samples=8,
         iqn_tau_target_samples=8, iqn_tau_act=4)
+
+
+def test_apex_split_mdqn_targets():
+    """Munchausen targets through the split: the learner's soft
+    bootstrap + log-policy bonus runs behind the same service plumbing."""
+    _run_split_and_assert_plumbing("mdqn")
 
 
 def test_apex_split_learns_cartpole():

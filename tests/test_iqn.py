@@ -247,36 +247,10 @@ def test_iqn_learner_step_runs_and_reports_priorities():
 def test_iqn_fused_loop_learns_cartpole():
     """The full combination learns: IQN head + PER + double-Q through the
     fused on-device loop clears a clearly-better-than-random return."""
-    from dist_dqn_tpu.envs import make_jax_env
-    from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
+    from fused_cartpole import run_scaled_cartpole
 
-    cfg = CONFIGS["iqn"]
-    cfg = dataclasses.replace(
-        cfg,
-        env_name="cartpole",
-        network=dataclasses.replace(cfg.network, torso="mlp",
-                                    mlp_features=(64, 64), hidden=0,
-                                    iqn_embed_dim=32, iqn_tau_samples=16,
-                                    iqn_tau_target_samples=16,
-                                    iqn_tau_act=16,
-                                    compute_dtype="float32"),
-        replay=dataclasses.replace(cfg.replay, capacity=20_000,
-                                   min_fill=1_000, pallas_sampler=False),
-        learner=dataclasses.replace(cfg.learner, batch_size=128,
-                                    learning_rate=1e-3,
-                                    target_update_period=250),
-        actor=dataclasses.replace(cfg.actor, num_envs=16,
-                                  epsilon_decay_steps=20_000),
-        total_env_steps=150_000,
-        train_every=1,
-    )
-    env = make_jax_env("cartpole")
-    net = build_network(cfg.network, env.num_actions)
-    init, run = make_fused_train(cfg, env, net)
-    run = jax.jit(run, static_argnums=1, donate_argnums=0)
-    evaluate = jax.jit(make_evaluator(cfg, env, net))
-    carry = init(jax.random.PRNGKey(0))
-    for _ in range(10):
-        carry, metrics = run(carry, 1000)
-    ret = float(evaluate(carry.learner.params, jax.random.PRNGKey(1)))
-    assert ret >= 150.0, (ret, jax.device_get(metrics))
+    ret, metrics = run_scaled_cartpole(
+        CONFIGS["iqn"],
+        dict(iqn_embed_dim=32, iqn_tau_samples=16,
+             iqn_tau_target_samples=16, iqn_tau_act=16))
+    assert ret >= 150.0, (ret, metrics)
