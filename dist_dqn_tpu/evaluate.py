@@ -125,6 +125,21 @@ def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
             "episodes_truncated": truncated}
 
 
+def _apply_risk_eta(cfg: ExperimentConfig, eta) -> ExperimentConfig:
+    """Evaluate an IQN checkpoint under a different risk profile than it
+    was trained with (the point of IQN's CVaR acting: one set of learned
+    quantiles, a family of policies). Parameters are risk-agnostic, so
+    any eta in (0, 1] restores cleanly."""
+    import dataclasses
+
+    if not cfg.network.iqn:
+        raise ValueError(
+            "--risk-cvar-eta only applies to IQN configs (the acting "
+            f"fractions of {cfg.name!r} are not tau-conditioned)")
+    return dataclasses.replace(
+        cfg, network=dataclasses.replace(cfg.network, risk_cvar_eta=eta))
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", choices=sorted(CONFIGS), required=True)
@@ -137,17 +152,27 @@ def main():
                         help="evaluate on a HOST env (e.g. ale:Breakout, "
                              "CartPole-v1, dmc:reacher:easy) instead of "
                              "the config's JAX stand-in env")
+    parser.add_argument("--risk-cvar-eta", type=float, default=None,
+                        help="IQN configs only: act on the lower-eta CVaR "
+                             "tail of the learned return distribution "
+                             "instead of the trained profile (risk-averse "
+                             "deploy-time policy from the same checkpoint)")
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    cfg = CONFIGS[args.config]
+    if args.risk_cvar_eta is not None:
+        cfg = _apply_risk_eta(cfg, args.risk_cvar_eta)
     if args.host_env:
         out = evaluate_checkpoint_host(
-            CONFIGS[args.config], args.checkpoint_dir, args.host_env,
+            cfg, args.checkpoint_dir, args.host_env,
             episodes=args.episodes, seed=args.seed)
     else:
         out = evaluate_checkpoint(
-            CONFIGS[args.config], args.checkpoint_dir,
+            cfg, args.checkpoint_dir,
             episodes=args.episodes, seed=args.seed)
+    if args.risk_cvar_eta is not None:
+        out["risk_cvar_eta"] = args.risk_cvar_eta
     print(json.dumps(out))
 
 

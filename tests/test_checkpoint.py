@@ -124,6 +124,55 @@ def test_standalone_evaluate_checkpoint(tmp_path):
     assert 1.0 <= out["eval_return"] <= 500.0
 
 
+def test_standalone_evaluate_risk_profile_swap(tmp_path):
+    """An IQN checkpoint restores under a DIFFERENT deploy-time risk
+    profile (--risk-cvar-eta): parameters are risk-agnostic, so the same
+    learned quantiles yield a family of policies; non-IQN configs must
+    reject the flag."""
+    import pytest
+
+    from dist_dqn_tpu.evaluate import _apply_risk_eta, evaluate_checkpoint
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["iqn"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="cartpole",
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    iqn_embed_dim=8, iqn_tau_samples=4,
+                                    iqn_tau_target_samples=4, iqn_tau_act=4,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=128,
+                                   pallas_sampler=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=32),
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        eval_every_steps=10**9,
+        train_every=1,
+    )
+    ckpt_dir = str(tmp_path / "run")
+    train(cfg, total_env_steps=3000, chunk_iters=250,
+          log_fn=lambda s: None, checkpoint_dir=ckpt_dir)
+    neutral = evaluate_checkpoint(cfg, ckpt_dir, episodes=2, seed=1)
+    averse_cfg = _apply_risk_eta(cfg, 0.3)
+    averse = evaluate_checkpoint(averse_cfg, ckpt_dir, episodes=2, seed=1)
+    for out in (neutral, averse):
+        assert 1.0 <= out["eval_return"] <= 500.0
+    # The override must actually reach the built network's acting
+    # fractions — otherwise --risk-cvar-eta is a silent no-op.
+    import numpy as np
+
+    from dist_dqn_tpu.models import build_network
+
+    assert averse_cfg.network.risk_cvar_eta == 0.3
+    taus_neutral = np.asarray(build_network(cfg.network, 2).act_taus())
+    taus_averse = np.asarray(
+        build_network(averse_cfg.network, 2).act_taus())
+    np.testing.assert_allclose(taus_averse, taus_neutral * 0.3, rtol=1e-6)
+    with pytest.raises(ValueError):
+        _apply_risk_eta(CONFIGS["cartpole"], 0.3)
+
+
 def test_standalone_evaluate_checkpoint_on_host_env(tmp_path):
     """--host-env: a checkpoint trained on the JAX env evaluates on the
     REAL host env (here gymnasium CartPole-v1 against the JAX cartpole
