@@ -40,11 +40,21 @@ def _fixed_batch(key, batch, obs_dim=6, num_actions=3):
     )
 
 
-def test_sharded_train_step_matches_single_device(mesh):
-    """8 learners on batch shards + pmean == 1 learner on the full batch."""
-    net = QNetwork(num_actions=3, torso="mlp", mlp_features=(32, 16),
-                   hidden=0)
-    cfg = LearnerConfig(learning_rate=1e-2)
+@pytest.mark.parametrize("head", ["dqn", "c51", "qrdqn", "mdqn"])
+def test_sharded_train_step_matches_single_device(mesh, head):
+    """8 learners on batch shards + pmean == 1 learner on the full batch,
+    for every deterministic head family. IQN is excluded: its loss draws
+    taus with shape [B_shard, N], so the sharded step sees different
+    fractions per example than the full-batch step and bit-equivalence
+    is impossible by construction — it gets the mesh-runs test below."""
+    net_kw = dict(num_actions=3, torso="mlp", mlp_features=(32, 16),
+                  hidden=0)
+    if head == "c51":
+        net_kw.update(num_atoms=11, v_min=-5.0, v_max=5.0)
+    elif head == "qrdqn":
+        net_kw.update(num_atoms=8, quantile=True)
+    net = QNetwork(**net_kw)
+    cfg = LearnerConfig(learning_rate=1e-2, munchausen=(head == "mdqn"))
     init_s, step_s = make_learner(net, cfg)
     _, step_d = make_learner(net, cfg, axis_name="dp")
 
@@ -118,6 +128,30 @@ def test_mesh_r2d2_train_runs(mesh):
     p0 = jax.tree.leaves(carry.learner.params)[0]
     assert np.all(np.isfinite(np.asarray(p0)))
     assert len(carry.ep_return.sharding.device_set) == 8
+
+
+def test_mesh_fused_train_runs_iqn(mesh):
+    """The sampled-tau head across the mesh: the learner rng is
+    replicated, so every shard draws the SAME tau fractions for its own
+    batch shard (shards differ in data, not fractions); grads pmean to
+    one replicated parameter set."""
+    cfg = _tiny_cartpole_cfg(prioritized=True)
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, iqn=True, iqn_embed_dim=8,
+                                    iqn_tau_samples=4,
+                                    iqn_tau_target_samples=4,
+                                    iqn_tau_act=4))
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run = make_mesh_fused_train(cfg, env, net, mesh)
+    carry = init(jax.random.PRNGKey(0))
+    carry, metrics = run(carry, 40)
+    assert int(metrics["env_frames"]) == 40 * 16
+    assert float(metrics["grad_steps_in_chunk"]) > 0
+    assert np.isfinite(float(metrics["loss"]))
+    p0 = jax.tree.leaves(carry.learner.params)[0]
+    assert np.all(np.isfinite(np.asarray(p0)))
 
 
 @pytest.mark.parametrize("prioritized", [False, True])
