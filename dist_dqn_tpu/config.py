@@ -313,3 +313,113 @@ CONFIGS: Dict[str, ExperimentConfig] = {
     c.name: c for c in (CARTPOLE, ATARI, APEX, R2D2, RAINBOW, QRDQN, IQN,
                         MDQN)
 }
+
+
+# ---------------------------------------------------------------------------
+# Generic dotted-path config overrides (the CLIs' --set flag): derive any
+# preset variant from the command line without writing a config file —
+# the CLI counterpart of the dataclasses.replace idiom used in code.
+# ---------------------------------------------------------------------------
+
+def _coerce(raw: str, current, path: str):
+    """Parse ``raw`` to the type of the field's current value."""
+    low = raw.lower()
+    if isinstance(current, bool):          # bool before int: bool is an int
+        if low in ("true", "1", "yes", "on"):
+            return True
+        if low in ("false", "0", "no", "off"):
+            return False
+        raise ValueError(f"--set {path}: expected a bool, got {raw!r}")
+    if isinstance(current, int):
+        try:
+            return int(raw, 0)
+        except ValueError:
+            raise ValueError(
+                f"--set {path}: expected an int, got {raw!r}") from None
+    if isinstance(current, float):
+        try:
+            return float(raw)
+        except ValueError:
+            raise ValueError(
+                f"--set {path}: expected a float, got {raw!r}") from None
+    if isinstance(current, tuple):
+        items = [s for s in raw.strip("()").split(",") if s.strip()]
+        elem = current[0] if current else 0
+        return tuple(_coerce(s.strip(), elem, path) for s in items)
+    if isinstance(current, str):
+        return raw
+    # Optional fields default to None (e.g. replay.store_final_obs);
+    # accept none/bool and fall back through int/float to str.
+    if current is None:
+        if low in ("none", "null"):
+            return None
+        if low in ("true", "false", "1", "0", "yes", "no", "on", "off"):
+            return _coerce(raw, True, path)
+        for parse in (int, float):
+            try:
+                return parse(raw)
+            except ValueError:
+                pass
+        return raw
+    raise ValueError(
+        f"--set {path}: field type {type(current).__name__} is not "
+        "overridable from the command line")
+
+
+def _is_optional(cls, name: str) -> bool:
+    """True if the resolved annotation of ``cls.name`` admits None
+    (covers both the ``X | None`` and ``Optional[X]`` spellings)."""
+    import typing
+
+    try:
+        hint = typing.get_type_hints(cls).get(name)
+    except Exception:
+        return False
+    return type(None) in typing.get_args(hint)
+
+
+def _set_path(obj, keys, raw: str, path: str):
+    if not dataclasses.is_dataclass(obj):
+        raise ValueError(f"--set {path}: {keys[0]!r} is past a leaf field")
+    names = {f.name for f in dataclasses.fields(obj)}
+    name = keys[0]
+    if name not in names:
+        raise ValueError(
+            f"--set {path}: unknown field {name!r}; valid here: "
+            f"{', '.join(sorted(names))}")
+    current = getattr(obj, name)
+    if len(keys) == 1:
+        if dataclasses.is_dataclass(current):
+            sub = ", ".join(
+                f.name for f in dataclasses.fields(current))
+            raise ValueError(
+                f"--set {path}: {name!r} is a config section; set one of "
+                f"its fields ({sub})")
+        # Optional fields (resolved annotation admits None) accept
+        # "none" regardless of their current value's type.
+        if raw.lower() in ("none", "null") and _is_optional(type(obj),
+                                                            name):
+            return dataclasses.replace(obj, **{name: None})
+        return dataclasses.replace(obj, **{name: _coerce(raw, current,
+                                                         path)})
+    return dataclasses.replace(
+        obj, **{name: _set_path(current, keys[1:], raw, path)})
+
+
+def apply_overrides(cfg: ExperimentConfig, assignments) -> ExperimentConfig:
+    """Apply ``--set dotted.path=value`` assignments to a config.
+
+    e.g. apply_overrides(CONFIGS["atari"], ["network.dueling=true",
+    "learner.batch_size=64", "replay.capacity=65536"]). Values are
+    coerced to the field's current type (tuples parse "256,256");
+    unknown fields and section-level assignments raise ValueError with
+    the valid field names.
+    """
+    for a in assignments or ():
+        path, eq, raw = a.partition("=")
+        path = path.strip()
+        if not eq or not path:
+            raise ValueError(
+                f"--set {a!r}: expected the form dotted.path=value")
+        cfg = _set_path(cfg, path.split("."), raw.strip(), path)
+    return cfg

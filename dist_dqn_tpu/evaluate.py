@@ -15,7 +15,7 @@ import os
 
 import jax
 
-from dist_dqn_tpu.config import CONFIGS, ExperimentConfig
+from dist_dqn_tpu.config import CONFIGS, ExperimentConfig, apply_overrides
 
 
 def _restore_latest(checkpoint_dir: str, example):
@@ -157,10 +157,15 @@ def main():
                              "tail of the learned return distribution "
                              "instead of the trained profile (risk-averse "
                              "deploy-time policy from the same checkpoint)")
+    parser.add_argument("--set", dest="overrides", action="append",
+                        metavar="PATH=VALUE", default=[],
+                        help="override config fields by dotted path (must "
+                             "match how the checkpoint was trained, e.g. "
+                             "--set network.dueling=true)")
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    cfg = CONFIGS[args.config]
+    cfg = apply_overrides(CONFIGS[args.config], args.overrides)
     if args.risk_cvar_eta is not None:
         cfg = _apply_risk_eta(cfg, args.risk_cvar_eta)
     if args.host_env:

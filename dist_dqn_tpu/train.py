@@ -15,7 +15,7 @@ import time
 import jax
 import numpy as np
 
-from dist_dqn_tpu.config import CONFIGS, ExperimentConfig
+from dist_dqn_tpu.config import CONFIGS, ExperimentConfig, apply_overrides
 from dist_dqn_tpu.envs import make_jax_env
 from dist_dqn_tpu.models import build_network
 from dist_dqn_tpu.train_loop import make_evaluator, make_fused_train
@@ -195,6 +195,12 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", choices=sorted(CONFIGS), required=True)
+    parser.add_argument("--set", dest="overrides", action="append",
+                        metavar="PATH=VALUE", default=[],
+                        help="override any config field by dotted path, "
+                             "repeatable (e.g. --set network.dueling=true "
+                             "--set learner.batch_size=64); values are "
+                             "coerced to the field's type")
     parser.add_argument("--total-env-steps", type=int, default=0)
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--chunk-iters", type=int, default=2000)
@@ -281,7 +287,7 @@ def main():
         # the CPU-collectives selection (parallel/distributed.py).
         from dist_dqn_tpu.parallel.distributed import initialize
         initialize(args.coordinator, args.num_processes, args.process_id)
-    cfg = CONFIGS[args.config]
+    cfg = apply_overrides(CONFIGS[args.config], args.overrides)
     if args.eval_every_steps:
         import dataclasses as _dc
         cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)
