@@ -162,6 +162,11 @@ def main():
                         help="train mode: env-step budget PER GAME "
                              "(default: the config's total)")
     parser.add_argument("--platform", default=None)
+    parser.add_argument("--set", dest="overrides", action="append",
+                        metavar="PATH=VALUE", default=[],
+                        help="override config fields by dotted path "
+                             "(applies to every game's run, e.g. "
+                             "--set learner.batch_size=128)")
     args = parser.parse_args()
 
     if args.mode == "list":
@@ -176,7 +181,8 @@ def main():
     games = tuple(ATARI_57 if args.games is None else args.games)
     if not games:
         parser.error("--games was given with no game names")
-    cfg = CONFIGS[args.config]
+    from dist_dqn_tpu.config import apply_overrides
+    cfg = apply_overrides(CONFIGS[args.config], args.overrides)
 
     if args.mode == "train":
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig
