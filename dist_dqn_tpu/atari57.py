@@ -182,7 +182,10 @@ def main():
     if not games:
         parser.error("--games was given with no game names")
     from dist_dqn_tpu.config import apply_overrides
-    cfg = apply_overrides(CONFIGS[args.config], args.overrides)
+    try:
+        cfg = apply_overrides(CONFIGS[args.config], args.overrides)
+    except ValueError as e:
+        parser.error(str(e))
 
     if args.mode == "train":
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig

@@ -165,7 +165,10 @@ def main():
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    cfg = apply_overrides(CONFIGS[args.config], args.overrides)
+    try:
+        cfg = apply_overrides(CONFIGS[args.config], args.overrides)
+    except ValueError as e:
+        parser.error(str(e))
     if args.risk_cvar_eta is not None:
         cfg = _apply_risk_eta(cfg, args.risk_cvar_eta)
     if args.host_env:

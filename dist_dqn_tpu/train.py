@@ -287,7 +287,10 @@ def main():
         # the CPU-collectives selection (parallel/distributed.py).
         from dist_dqn_tpu.parallel.distributed import initialize
         initialize(args.coordinator, args.num_processes, args.process_id)
-    cfg = apply_overrides(CONFIGS[args.config], args.overrides)
+    try:
+        cfg = apply_overrides(CONFIGS[args.config], args.overrides)
+    except ValueError as e:
+        parser.error(str(e))
     if args.eval_every_steps:
         import dataclasses as _dc
         cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)

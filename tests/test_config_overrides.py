@@ -73,3 +73,20 @@ def test_train_cli_honors_set(tmp_path, capsys):
     # 4 env lanes (not the preset's 16): 150-iter chunks advance 600
     # frames each.
     assert rows and rows[0]["env_frames"] == 600
+
+
+def test_train_cli_reports_bad_set_cleanly(capsys):
+    """A bad --set exits via parser.error (clean usage message naming the
+    failing path), not a traceback."""
+    import sys
+    from unittest import mock
+
+    from dist_dqn_tpu.train import main
+
+    argv = ["train", "--config", "cartpole", "--platform", "cpu",
+            "--set", "learner.batch_size=abc"]
+    with mock.patch.object(sys, "argv", argv):
+        with pytest.raises(SystemExit) as exc:
+            main()
+    assert exc.value.code == 2
+    assert "learner.batch_size: expected an int" in capsys.readouterr().err
