@@ -179,7 +179,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--configs", nargs="*",
                    default=["atari", "apex", "r2d2", "rainbow", "qrdqn",
-                            "iqn"])
+                            "iqn", "mdqn"])
     p.add_argument("--iters", type=int, default=50)
     p.add_argument("--platform", default=None)
     p.add_argument("--r2d2-sweep", action="store_true",
