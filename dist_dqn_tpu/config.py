@@ -1,8 +1,11 @@
-"""Configuration system for the five driver configs (BASELINE.json:7-11).
+"""Configuration system: the five driver configs (BASELINE.json:7-11)
+plus three beyond-spec presets (qrdqn, iqn, mdqn).
 
 Frozen dataclasses so configs are hashable and can be closed over by ``jit``
 as static values. ``CONFIGS`` is the registry keyed by the names the train CLI
-accepts; each corresponds 1:1 to a driver config line.
+accepts; the first five correspond 1:1 to driver config lines. Derive
+variants with ``dataclasses.replace`` or the CLIs' ``--set`` flag
+(``apply_overrides``).
 """
 from __future__ import annotations
 
