@@ -144,21 +144,19 @@ def categorical_projection(
     tz = rewards[:, None] + discounts[:, None] * atoms[None, :]   # [B, M]
     tz = jnp.clip(tz, v_min, v_max)
     b = (tz - v_min) / dz                                         # in [0, M-1]
-    low = jnp.floor(b)
-    high = jnp.ceil(b)
-    # When b lands exactly on an atom, low == high and both weights below are
-    # zero; route the full mass through the `low` bucket in that case.
-    eq = (low == high).astype(next_probs.dtype)
-    w_low = (high - b) + eq
-    w_high = b - low
-
-    low_i = low.astype(jnp.int32)
-    high_i = high.astype(jnp.int32)
-    batch = jnp.arange(next_probs.shape[0])[:, None]
-    out = jnp.zeros_like(next_probs)
-    out = out.at[batch, low_i].add(next_probs * w_low)
-    out = out.at[batch, high_i].add(next_probs * w_high)
-    return out
+    # Scatter-free TPU formulation: the linear mass split IS a triangular
+    # interpolation kernel — source atom i at fractional position b_i
+    # contributes relu(1 - |b_i - j|) of its mass to output atom j (1 at
+    # an exact landing, (1-frac)/frac to the floor/ceil neighbours). One
+    # dense [B, M, M] elementwise weight + reduce replaces the two
+    # .at[].add scatters, which XLA lowers poorly on TPU; at C51 sizes
+    # the cube is tiny (B x 51 x 51). Elementwise multiply+sum rather
+    # than einsum: a default-precision matmul would run the contraction
+    # through the MXU with bf16-truncated inputs, breaking the rows-sum-
+    # to-1 contract at ~1e-2; the VPU reduce stays full f32.
+    j = jnp.arange(m, dtype=b.dtype)
+    w = jnp.maximum(0.0, 1.0 - jnp.abs(b[:, :, None] - j[None, None, :]))
+    return jnp.sum(next_probs[:, :, None] * w, axis=1)
 
 
 def categorical_double_q_probs(
