@@ -78,8 +78,25 @@ class TrainCheckpointer:
                 np.shape(x), x.dtype,
                 sharding=getattr(x, "sharding", None)),
             example)
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except ValueError as e:
+            # Orbax's structure-mismatch error lists raw pytree paths;
+            # the usual cause is a config drift, so say that first — but
+            # only for actual structure mismatches; any other restore
+            # ValueError (corruption, sharding mapping, ...) passes
+            # through untouched.
+            msg = str(e)
+            if not ("structures do not match" in msg
+                    or "User-provided restore item" in msg):
+                raise
+            raise ValueError(
+                "checkpoint does not match the current config's learner "
+                "structure — it was saved with a different network/"
+                "optimizer architecture. Rebuild with the same --config "
+                "and --set overrides used at save time.\n\nOriginal "
+                f"error:\n{e}") from e
         self._next_save = step + self.save_every_frames
         return int(step), restored
 

@@ -124,6 +124,34 @@ def test_standalone_evaluate_checkpoint(tmp_path):
     assert 1.0 <= out["eval_return"] <= 500.0
 
 
+def test_architecture_mismatch_error_names_the_cause(tmp_path):
+    """Restoring a checkpoint onto a DIFFERENT architecture (e.g. the
+    user forgot a --set flag at evaluate time) must say so up front
+    instead of leading with orbax's raw pytree-path dump."""
+    import pytest
+
+    from dist_dqn_tpu.evaluate import evaluate_checkpoint
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(32,)),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        eval_every_steps=10**9,
+    )
+    ckpt_dir = str(tmp_path / "run")
+    train(cfg, total_env_steps=300, chunk_iters=75, log_fn=lambda s: None,
+          checkpoint_dir=ckpt_dir)
+    mismatched = dataclasses.replace(
+        cfg, network=dataclasses.replace(cfg.network, dueling=True))
+    with pytest.raises(ValueError,
+                       match="same --config and --set overrides"):
+        evaluate_checkpoint(mismatched, ckpt_dir, episodes=1)
+
+
 def test_standalone_evaluate_risk_profile_swap(tmp_path):
     """An IQN checkpoint restores under a DIFFERENT deploy-time risk
     profile (--risk-cvar-eta): parameters are risk-agnostic, so the same
