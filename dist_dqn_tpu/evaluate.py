@@ -18,10 +18,10 @@ import jax
 from dist_dqn_tpu.config import CONFIGS, ExperimentConfig, apply_overrides
 
 
-def _restore_latest(checkpoint_dir: str, example):
-    """(frames, learner) from the newest checkpoint. Read-only surface:
-    never create the directory on a typo'd path, and release the orbax
-    manager after the one restore."""
+def _restore_latest(checkpoint_dir: str, example, step=None):
+    """(frames, learner) from the newest checkpoint (or a specific
+    retained ``step``). Read-only surface: never create the directory on
+    a typo'd path, and release the orbax manager after the one restore."""
     from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
     if not os.path.isdir(checkpoint_dir):
@@ -29,7 +29,7 @@ def _restore_latest(checkpoint_dir: str, example):
             f"no checkpoint found under {checkpoint_dir!r}")
     ckpt = TrainCheckpointer(checkpoint_dir)
     try:
-        restored = ckpt.restore_latest(example)
+        restored = ckpt.restore_latest(example, step=step)
     finally:
         ckpt.close()
     if restored is None:
@@ -38,14 +38,11 @@ def _restore_latest(checkpoint_dir: str, example):
     return restored
 
 
-def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
-                        episodes: int = 10, seed: int = 0,
-                        epsilon: float = 0.001) -> dict:
-    """Restore the newest checkpoint and play greedy episodes.
-
-    Returns {"eval_return": mean, "frames": checkpoint cursor, ...}.
-    Raises FileNotFoundError if the directory holds no checkpoint.
-    """
+def _build_eval(cfg: ExperimentConfig, episodes: int, epsilon: float,
+                seed: int):
+    """(example learner pytree, jitted evaluator, eval key) for the
+    config's JAX env — shared by the single-point and curve surfaces so
+    the compiled evaluator is built exactly once either way."""
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
 
@@ -71,16 +68,85 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     obs_example = jax.numpy.zeros(env.observation_shape,
                                   env.observation_dtype)
     example = init(k_init, obs_example)
-    frames, learner = _restore_latest(checkpoint_dir, example)
-    mean_return = float(jax.jit(evaluator)(learner.params, k_eval))
+    return example, jax.jit(evaluator), k_eval
+
+
+def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
+                        episodes: int = 10, seed: int = 0,
+                        epsilon: float = 0.001, step: int = None) -> dict:
+    """Restore the newest checkpoint (or retained ``step``) and play
+    greedy episodes.
+
+    Returns {"eval_return": mean, "frames": checkpoint cursor, ...}.
+    Raises FileNotFoundError if the directory holds no checkpoint.
+    """
+    example, evaluator, k_eval = _build_eval(cfg, episodes, epsilon, seed)
+    frames, learner = _restore_latest(checkpoint_dir, example, step=step)
+    mean_return = float(evaluator(learner.params, k_eval))
     return {"eval_return": mean_return, "frames": frames,
             "episodes": episodes, "config": cfg.name}
+
+
+def _skip_row(step: int) -> dict:
+    """The one shape both --all-steps modes emit for a checkpoint that a
+    live training run's retention deleted mid-walk."""
+    return {"frames": step,
+            "skipped": "checkpoint deleted during walk (live retention)"}
+
+
+def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
+                              episodes: int = 10, seed: int = 0,
+                              epsilon: float = 0.001,
+                              log_fn=None) -> list:
+    """Evaluate EVERY retained checkpoint step (oldest first) — the
+    learning curve of a run directory. One env/net/evaluator build and
+    one compile serve all steps; one checkpoint manager restores each
+    into the same example pytree. Identical eval rng per step, so curve
+    points differ only by the restored parameters. Steps garbage-
+    collected mid-walk by a live training run's retention are skipped
+    with a log line rather than aborting the walk.
+    """
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    if not os.path.isdir(checkpoint_dir):
+        raise FileNotFoundError(
+            f"no checkpoint found under {checkpoint_dir!r}")
+    rows = []
+    ckpt = TrainCheckpointer(checkpoint_dir)
+    try:
+        steps = ckpt.all_steps()
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {checkpoint_dir!r}")
+        # Build (env, net, jitted evaluator) only once a step list
+        # exists — an empty dir errors without paying the build.
+        example, evaluator, k_eval = _build_eval(cfg, episodes, epsilon,
+                                                 seed)
+        for step in steps:
+            try:
+                frames, learner = ckpt.restore_latest(example, step=step)
+            except FileNotFoundError:
+                # Narrow scope: only the restore is guarded, so an
+                # unrelated FileNotFoundError cannot be mislabeled.
+                if log_fn:
+                    log_fn(_skip_row(step))
+                continue
+            row = {"eval_return": float(evaluator(learner.params, k_eval)),
+                   "frames": frames, "episodes": episodes,
+                   "config": cfg.name}
+            rows.append(row)
+            if log_fn:
+                log_fn(row)
+    finally:
+        ckpt.close()
+    return rows
 
 
 def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
                              host_env: str, episodes: int = 10,
                              seed: int = 0, epsilon: float = 0.001,
-                             max_steps: int = 20_000) -> dict:
+                             max_steps: int = 20_000,
+                             step: int = None) -> dict:
     """Greedy checkpoint episodes on a HOST env (real ALE / DM-Control /
     gymnasium) — the deploy-side counterpart of an Ape-X split training
     run, which steps host envs the JAX stand-ins only approximate.
@@ -114,7 +180,7 @@ def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
     rng = jax.random.PRNGKey(seed)
     rng, k_init = jax.random.split(rng)
     example = init(k_init, jax.numpy.asarray(obs[0]))
-    frames, learner = _restore_latest(checkpoint_dir, example)
+    frames, learner = _restore_latest(checkpoint_dir, example, step=step)
 
     returns, truncated, _ = run_greedy_episodes(
         env, act, learner.params, rng, episodes=episodes,
@@ -162,6 +228,11 @@ def main():
                         help="override config fields by dotted path (must "
                              "match how the checkpoint was trained, e.g. "
                              "--set network.dueling=true)")
+    parser.add_argument("--all-steps", action="store_true",
+                        help="evaluate EVERY retained checkpoint step "
+                             "(oldest first, one JSON line each) — a "
+                             "learning curve from the run directory "
+                             "instead of just the newest point")
     args = parser.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -171,17 +242,49 @@ def main():
         parser.error(str(e))
     if args.risk_cvar_eta is not None:
         cfg = _apply_risk_eta(cfg, args.risk_cvar_eta)
-    if args.host_env:
-        out = evaluate_checkpoint_host(
-            cfg, args.checkpoint_dir, args.host_env,
-            episodes=args.episodes, seed=args.seed)
+
+    def tag_and_print(out):
+        if args.risk_cvar_eta is not None:
+            out["risk_cvar_eta"] = args.risk_cvar_eta
+        print(json.dumps(out), flush=True)
+
+    def run_one(step=None):
+        if args.host_env:
+            out = evaluate_checkpoint_host(
+                cfg, args.checkpoint_dir, args.host_env,
+                episodes=args.episodes, seed=args.seed, step=step)
+        else:
+            out = evaluate_checkpoint(
+                cfg, args.checkpoint_dir,
+                episodes=args.episodes, seed=args.seed, step=step)
+        tag_and_print(out)
+
+    if args.all_steps and not args.host_env:
+        # One build + one compile + one manager serve the whole curve.
+        evaluate_checkpoint_curve(
+            cfg, args.checkpoint_dir, episodes=args.episodes,
+            seed=args.seed,
+            log_fn=tag_and_print)
+    elif args.all_steps:
+        # Host envs: per-step restores through the single-point surface
+        # (episode stepping dominates; no scan-evaluator recompile).
+        from dist_dqn_tpu.utils.checkpoint import list_checkpoint_steps
+
+        steps = list_checkpoint_steps(args.checkpoint_dir)
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {args.checkpoint_dir!r}")
+        for step in steps:
+            # Pre-flight the step instead of catching FileNotFoundError
+            # around the whole evaluation, which would mislabel
+            # unrelated errors (missing ROM/asset) as deleted
+            # checkpoints; a real error propagates loudly.
+            if step not in list_checkpoint_steps(args.checkpoint_dir):
+                tag_and_print(_skip_row(step))
+                continue
+            run_one(step)
     else:
-        out = evaluate_checkpoint(
-            cfg, args.checkpoint_dir,
-            episodes=args.episodes, seed=args.seed)
-    if args.risk_cvar_eta is not None:
-        out["risk_cvar_eta"] = args.risk_cvar_eta
-    print(json.dumps(out))
+        run_one()
 
 
 if __name__ == "__main__":

@@ -62,15 +62,21 @@ class TrainCheckpointer:
         """Block until any async save landed (call before process exit)."""
         self._mgr.wait_until_finished()
 
-    def restore_latest(self, example: PyTree
+    def all_steps(self) -> Tuple[int, ...]:
+        """Retained checkpoint steps (frame cursors), oldest first."""
+        return tuple(sorted(self._mgr.all_steps()))
+
+    def restore_latest(self, example: PyTree, step: Optional[int] = None
                        ) -> Optional[Tuple[int, PyTree]]:
-        """Restore the newest checkpoint as (frames, learner), or None.
+        """Restore the newest checkpoint (or a specific retained ``step``
+        from ``all_steps()``) as (frames, learner), or None.
 
         ``example`` is a live learner pytree of the target structure; its
         shapes/dtypes/shardings template the restore, so restoring onto a
         different mesh layout re-shards on load.
         """
-        step = self._mgr.latest_step()
+        if step is None:
+            step = self._mgr.latest_step()
         if step is None:
             return None
         abstract = jax.tree.map(
@@ -103,6 +109,23 @@ class TrainCheckpointer:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+def list_checkpoint_steps(directory: str) -> Tuple[int, ...]:
+    """Retained checkpoint steps under ``directory``, oldest first,
+    without keeping a manager open. Read-only surface: a missing
+    directory raises instead of being created (the manager itself
+    mkdirs, so guard before constructing it)."""
+    import os
+
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(
+            f"no checkpoint found under {directory!r}")
+    ckpt = TrainCheckpointer(directory)
+    try:
+        return ckpt.all_steps()
+    finally:
+        ckpt.close()
 
 
 def save_pytree(path: str, tree: PyTree) -> None:

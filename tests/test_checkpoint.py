@@ -124,6 +124,48 @@ def test_standalone_evaluate_checkpoint(tmp_path):
     assert 1.0 <= out["eval_return"] <= 500.0
 
 
+def test_evaluate_all_steps_walks_the_learning_curve(tmp_path, capsys):
+    """`evaluate --all-steps` restores EVERY retained checkpoint (oldest
+    first) and prints one JSON line each — a learning curve from the run
+    directory."""
+    import json
+    import sys
+    from unittest import mock
+
+    from dist_dqn_tpu.evaluate import main
+    from dist_dqn_tpu.train import train
+    from dist_dqn_tpu.utils.checkpoint import list_checkpoint_steps
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(32,)),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        eval_every_steps=10**9,
+    )
+    ckpt_dir = str(tmp_path / "run")
+    # Two chunks x 300 frames with a 300-frame save period -> multiple
+    # retained steps.
+    train(cfg, total_env_steps=600, chunk_iters=75, log_fn=lambda s: None,
+          checkpoint_dir=ckpt_dir, save_every_frames=300)
+    steps = list_checkpoint_steps(ckpt_dir)
+    assert len(steps) >= 2 and list(steps) == sorted(steps)
+
+    argv = ["evaluate", "--config", "cartpole", "--platform", "cpu",
+            "--checkpoint-dir", ckpt_dir, "--episodes", "1",
+            "--all-steps",
+            "--set", "network.mlp_features=32",
+            "--set", "actor.num_envs=4"]
+    with mock.patch.object(sys, "argv", argv):
+        main()
+    rows = [json.loads(line) for line in
+            capsys.readouterr().out.splitlines() if line.startswith("{")]
+    assert [r["frames"] for r in rows] == list(steps)
+    assert all(1.0 <= r["eval_return"] <= 500.0 for r in rows)
+
+
 def test_architecture_mismatch_error_names_the_cause(tmp_path):
     """Restoring a checkpoint onto a DIFFERENT architecture (e.g. the
     user forgot a --set flag at evaluate time) must say so up front
