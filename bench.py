@@ -77,16 +77,40 @@ def _env_int(name: str, default: int) -> int:
 
 
 def _watchdog(stage: str, seconds: float) -> threading.Timer:
-    """Arm a timer that emits an error line and hard-exits; caller cancels.
+    """Arm a timer that emits an error line and exits; caller cancels.
 
-    A hard ``os._exit`` is deliberate: a wedged tunnel blocks the main
-    thread inside an uninterruptible C call, so no exception-based unwind
-    can run — getting the JSON line out is all that matters.
+    The exit path (incident #3, VERDICT round 3): a raw ``os._exit``
+    here is exactly as mid-device-op as a SIGKILL — it fires precisely
+    when a device op is stuck, and on this box's axon tunnel that
+    orphans the pool-side grant and wedges the tunnel for hours. So the
+    watchdog now (1) emits the JSON contract line, (2) attempts a
+    BOUNDED device release (``utils/device_cleanup.release`` on a
+    daemon thread — on a truly wedged tunnel the release itself hangs,
+    so it gets ``BENCH_CLEANUP_TIMEOUT_S`` seconds, default 60, not
+    forever), then (3) hard-exits. A live-but-slow run gets its grant
+    released; a genuinely wedged one is no worse off than before. The
+    real protection remains the pre-flight sizing gate in ``main`` —
+    never starting a run that could hit this timer.
     """
 
     def fire():
         _emit_error(stage, f"no progress within {seconds:.0f}s "
                            "(wedged TPU tunnel?)")
+        sys.stdout.flush()
+        try:
+            from dist_dqn_tpu.utils.device_cleanup import release
+
+            done = threading.Event()
+
+            def _clean():
+                release()
+                done.set()
+
+            cleaner = threading.Thread(target=_clean, daemon=True)
+            cleaner.start()
+            done.wait(_env_float("BENCH_CLEANUP_TIMEOUT_S", 60.0))
+        except Exception:  # noqa: BLE001 — exit anyway
+            pass
         sys.stdout.flush()
         os._exit(3)
 
@@ -94,6 +118,24 @@ def _watchdog(stage: str, seconds: float) -> threading.Timer:
     t.daemon = True
     t.start()
     return t
+
+
+def _sizes(smoke: bool) -> dict:
+    """The run-shaping knobs, readable before any device work (env
+    overrides are how benchmarks/bench_sweep.py explores variants).
+    train_every defaults to the atari preset's value so the benchmark
+    cannot silently diverge from the config it claims to measure."""
+    from dist_dqn_tpu.config import CONFIGS
+
+    return {
+        "num_envs": _env_int("BENCH_NUM_ENVS", 8 if smoke else 1024),
+        "chunk": _env_int("BENCH_CHUNK", 20 if smoke else 200),
+        "measure_chunks": _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25),
+        "ring": _env_int("BENCH_RING", 2_048 if smoke else 65_536),
+        "batch": _env_int("BENCH_BATCH", 32 if smoke else 512),
+        "train_every": _env_int("BENCH_TRAIN_EVERY",
+                                CONFIGS["atari"].train_every),
+    }
 
 
 def main() -> int:
@@ -115,8 +157,28 @@ def main() -> int:
     finally:
         guard.cancel()
 
-    guard = _watchdog("measurement", _env_float("BENCH_TOTAL_TIMEOUT_S",
-                                                900.0))
+    total_budget = _env_float("BENCH_TOTAL_TIMEOUT_S", 900.0)
+    if device.platform != "cpu":
+        # Pre-flight sizing gate (VERDICT round-3 ask #1b): refuse any
+        # config not predicted to finish comfortably inside the watchdog
+        # budget, BEFORE touching the device — a run that hits the
+        # watchdog dies mid-device-op and wedges the tunnel (incident
+        # #3). CPU smoke runs are exempt (no tunnel to wedge).
+        from dist_dqn_tpu.utils.sizing import gate_fused
+
+        s = _sizes(smoke)
+        verdict = gate_fused(
+            budget_s=total_budget, num_envs=s["num_envs"],
+            batch_size=s["batch"], train_every=s["train_every"],
+            chunk_iters=s["chunk"], num_chunks=2 + s["measure_chunks"],
+            ring=s["ring"])
+        if not verdict.ok:
+            _emit({"metric": METRIC, "value": None, "unit": UNIT,
+                   "vs_baseline": None, **verdict.as_fields(),
+                   "error": f"sizing-gate: {verdict.reason}"})
+            return 4
+
+    guard = _watchdog("measurement", total_budget)
     try:
         from dist_dqn_tpu.utils.device_cleanup import install
 
@@ -188,11 +250,12 @@ def _measure(jax, device, smoke: bool):
     # env-steps/sec/chip vs 510-525k for the round-1 512x256 default, so
     # 1024x512 is the default; 2048x1024 exceeded the 450s watchdog
     # (docs/tpu_runs/20260731_0316_sweep/).
-    num_envs = _env_int("BENCH_NUM_ENVS", 8 if smoke else 1024)
-    chunk = _env_int("BENCH_CHUNK", 20 if smoke else 200)
+    s = _sizes(smoke)
+    num_envs = s["num_envs"]
+    chunk = s["chunk"]
     # ~25 chunks x 200 iters x 1024 envs ~= 5M env steps: several seconds
     # of measured work, long enough to average out dispatch/clock jitter.
-    measure_chunks = _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25)
+    measure_chunks = s["measure_chunks"]
 
     cfg = CONFIGS["atari"]
     cfg = dataclasses.replace(
@@ -203,12 +266,12 @@ def _measure(jax, device, smoke: bool):
         # (a 131k ring was measurably slower on a 16 GB v5e).
         replay=dataclasses.replace(
             cfg.replay,
-            capacity=_env_int("BENCH_RING", 2_048 if smoke else 65_536),
+            capacity=s["ring"],
             min_fill=128 if smoke else 4_096),
         learner=dataclasses.replace(
             cfg.learner,
-            batch_size=_env_int("BENCH_BATCH", 32 if smoke else 512)),
-        train_every=_env_int("BENCH_TRAIN_EVERY", cfg.train_every),
+            batch_size=s["batch"]),
+        train_every=s["train_every"],
     )
     env = make_jax_env(cfg.env_name)
     net = build_network(cfg.network, env.num_actions)

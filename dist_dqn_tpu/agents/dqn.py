@@ -80,6 +80,11 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
             "munchausen requires n_step=1: replay folds n-step rewards "
             "at sample time, so the per-step log-policy bonuses the "
             "soft recursion needs cannot be applied for n_step > 1")
+    if cfg.munchausen and cfg.double_dqn:
+        raise ValueError(
+            "munchausen replaces the max/double-Q bootstrap with the "
+            "tau-logsumexp soft bootstrap, so double_dqn has no effect; "
+            "set double_dqn=False (the mdqn preset does)")
 
     def init(rng: Array, obs_example: Array) -> LearnerState:
         rng, k_param, k_noise = jax.random.split(rng, 3)
@@ -143,11 +148,22 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
             # IQN: quantile-Huber regression at SAMPLED fractions — N
             # online draws conditioned into the net, N' independent
             # target draws as Bellman samples (Dabney et al., 2018b).
+            # Tau keys fold in each example's GLOBAL batch position so
+            # the draws are identical whether the batch is whole on one
+            # device or row-sharded over the dp mesh — that makes the
+            # sharded IQN step bit-equal to single-device, like the
+            # deterministic heads (VERDICT round-3 ask #8).
+            local_b = batch.obs.shape[0]
+            ids = jnp.arange(local_b, dtype=jnp.uint32)
+            if axis_name is not None:
+                ids = ids + (jax.lax.axis_index(axis_name)
+                             .astype(jnp.uint32) * local_b)
             theta, taus = net.apply(
-                params, batch.obs, net.num_tau,
+                params, batch.obs, net.num_tau, example_ids=ids,
                 method=net.sample_quantiles, rngs={"tau": k_online})
             theta_next_target, _ = net.apply(
                 target_params, batch.next_obs, net.num_tau_target,
+                example_ids=ids,
                 method=net.sample_quantiles, rngs={"tau": k_target})
             if cfg.double_dqn:
                 # Greedy selection by the online net's deterministic

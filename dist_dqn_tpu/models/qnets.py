@@ -287,10 +287,26 @@ class ImplicitQuantileNetwork(nn.Module):
         return jnp.transpose(q, (0, 2, 1))                     # [B, A, K]
 
     def sample_quantiles(self, obs: Array, num: int,
-                         *, add_noise: bool = False):
-        """([B, A, num] values, [B, num] taus) at fresh U(0, 1) draws."""
-        taus = jax.random.uniform(self.make_rng("tau"),
-                                  (obs.shape[0], num))
+                         *, example_ids: Array = None,
+                         add_noise: bool = False):
+        """([B, A, num] values, [B, num] taus) at fresh U(0, 1) draws.
+
+        Each example's taus come from its OWN key — the draw key with
+        the example's batch position folded in — so the draw is
+        shard-invariant: example i gets identical taus whether the
+        batch is whole on one device or row-sharded over a mesh, as
+        long as the caller passes GLOBAL positions via ``example_ids``
+        (the sharded learner offsets by ``axis_index * local_B``;
+        default: local arange, which IS the global position in the
+        unsharded case). This is what lets the IQN learner join the
+        sharded-vs-single-device bit-equality tests (VERDICT round-3
+        ask #8)."""
+        key = self.make_rng("tau")
+        if example_ids is None:
+            example_ids = jnp.arange(obs.shape[0], dtype=jnp.uint32)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            example_ids.astype(jnp.uint32))
+        taus = jax.vmap(lambda k: jax.random.uniform(k, (num,)))(keys)
         return self(obs, add_noise=add_noise, taus=taus), taus
 
     def q_values(self, obs: Array, *, add_noise: bool = False) -> Array:

@@ -226,6 +226,12 @@ def main():
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
+    parser.add_argument("--wall-budget-s", type=float, default=None,
+                        help="device runs: refuse to start unless the "
+                             "predicted wall time fits comfortably inside "
+                             "this kill budget (set it to the external "
+                             "`timeout` you wrap the run in; a run killed "
+                             "mid-device-op wedges the shared TPU tunnel)")
     parser.add_argument("--mesh-devices", type=int, default=1,
                         help="fused runtime: run over a dp mesh of this "
                              "many devices (0 = all; multi-process runs "
@@ -336,6 +342,46 @@ def main():
         target = args.stop_at_return
         stop_fn = lambda row: row.get("eval_return",  # noqa: E731
                                       -float("inf")) >= target
+    if jax.default_backend() != "cpu":
+        # Pre-flight sizing gate for device runs (VERDICT round-3 ask
+        # #1b): incident #2 was exactly this CLI started with a frame
+        # budget that could not finish inside its external `timeout`,
+        # SIGTERM'd mid-device-op, wedging the tunnel. Predict the wall
+        # time up front; with --wall-budget-s given, REFUSE to start a
+        # run not predicted to fit comfortably inside it. Without the
+        # flag the prediction is still printed so the operator can size
+        # the external timeout.
+        import math
+
+        from dist_dqn_tpu.utils.sizing import gate_fused
+
+        menv = make_jax_env(cfg.env_name)
+        total = args.total_env_steps or cfg.total_env_steps
+        lanes = cfg.actor.num_envs
+        n_chunks = max(1, math.ceil(total / (args.chunk_iters * lanes)))
+        n_evals = (math.ceil(total / cfg.eval_every_steps)
+                   if cfg.eval_every_steps else 0)
+        verdict = gate_fused(
+            budget_s=args.wall_budget_s or float("inf"),
+            num_envs=lanes, batch_size=cfg.learner.batch_size,
+            train_every=cfg.train_every, chunk_iters=args.chunk_iters,
+            num_chunks=n_chunks, ring=cfg.replay.capacity,
+            num_evals=n_evals, eval_iters=3_000 * cfg.eval_episodes,
+            pixel_obs=len(menv.observation_shape) == 3,
+            num_actions=menv.num_actions)
+        print(json.dumps({"sizing_predicted_s": round(verdict.predicted_s, 1),
+                          "wall_budget_s": args.wall_budget_s}))
+        if not verdict.ok:
+            if args.wall_budget_s is None:
+                # No kill budget -> nothing will SIGTERM this run
+                # mid-device-op, so nothing to refuse: the wedge
+                # scenario needs a kill. Surface the concern and run.
+                print(json.dumps({"sizing_gate": "warning",
+                                  "reason": verdict.reason}))
+            else:
+                print(json.dumps({"sizing_gate": "refused",
+                                  "reason": verdict.reason}))
+                raise SystemExit(4)
     train(cfg, total_env_steps=args.total_env_steps, seed=args.seed,
           chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
           save_every_frames=args.save_every_frames,

@@ -75,6 +75,11 @@ class TrainCheckpointer:
         shapes/dtypes/shardings template the restore, so restoring onto a
         different mesh layout re-shards on load.
         """
+        # The save schedule advances only on the latest-resume path: an
+        # explicitly requested OLD step (the eval surfaces walk
+        # all_steps()) must not regress _next_save and re-save over
+        # newer retained steps (ADVICE round 3).
+        advance_schedule = step is None
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
@@ -103,7 +108,8 @@ class TrainCheckpointer:
                 "optimizer architecture. Rebuild with the same --config "
                 "and --set overrides used at save time.\n\nOriginal "
                 f"error:\n{e}") from e
-        self._next_save = step + self.save_every_frames
+        if advance_schedule:
+            self._next_save = step + self.save_every_frames
         return int(step), restored
 
     def close(self) -> None:

@@ -71,6 +71,18 @@ def _release_devices(log_fn=None) -> None:
         pass
 
 
+def release(log_fn=None) -> None:
+    """Public best-effort device release for watchdog/timeout paths.
+
+    A measurement watchdog that must abandon a stuck run should call this
+    (bounded by its own timer — on a truly wedged tunnel the release
+    itself can hang) BEFORE its hard exit, so a live-but-slow run gets
+    its grant released instead of orphaned (incident #3: the raw
+    ``os._exit`` of a watchdog is exactly as mid-device-op as a SIGTERM).
+    """
+    _release_devices(log_fn)
+
+
 def install(log_fn=None) -> None:
     """Idempotently register the SIGTERM handler + atexit release hook.
 

@@ -1,0 +1,175 @@
+"""Pre-flight sizing gate for on-device runs (VERDICT round-3 ask #1b).
+
+All three tunnel-wedging incidents on this box (2026-07-30/31, recorded
+in ``.claude/skills/verify/SKILL.md``) share one root cause: a device
+job was started whose wall time exceeded the budget that would
+eventually kill it — an external ``timeout`` SIGTERM (incidents #1, #2)
+or the bench's own internal watchdog (incident #3) — and the kill landed
+mid-device-op, wedging the pool-side tunnel grant for hours. A cleanup
+handler cannot save a process that is *blocked inside* a device RPC, so
+the only real protection is refusing to START jobs that could need
+killing. This module predicts the wall time of a run from analytic
+FLOPs (``utils/flops.py``), the measured per-env-step bandwidth cost,
+and the measured ~65-70 ms tunnel dispatch constant, then refuses
+configs whose prediction approaches the caller's kill budget — plus two
+hard envelope rules distilled from the incidents:
+
+* sizes **proven oversized** by a measured failure are refused outright
+  (2048 lanes x batch 1024 timed out the 450 s watchdog on v5e and
+  wedged the tunnel — incident #3);
+* sizes **more than 2x any proven-safe size** are refused as unproven
+  (the incident-#3 rule: 1024 lanes succeeded, 2048 killed the window).
+
+Both refusals honor an explicit override (``BENCH_ALLOW_UNPROVEN=1``)
+so a deliberately-risked probe is still possible — LAST in a window,
+never while a driver capture is owed.
+
+Calibration anchors (measured, ``docs/tpu_runs/`` 2026-07-31, v5e):
+
+* fused-loop per-iteration wall: 1.00 ms @ 512 lanes, 1.80 ms @ 1024
+  lanes (510k / 569k env-steps/s => ~1.8 us/env-step); the gate charges
+  a conservative 3 us/env-step.
+* tunnel dispatch constant: 62-70 ms/call (recovered ``dispatch_s`` in
+  ``sampler_bench_marginal.jsonl``); the gate charges 80 ms/dispatch.
+* compile: the fused program builds in ~60-90 s on this box; the gate
+  budgets 150 s for bench.py's two compiles (fused chunk + the
+  standalone MFU-census step).
+* learner achieved compute: the lowest measured learner MFU is 1.6 %
+  of the 197 TFLOP/s bf16 peak (qrdqn); the gate assumes 3 TFLOP/s
+  achieved so FLOPs-heavy configs are charged honestly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from dist_dqn_tpu.utils import flops as flops_util
+
+# Measured anchors (module docstring) with conservative safety margins.
+DISPATCH_S = 0.080          # per host->device call round-trip (measured 62-70 ms)
+ENV_STEP_S = 3e-6           # per env-step wall cost in the fused loop (measured ~1.8 us)
+ACHIEVED_FLOPS = 3e12       # learner FLOP/s actually achieved (lowest measured: 3.2e12)
+COMPILE_BUDGET_S = 150.0    # fused chunk + census step, first build
+BUDGET_FRACTION = 0.6       # predicted time must fit in this fraction of the kill budget
+
+# Envelope rules (v5e, incident #3). "Proven safe" = the largest sizes
+# that completed a measured run on this box's chip; update when a larger
+# size completes cleanly.
+PROVEN_SAFE = {"num_envs": 1024, "batch_size": 512, "ring": 131_072}
+# Measured failures: configs at or beyond these sizes died mid-window.
+KNOWN_BAD = {"num_envs": 2048}
+
+OVERRIDE_ENV = "BENCH_ALLOW_UNPROVEN"
+
+
+@dataclasses.dataclass(frozen=True)
+class SizingVerdict:
+    ok: bool
+    predicted_s: float
+    budget_s: float
+    reason: str
+
+    def as_fields(self) -> dict:
+        return {"sizing_predicted_s": round(self.predicted_s, 1),
+                "sizing_budget_s": round(self.budget_s, 1)}
+
+
+def _override_active() -> bool:
+    return os.environ.get(OVERRIDE_ENV) == "1"
+
+
+def grad_step_flops_estimate(batch_size: int, num_actions: int = 6,
+                             pixel_obs: bool = True) -> float:
+    """Analytic FLOPs of one grad step, for sizing only (pre-compile, so
+    no XLA census is available). fwd+bwd ~ 3x forward, plus the target
+    forward = 4x; non-pixel nets are MLPs too small to matter."""
+    if not pixel_obs:
+        return 0.0
+    return 4.0 * flops_util.nature_cnn_fwd_flops(batch_size,
+                                                 num_actions=num_actions)
+
+
+def predict_fused_seconds(*, num_envs: int, batch_size: int,
+                          train_every: int, chunk_iters: int,
+                          num_chunks: int, num_evals: int = 0,
+                          eval_iters: int = 0, pixel_obs: bool = True,
+                          num_actions: int = 6,
+                          compile_s: float = COMPILE_BUDGET_S) -> float:
+    """Conservative wall-time prediction for a fused-loop device run.
+
+    Terms: compile budget + per-chunk dispatch + env-step bandwidth cost
+    + learner FLOPs at the conservative achieved rate + eval episodes
+    (each eval is one dispatch plus its own env-step cost).
+    """
+    env_steps = float(num_chunks) * chunk_iters * num_envs
+    grad_steps = float(num_chunks) * chunk_iters / max(train_every, 1)
+    flops = grad_steps * grad_step_flops_estimate(batch_size, num_actions,
+                                                  pixel_obs)
+    eval_s = num_evals * (DISPATCH_S + eval_iters * ENV_STEP_S)
+    return (compile_s
+            + num_chunks * DISPATCH_S
+            + env_steps * ENV_STEP_S
+            + flops / ACHIEVED_FLOPS
+            + eval_s)
+
+
+def check_envelope(*, num_envs: int, batch_size: int,
+                   ring: Optional[int] = None,
+                   pixel_obs: bool = True) -> Optional[str]:
+    """Hard size rules from measured incidents; None when inside the
+    envelope, else the refusal reason. Override: BENCH_ALLOW_UNPROVEN=1.
+
+    The envelope is calibrated on the pixel (84x84x4) configs where all
+    three incidents happened; vector-obs runs are orders of magnitude
+    smaller per lane/slot and rely on the time model alone."""
+    if _override_active() or not pixel_obs:
+        return None
+    if num_envs >= KNOWN_BAD["num_envs"]:
+        return (f"num_envs={num_envs} is PROVEN OVERSIZED on this chip "
+                f"(>= {KNOWN_BAD['num_envs']} timed out the watchdog and "
+                f"wedged the tunnel, incident #3); set {OVERRIDE_ENV}=1 "
+                "to deliberately risk it (last in a window, never while "
+                "a driver capture is owed)")
+    sized = {"num_envs": num_envs, "batch_size": batch_size}
+    if ring is not None:
+        sized["ring"] = ring
+    for key, value in sized.items():
+        if value > 2 * PROVEN_SAFE[key]:
+            return (f"{key}={value} is more than 2x the proven-safe "
+                    f"{PROVEN_SAFE[key]} (incident-#3 rule: unproven "
+                    f"sizes wedge windows); set {OVERRIDE_ENV}=1 to "
+                    "deliberately risk it")
+    return None
+
+
+def gate_fused(*, budget_s: float, num_envs: int, batch_size: int,
+               train_every: int, chunk_iters: int, num_chunks: int,
+               ring: Optional[int] = None, num_evals: int = 0,
+               eval_iters: int = 0, pixel_obs: bool = True,
+               num_actions: int = 6,
+               compile_s: float = COMPILE_BUDGET_S) -> SizingVerdict:
+    """Combined envelope + time-prediction gate for a fused device run.
+
+    ``budget_s`` is whatever will kill the process (internal watchdog,
+    external ``timeout``); the run must be predicted to finish in
+    ``BUDGET_FRACTION`` of it or it is refused before any device work.
+    """
+    predicted = predict_fused_seconds(
+        num_envs=num_envs, batch_size=batch_size, train_every=train_every,
+        chunk_iters=chunk_iters, num_chunks=num_chunks, num_evals=num_evals,
+        eval_iters=eval_iters, pixel_obs=pixel_obs, num_actions=num_actions,
+        compile_s=compile_s)
+    envelope = check_envelope(num_envs=num_envs, batch_size=batch_size,
+                              ring=ring, pixel_obs=pixel_obs)
+    if envelope is not None:
+        return SizingVerdict(False, predicted, budget_s, envelope)
+    limit = BUDGET_FRACTION * budget_s
+    if predicted > limit:
+        return SizingVerdict(
+            False, predicted, budget_s,
+            f"predicted {predicted:.0f}s exceeds {BUDGET_FRACTION:.0%} of "
+            f"the {budget_s:.0f}s kill budget — shrink the run or raise "
+            "the budget; starting a job that will be killed mid-device-op "
+            "is how the tunnel wedges (incidents #1-#3)")
+    return SizingVerdict(True, predicted, budget_s, "ok")

@@ -119,3 +119,25 @@ def test_cli_list_mode():
     assert out.returncode == 0, out.stderr[-500:]
     payload = json.loads(out.stdout.strip().splitlines()[-1])
     assert payload["count"] == 57 and "Pong" in payload["games"]
+
+
+def test_shipped_reference_table_covers_all_57_games():
+    """The Wang et al. 2016 table ships as the default HNS reference
+    (VERDICT round-3 ask #6): exactly the canonical 57 games, human >
+    random everywhere (HNS must be a positive-direction scale), and the
+    two EXAMPLE_SCORES seed games agree with the shipped table."""
+    from dist_dqn_tpu.atari57_refs import HUMAN_RANDOM_SCORES
+
+    assert set(HUMAN_RANDOM_SCORES) == set(ATARI_57)
+    assert len(HUMAN_RANDOM_SCORES) == 57
+    for game, ref in HUMAN_RANDOM_SCORES.items():
+        assert ref["human"] > ref["random"], game
+    for game, ref in EXAMPLE_SCORES.items():
+        assert HUMAN_RANDOM_SCORES[game] == ref, game
+    # The benchmark's standard sanity anchors: a policy scoring exactly
+    # the human table point has HNS 100 on every game.
+    at_human = {g: r["human"] for g, r in HUMAN_RANDOM_SCORES.items()}
+    out = normalized_scores(at_human, HUMAN_RANDOM_SCORES)
+    assert out["games"] == 57
+    assert out["median_hns"] == pytest.approx(100.0)
+    assert out["mean_hns"] == pytest.approx(100.0)

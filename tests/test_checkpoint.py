@@ -407,3 +407,68 @@ def test_standalone_evaluate_checkpoint_recurrent(tmp_path):
     out = evaluate_checkpoint(cfg, ckpt_dir, episodes=3, seed=2)
     assert out["frames"] >= 2000 and out["config"] == "r2d2"
     assert 1.0 <= out["eval_return"] <= 500.0
+
+
+def test_explicit_step_restore_keeps_save_schedule(tmp_path):
+    """restore_latest(step=OLD) is an eval-surface read; it must not
+    regress the save schedule and re-save over newer retained steps
+    (ADVICE round 3)."""
+    state = _learner_state(seed=0)
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"), save_every_frames=100)
+    ckpt.save(100, state)
+    ckpt.save(200, state)
+    ckpt.wait()
+    # Latest-resume path DOES advance the schedule past the cursor.
+    frames, _ = ckpt.restore_latest(state)
+    assert frames == 200 and ckpt._next_save == 300
+    # Explicit-step restore of an OLD step leaves it untouched...
+    frames, _ = ckpt.restore_latest(state, step=100)
+    assert frames == 100 and ckpt._next_save == 300
+    # ...so a subsequent cursor inside the already-covered window does
+    # not overwrite newer retained steps.
+    assert not ckpt.maybe_save(250, state)
+    assert ckpt.all_steps() == (100, 200)
+    ckpt.close()
+
+
+def test_host_all_steps_skips_only_missing_checkpoints(tmp_path, capsys):
+    """The host --all-steps walk skips a step whose checkpoint vanished
+    mid-walk (live retention) via the DISTINCT CheckpointMissingError —
+    an unrelated FileNotFoundError from the evaluation (missing ROM)
+    still propagates loudly (ADVICE round 3)."""
+    import sys
+    from unittest import mock
+
+    from dist_dqn_tpu import evaluate as ev
+
+    state = _learner_state(seed=0)
+    ckpt = TrainCheckpointer(str(tmp_path / "run"), save_every_frames=100)
+    ckpt.save(100, state)
+    ckpt.save(200, state)
+    ckpt.wait()
+    ckpt.close()
+
+    def fake_host_eval(cfg, ckpt_dir, host_env, episodes, seed, step):
+        if step == 100:
+            raise ev.CheckpointMissingError("step 100 vanished")
+        return {"eval_return": 1.0, "frames": step, "episodes": episodes,
+                "config": cfg.name, "host_env": host_env,
+                "episodes_truncated": 0}
+
+    argv = ["evaluate", "--config", "cartpole", "--platform", "cpu",
+            "--checkpoint-dir", str(tmp_path / "run"), "--episodes", "1",
+            "--all-steps", "--host-env", "CartPole-v1"]
+    with mock.patch.object(sys, "argv", argv), \
+            mock.patch.object(ev, "evaluate_checkpoint_host",
+                              side_effect=fake_host_eval):
+        ev.main()
+    rows = [json.loads(line) for line in
+            capsys.readouterr().out.splitlines() if line.startswith("{")]
+    assert rows[0]["frames"] == 100 and "skipped" in rows[0]
+    assert rows[1]["frames"] == 200 and rows[1]["eval_return"] == 1.0
+
+    with mock.patch.object(sys, "argv", argv), \
+            mock.patch.object(ev, "evaluate_checkpoint_host",
+                              side_effect=FileNotFoundError("no ROM")), \
+            pytest.raises(FileNotFoundError, match="no ROM"):
+        ev.main()

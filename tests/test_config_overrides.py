@@ -27,6 +27,22 @@ def test_typed_coercion_across_field_kinds():
     assert CONFIGS["atari"].network.dueling is False
 
 
+def test_int_fields_accept_unambiguous_shorthand():
+    """1e6 / 2.5e5 / 200_000 spellings have exactly one integer meaning;
+    the coercion takes them. Non-integral floats stay errors (ADVICE
+    round 3)."""
+    cfg = apply_overrides(CONFIGS["atari"], [
+        "replay.capacity=1e6",
+        "replay.min_fill=2.5e4",
+        "total_env_steps=200_000",
+    ])
+    assert cfg.replay.capacity == 1_000_000
+    assert cfg.replay.min_fill == 25_000
+    assert cfg.total_env_steps == 200_000
+    with pytest.raises(ValueError, match="batch_size: expected an int"):
+        apply_overrides(CONFIGS["atari"], ["learner.batch_size=1.5"])
+
+
 def test_optional_field_accepts_none_and_bool():
     cfg = apply_overrides(CONFIGS["atari"],
                           ["replay.store_final_obs=true"])

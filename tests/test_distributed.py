@@ -40,21 +40,30 @@ def _fixed_batch(key, batch, obs_dim=6, num_actions=3):
     )
 
 
-@pytest.mark.parametrize("head", ["dqn", "c51", "qrdqn", "mdqn"])
+@pytest.mark.parametrize("head", ["dqn", "c51", "qrdqn", "mdqn", "iqn"])
 def test_sharded_train_step_matches_single_device(mesh, head):
     """8 learners on batch shards + pmean == 1 learner on the full batch,
-    for every deterministic head family. IQN is excluded: its loss draws
-    taus with shape [B_shard, N], so the sharded step sees different
-    fractions per example than the full-batch step and bit-equivalence
-    is impossible by construction — it gets the mesh-runs test below."""
-    net_kw = dict(num_actions=3, torso="mlp", mlp_features=(32, 16),
-                  hidden=0)
-    if head == "c51":
-        net_kw.update(num_atoms=11, v_min=-5.0, v_max=5.0)
-    elif head == "qrdqn":
-        net_kw.update(num_atoms=8, quantile=True)
-    net = QNetwork(**net_kw)
-    cfg = LearnerConfig(learning_rate=1e-2, munchausen=(head == "mdqn"))
+    for every head family — INCLUDING IQN, whose tau draws are made
+    shard-invariant by folding each example's global batch position into
+    the draw key (models/qnets.py sample_quantiles; VERDICT round-3 ask
+    #8), so the sharded step sees the exact fractions the full-batch
+    step does."""
+    if head == "iqn":
+        from dist_dqn_tpu.models.qnets import ImplicitQuantileNetwork
+
+        net = ImplicitQuantileNetwork(
+            num_actions=3, torso="mlp", mlp_features=(32, 16), hidden=0,
+            embed_dim=8, num_tau=4, num_tau_target=4, num_tau_act=4)
+    else:
+        net_kw = dict(num_actions=3, torso="mlp", mlp_features=(32, 16),
+                      hidden=0)
+        if head == "c51":
+            net_kw.update(num_atoms=11, v_min=-5.0, v_max=5.0)
+        elif head == "qrdqn":
+            net_kw.update(num_atoms=8, quantile=True)
+        net = QNetwork(**net_kw)
+    cfg = LearnerConfig(learning_rate=1e-2, munchausen=(head == "mdqn"),
+                        double_dqn=(head != "mdqn"))
     init_s, step_s = make_learner(net, cfg)
     _, step_d = make_learner(net, cfg, axis_name="dp")
 

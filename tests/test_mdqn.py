@@ -77,6 +77,10 @@ def test_munchausen_rejects_incompatible_configs():
     # Folded n-step rewards can't carry the per-step log-policy bonuses.
     with pytest.raises(ValueError):
         make_learner(scalar, dataclasses.replace(lcfg, n_step=3))
+    # The soft bootstrap has no argmax to decouple: double_dqn must be
+    # rejected loudly, not silently dropped (ADVICE round 3).
+    with pytest.raises(ValueError):
+        make_learner(scalar, dataclasses.replace(lcfg, double_dqn=True))
     # The recurrent learner must reject the flag loudly, not drop it.
     from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner
 

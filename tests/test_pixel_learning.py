@@ -95,7 +95,7 @@ def test_distributional_heads_learn_on_pixels(head):
         # (calibrated on this box: clears +0.5 at ~120k frames).
         cfg = dataclasses.replace(
             cfg, learner=dataclasses.replace(cfg.learner, munchausen=True,
-                                             n_step=1),
+                                             double_dqn=False, n_step=1),
             train_every=1)
     total = 144_000 if head == "mdqn" else 96_000
     _train_and_assert_clear_margin(dataclasses.replace(cfg, network=net),

@@ -1,0 +1,95 @@
+"""Pre-flight sizing gate (utils/sizing.py) — VERDICT round-3 ask #1b.
+
+The gate exists so no device job is ever started that would be killed
+mid-device-op by its own watchdog or an external ``timeout`` (the root
+cause of all three tunnel-wedge incidents). These tests pin (a) the
+default bench/sweep configs PASS their real budgets, (b) the measured
+incident-#3 config (2048 lanes) is REFUSED, (c) the unproven->refused
+and override rules, (d) the time model refuses an over-budget run.
+"""
+from __future__ import annotations
+
+import pytest
+
+from dist_dqn_tpu.utils import sizing
+
+
+def test_default_bench_config_passes_default_budget():
+    # bench.py defaults: 1024 lanes x batch 512, 27 chunks x 200 iters,
+    # 900 s watchdog. This exact run measured ~569k steps/s in ~3 min on
+    # v5e — the gate must not refuse the headline config.
+    v = sizing.gate_fused(budget_s=900.0, num_envs=1024, batch_size=512,
+                          train_every=4, chunk_iters=200, num_chunks=27,
+                          ring=65_536)
+    assert v.ok, v.reason
+    assert v.predicted_s < 0.6 * 900.0
+
+
+def test_sweep_variant_passes_sweep_budget():
+    # bench_sweep.py: BENCH_MEASURE_CHUNKS=10 (12 with warmup) under a
+    # 450 s watchdog; the 1024x512 and 1536x768 variants must pass.
+    for lanes, batch in ((1024, 512), (1536, 768)):
+        v = sizing.gate_fused(budget_s=450.0, num_envs=lanes,
+                              batch_size=batch, train_every=4,
+                              chunk_iters=200, num_chunks=12, ring=65_536)
+        assert v.ok, (lanes, batch, v.reason)
+
+
+def test_incident_3_config_refused():
+    # 2048 lanes x batch 1024 timed out the 450 s watchdog on v5e and
+    # wedged the tunnel (incident #3). The gate must refuse it outright.
+    v = sizing.gate_fused(budget_s=450.0, num_envs=2048, batch_size=1024,
+                          train_every=4, chunk_iters=200, num_chunks=12,
+                          ring=65_536)
+    assert not v.ok
+    assert "PROVEN OVERSIZED" in v.reason
+
+
+@pytest.mark.parametrize("kwargs,fragment", [
+    (dict(num_envs=1024, batch_size=1100, ring=65_536), "batch_size"),
+    (dict(num_envs=1024, batch_size=512, ring=300_000), "ring"),
+])
+def test_unproven_sizes_refused(kwargs, fragment):
+    v = sizing.gate_fused(budget_s=10_000.0, train_every=4,
+                          chunk_iters=200, num_chunks=12, **kwargs)
+    assert not v.ok
+    assert fragment in v.reason and "2x" in v.reason
+
+
+def test_override_env_admits_unproven(monkeypatch):
+    monkeypatch.setenv(sizing.OVERRIDE_ENV, "1")
+    v = sizing.gate_fused(budget_s=10_000.0, num_envs=2048,
+                          batch_size=1024, train_every=4, chunk_iters=200,
+                          num_chunks=12, ring=65_536)
+    assert v.ok, v.reason
+
+
+def test_vector_obs_skips_pixel_envelope():
+    # CartPole-class runs: tiny slots/lanes, envelope N/A; the time model
+    # still governs.
+    v = sizing.gate_fused(budget_s=3_600.0, num_envs=4096, batch_size=2048,
+                          train_every=1, chunk_iters=1000, num_chunks=10,
+                          ring=1_000_000, pixel_obs=False)
+    assert v.ok, v.reason
+
+
+def test_time_model_refuses_over_budget_run():
+    # Incident-#2 shape: a frame budget far larger than the kill budget.
+    # 500 chunks x 2000 iters x 1024 lanes = ~1e9 env steps cannot fit
+    # inside a 560 s `timeout`.
+    v = sizing.gate_fused(budget_s=560.0, num_envs=1024, batch_size=512,
+                          train_every=4, chunk_iters=2000, num_chunks=500,
+                          ring=65_536)
+    assert not v.ok
+    assert "kill budget" in v.reason
+    assert v.predicted_s > 560.0
+
+
+def test_prediction_is_conservative_vs_measured():
+    # The measured headline run (27 chunks, ~3 min total incl. compile)
+    # must be predicted ABOVE its real wall time (conservative) but well
+    # under the watchdog — the gate is a guard band, not a forecast.
+    v = sizing.gate_fused(budget_s=900.0, num_envs=1024, batch_size=512,
+                          train_every=4, chunk_iters=200, num_chunks=27,
+                          ring=65_536)
+    assert 170.0 < v.predicted_s < 540.0
