@@ -126,6 +126,20 @@ def bench_config(name: str, iters: int, cfg=None) -> dict:
         "platform": device.platform,
     }
     out.update(flops_util.mfu_fields(flops_per_step, iters, dt, device))
+    if not cfg.network.lstm_size:
+        # Roofline verdict (VERDICT round-3 next #5): bytes census +
+        # which ceiling (compute vs HBM) governs this step, vs the
+        # measured time. Feedforward steps only — the census counts a
+        # scan body once, so the recurrent configs would under-count.
+        out.update(flops_util.roofline_fields(
+            flops_per_step, flops_util.compiled_bytes(compiled), device))
+        if "roofline_s" in out:
+            out["measured_step_s"] = round(dt / iters, 6)
+            # Gap from the UNROUNDED roofline rate: the rounded
+            # roofline_s display field can be 0.0 for sub-microsecond
+            # rooflines (tiny CPU test cases) and must not be divided by.
+            out["roofline_gap_x"] = round(
+                (dt / iters) * out["roofline_grad_steps_per_sec"], 2)
     return out
 
 

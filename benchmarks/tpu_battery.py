@@ -55,6 +55,18 @@ STAGES = [
     # chip (BASELINE.md); ~110s measured, exit 0 iff the +0.5 bar clears.
     ("r2d2_pixel_learning",
      [sys.executable, "benchmarks/r2d2_pixel_learning.py"], 600),
+    # End-to-end Ape-X split (VERDICT round-3 missing #2): learner on
+    # the chip, real shm actor fleet stepping fake-ALE Pong through the
+    # production AtariPreprocessing path. Self-sizing (probe phase
+    # derives the measure budget), so it cannot be oversized.
+    ("apex_split",
+     [sys.executable, "benchmarks/apex_split_bench.py"], 1500),
+    # Full-game learning proof (VERDICT round-3 next #4): fake-ALE Pong
+    # through the real AtariPreprocessing stack, Nature-CNN apex split,
+    # bar = training-episode-return improvement. Self-sizing like
+    # apex_split. Exit 0 iff the bar clears.
+    ("ale_learning",
+     [sys.executable, "benchmarks/ale_learning.py"], 1500),
 ]
 
 

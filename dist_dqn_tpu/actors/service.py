@@ -307,6 +307,16 @@ class ApexLearnerService:
         self._eval_rng = None
         self.bad_records = 0
         self.actor_restarts = 0
+        # Training episode returns, accumulated from the RAW per-lane
+        # reward stream the drain path already sees (in the env's
+        # training units, i.e. post-preprocessing clipping) — the apex
+        # counterpart of the fused loop's episode_return metric, and the
+        # learning signal that works on a remote-tunnel device, where
+        # stepping a host eval env synchronously (one device call per
+        # step) is dispatch-bound.
+        self._ep_accum: Dict[int, np.ndarray] = {}
+        self._ep_returns: deque = deque(maxlen=64)
+        self.episodes_completed = 0
         from dist_dqn_tpu.utils.trace import make_tracer
         self.tracer = make_tracer(rt.trace_path, process_name="apex-learner")
         self.global_env_steps = 0
@@ -622,7 +632,12 @@ class ApexLearnerService:
                 # Re-hello = reconnect: the step stream has a gap, so drop
                 # partial assembly windows (and the recurrent carry — the
                 # next act restarts it from zeros) rather than bridging it.
+                # The partial episode-return accumulator goes with them: a
+                # restarted actor begins fresh episodes, and folding the
+                # aborted episode's partial return into the next completed
+                # one would contaminate the learning signal.
                 self.assemblers[actor].reset()
+                self._ep_accum.pop(actor, None)
                 if self.recurrent:
                     self._carry[actor] = None
             self._reply_actions(actor, arrays["obs"], t)
@@ -633,6 +648,8 @@ class ApexLearnerService:
         # step record: completes (prev_obs, prev_action) -> transition.
         terminated = arrays["terminated"].astype(bool)
         truncated = arrays["truncated"].astype(bool)
+        self._track_episode_returns(actor, arrays["reward"], terminated,
+                                    truncated)
         if self.recurrent:
             self.assemblers[actor].step(
                 self._prev_obs[actor], self._prev_actions[actor],
@@ -906,6 +923,25 @@ class ApexLearnerService:
         order — the collective-pairing invariant)."""
         return self.global_env_steps if self.distributed else self.env_steps
 
+    def _track_episode_returns(self, actor: int, reward: np.ndarray,
+                               terminated: np.ndarray,
+                               truncated: np.ndarray) -> None:
+        """Per-lane raw-reward accumulation -> completed episode returns
+        (training units). Reconnect resets re-zero via shape mismatch:
+        a fresh hello changes nothing here because rewards restart with
+        the new episode anyway."""
+        acc = self._ep_accum.get(actor)
+        if acc is None or acc.shape != reward.shape:
+            acc = np.zeros_like(reward, dtype=np.float64)
+        acc = acc + reward
+        done = np.logical_or(terminated, truncated)
+        if done.any():
+            finished = acc[done]
+            self._ep_returns.extend(finished.tolist())
+            self.episodes_completed += int(done.sum())
+            acc = np.where(done, 0.0, acc)
+        self._ep_accum[actor] = acc
+
     def _drain_transports(self, burst: int = 256) -> bool:
         """One ingest burst: pop up to ``burst`` records from the shm ring
         and the TCP listener and route each through ``_handle_record``.
@@ -996,6 +1032,12 @@ class ApexLearnerService:
                                         self.actor_restarts),
                                     ring_dropped=float(
                                         self.req_ring.dropped))
+                    if self._ep_returns:
+                        self.log.record(
+                            episode_return=float(
+                                np.mean(self._ep_returns)),
+                            episodes_completed=float(
+                                self.episodes_completed))
                     self.log.flush()
                     last_log = now
             self._flush_pending(force=True)
@@ -1011,6 +1053,10 @@ class ApexLearnerService:
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
                 "global_env_steps": self.global_env_steps,
+                "episodes_completed": self.episodes_completed,
+                "episode_return_recent":
+                    (float(np.mean(self._ep_returns))
+                     if self._ep_returns else None),
                 "replay_size": len(self.replay),
                 "ring_dropped": self.req_ring.dropped,
                 # Full backlogs backpressure rather than drop; a nonzero

@@ -31,24 +31,35 @@ _PEAK_BF16 = {
 }
 
 
+# device_kind -> peak HBM bandwidth, bytes/s per chip. Public numbers:
+# v4 1228 GB/s, v5e 819, v5p 2765, v6e (Trillium) 1640.
+_PEAK_HBM_BW = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+
 def chip_peak_flops(device) -> Optional[float]:
     """Dense bf16 peak FLOP/s for a jax.Device, or None if unknown (CPU)."""
     return _PEAK_BF16.get(getattr(device, "device_kind", ""))
 
 
-def compiled_flops(compiled) -> Optional[float]:
-    """FLOPs of one execution of a ``jax.stages.Compiled`` program.
+def chip_peak_hbm_bw(device) -> Optional[float]:
+    """Peak HBM bytes/s for a jax.Device, or None if unknown (CPU)."""
+    return _PEAK_HBM_BW.get(getattr(device, "device_kind", ""))
 
-    Returns None when the backend does not expose a cost analysis (some
-    plugin backends) — callers must treat MFU as unavailable, not zero.
 
-    CAVEAT (measured on this box, round 3): the census counts the body of
-    a ``lax.scan``/``while_loop`` ONCE, regardless of trip count — a
-    5-iteration and a 40-iteration chunk of the fused loop return the
-    SAME flops. Only call this on programs without data/trip-dependent
-    loops over compute (the feedforward train step qualifies; fused
-    chunks and the scanned R2D2 time loop do not).
-    """
+def _cost_value(compiled, key: str) -> Optional[float]:
+    """One positive value from a compiled program's XLA cost analysis,
+    or None when the backend exposes no analysis / no such key —
+    callers must treat the metric as unavailable, not zero."""
     try:
         cost = compiled.cost_analysis()
     except Exception:
@@ -58,10 +69,68 @@ def compiled_flops(compiled) -> Optional[float]:
         cost = cost[0] if cost else {}
     if not isinstance(cost, dict):
         return None
-    flops = cost.get("flops")
-    if flops is None or flops <= 0:
+    value = cost.get(key)
+    if value is None or value <= 0:
         return None
-    return float(flops)
+    return float(value)
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """FLOPs of one execution of a ``jax.stages.Compiled`` program.
+
+    CAVEAT (measured on this box, round 3): the census counts the body of
+    a ``lax.scan``/``while_loop`` ONCE, regardless of trip count — a
+    5-iteration and a 40-iteration chunk of the fused loop return the
+    SAME flops. Only call this on programs without data/trip-dependent
+    loops over compute (the feedforward train step qualifies; fused
+    chunks and the scanned R2D2 time loop do not).
+    """
+    return _cost_value(compiled, "flops")
+
+
+def compiled_bytes(compiled) -> Optional[float]:
+    """"bytes accessed" census of one execution of a compiled program —
+    the HLO cost model's post-fusion sum of every fusion's operand +
+    result traffic, i.e. the memory-side counterpart of
+    ``compiled_flops`` for a roofline bound (VERDICT round-3 next #5).
+
+    Same scan caveat as ``compiled_flops`` (a scan body is counted once
+    — feedforward steps only), plus one of its own: the cost model does
+    not see VMEM reuse across fusions, so this is the compiler's
+    HBM-traffic estimate, not a hardware counter. Good enough to decide
+    memory-bound vs compute-bound; not a promise of achieved GB/s.
+    """
+    return _cost_value(compiled, "bytes accessed")
+
+
+def roofline_fields(flops_per_exec: Optional[float],
+                    bytes_per_exec: Optional[float], device) -> dict:
+    """Roofline verdict for one program execution: which bound governs,
+    and the predicted step time under peak compute / peak bandwidth.
+
+    Returns {} when any input is unknown. ``roofline_s`` is
+    max(flops/peak_flops, bytes/peak_bw); measured time far above it
+    means dispatch/latency overhead, near it means the named bound is
+    real, and the ``roofline_bound`` field says which ceiling the
+    program sits under (the answer to "is 2% MFU headroom or the
+    bandwidth ceiling?" — BASELINE.md's CNN-family question).
+    """
+    peak_f = chip_peak_flops(device)
+    peak_b = chip_peak_hbm_bw(device)
+    if None in (flops_per_exec, bytes_per_exec, peak_f, peak_b):
+        return {}
+    t_compute = flops_per_exec / peak_f
+    t_memory = bytes_per_exec / peak_b
+    t_roof = max(t_compute, t_memory)
+    return {
+        "bytes_per_step": round(bytes_per_exec, 1),
+        "arith_intensity": round(flops_per_exec / bytes_per_exec, 2),
+        "roofline_compute_s": round(t_compute, 6),
+        "roofline_memory_s": round(t_memory, 6),
+        "roofline_s": round(t_roof, 6),
+        "roofline_bound": "memory" if t_memory >= t_compute else "compute",
+        "roofline_grad_steps_per_sec": round(1.0 / t_roof, 1),
+    }
 
 
 def mfu(flops_per_sec: Optional[float], device) -> Optional[float]:

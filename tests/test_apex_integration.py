@@ -35,6 +35,12 @@ def _run_split_and_assert_plumbing(config_name, **net_overrides):
     assert result["replay_size"] > 500
     assert result["grad_steps"] >= 10
     assert result["ring_dropped"] == 0
+    # Training episode returns come free with ingestion (raw per-lane
+    # reward accumulation): 1200 CartPole steps over 8 lanes complete
+    # episodes, and random-policy CartPole returns sit near ~20.
+    assert result["episodes_completed"] > 0
+    assert result["episode_return_recent"] is not None
+    assert 5.0 <= result["episode_return_recent"] <= 500.0
 
 
 def test_apex_split_end_to_end():

@@ -1,0 +1,63 @@
+"""Harness smokes for the chip-window benchmark stages (VERDICT round-3
+asks #2/#4): the apex-split end-to-end bench and the fake-ALE game
+learning proof. Both self-size from a probe phase so they cannot be
+oversized on the tunnel; these CPU smokes pin the harness mechanics
+(gate bypass, probe -> measure sizing, result-row schema, exit codes) so
+a chip window never burns time on a harness bug."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow  # real multi-process runs: full-suite only
+
+
+def _run(cmd, timeout=540):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # never touch the tunnel
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _json_rows(stdout):
+    rows = []
+    for line in stdout.splitlines():
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            pass
+    return rows
+
+
+def test_apex_split_bench_smoke_vector():
+    proc = _run([sys.executable, "benchmarks/apex_split_bench.py",
+                 "--allow-cpu", "--variants", "vector",
+                 "--measure-seconds", "5"])
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rows = _json_rows(proc.stdout)
+    measure = [r for r in rows if r.get("phase") == "measure"]
+    assert len(measure) == 1
+    row = measure[0]
+    assert row["env_steps"] >= row["total_env_steps"]
+    assert row["bad_records"] == 0 and row["ring_dropped"] == 0
+    assert row["grad_steps"] > 0
+    assert row["platforms"] == "cpu"  # smoke must never record TPU-ish rows
+
+
+def test_ale_learning_smoke():
+    proc = _run([sys.executable, "benchmarks/ale_learning.py", "--smoke",
+                 "--budget-seconds", "20"])
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rows = _json_rows(proc.stdout)
+    summary = [r for r in rows if r.get("summary") == "ale_learning"]
+    assert len(summary) == 1
+    row = summary[0]
+    assert row["fake_ale"] is True and row["platform"] == "cpu"
+    assert row["frames"] > 0 and row["grad_steps"] > 0
+    assert row["smoke"] is True
