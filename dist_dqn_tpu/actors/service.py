@@ -21,6 +21,7 @@ north-star metrics (BASELINE.json:2) and are reported every flush.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import threading
 import time
@@ -57,6 +58,13 @@ class ApexRuntimeConfig:
     # recovery point; actors/replay are stateless and refill).
     checkpoint_dir: Optional[str] = None
     save_every_steps: int = 100_000    # env steps between checkpoints
+    # Opt-in replay-state checkpointing (VERDICT round-3 next #7): also
+    # snapshot the host replay shard beside the learner checkpoint on
+    # every save and restore it on startup, trading ring-sized writes
+    # (a 60k pixel shard is ~1.7 GB) for resuming with a warm,
+    # already-distributed buffer instead of a min_fill refill. The
+    # default stays stateless (utils/checkpoint.py has the cost math).
+    checkpoint_replay: bool = False
     # Periodic greedy evaluation on a service-owned env instance.
     eval_every_steps: int = 0          # 0 disables
     eval_episodes: int = 5
@@ -474,6 +482,8 @@ class ApexLearnerService:
                         self._next_eval = resumed + self.rt.eval_every_steps
                     self.log.log_fn(
                         f'{{"resumed_at_env_steps": {resumed}}}')
+                    if self.rt.checkpoint_replay:
+                        self._load_replay_snapshot()
             self._refresh_host_params()
 
     def _refresh_host_params(self):
@@ -923,6 +933,41 @@ class ApexLearnerService:
         order — the collective-pairing invariant)."""
         return self.global_env_steps if self.distributed else self.env_steps
 
+    def _replay_snapshot_path(self) -> str:
+        # Multi-host: each process owns its shard, so each snapshots its
+        # own file beside the shared learner checkpoint.
+        suffix = (f"_p{self.jax.process_index()}" if self.distributed
+                  else "")
+        return os.path.join(self.rt.checkpoint_dir,
+                            f"replay_shard{suffix}.npz")
+
+    def _save_replay_snapshot(self) -> None:
+        if not (self.rt.checkpoint_replay and self.rt.checkpoint_dir
+                and len(self.replay)):
+            return
+        path = self._replay_snapshot_path()
+        tmp = path + ".tmp"
+        t0 = time.perf_counter()
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **self.replay.state_dict())
+        os.replace(tmp, path)  # atomic: a crash mid-write leaves the old one
+        self.log.log_fn(json.dumps({
+            "replay_snapshot_s": round(time.perf_counter() - t0, 3),
+            "replay_snapshot_mb": round(os.path.getsize(path) / 2**20, 1),
+            "replay_snapshot_items": len(self.replay)}))
+
+    def _load_replay_snapshot(self) -> None:
+        path = self._replay_snapshot_path()
+        if not os.path.exists(path):
+            return
+        t0 = time.perf_counter()
+        with np.load(path) as state:
+            self.replay.load_state_dict(dict(state))
+        self.log.log_fn(json.dumps({
+            "replay_snapshot_restored_items": len(self.replay),
+            "replay_snapshot_restore_s":
+                round(time.perf_counter() - t0, 3)}))
+
     def _track_episode_returns(self, actor: int, reward: np.ndarray,
                                terminated: np.ndarray,
                                truncated: np.ndarray) -> None:
@@ -993,7 +1038,8 @@ class ApexLearnerService:
                 self._flush_pending()
                 self._maybe_train()
                 if self._ckpt is not None:
-                    self._ckpt.maybe_save(self._progress(), self.state)
+                    if self._ckpt.maybe_save(self._progress(), self.state):
+                        self._save_replay_snapshot()
                 if self._progress() >= self._next_eval:
                     self._next_eval = self._progress() \
                         + self.rt.eval_every_steps
@@ -1048,6 +1094,7 @@ class ApexLearnerService:
             if self._ckpt is not None:
                 self._ckpt.save(self._progress(), self.state)
                 self._ckpt.close()
+                self._save_replay_snapshot()
         finally:
             self.tracer.close()
             self.shutdown()

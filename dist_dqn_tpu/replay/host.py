@@ -386,6 +386,59 @@ class PrioritizedHostReplay:
         self.sampled += batch_size
         return items, idx, weights
 
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Serializable shard snapshot (VERDICT round-3 next #7): item
+        arrays over the FULL capacity ring (the ring may have wrapped, so
+        the live region is position-dependent), per-slot p^alpha mass,
+        and the cursor/counters. Pairs with ``load_state_dict`` for the
+        apex runtime's opt-in replay checkpointing; a 60k-slot pixel
+        shard snapshots at ~1.7 GB (documented trade-off in
+        utils/checkpoint.py — the default remains stateless refill)."""
+        if self._data is None:
+            raise ValueError("state_dict() on an unallocated shard "
+                             "(nothing added yet)")
+        if self.device_sampler is not None:
+            self.device_sampler._flush_writes()
+            mass = np.asarray(self.device_sampler._plane,
+                              np.float32).reshape(-1)[:self.capacity].copy()
+        else:
+            mass = np.asarray(
+                self.tree.get(np.arange(self.capacity, dtype=np.int64)),
+                np.float64)
+        out = {f"data.{k}": v for k, v in self._data.items()}
+        out.update(mass=mass, slot_gen=self._slot_gen.copy(),
+                   meta=np.array([self._pos, self._size, self.added,
+                                  self.sampled], np.int64),
+                   max_priority=np.float64(self._max_priority),
+                   alpha=np.float64(self.alpha),
+                   capacity=np.int64(self.capacity))
+        return out
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        """Restore a ``state_dict`` snapshot into this (same-capacity,
+        same-alpha) shard; storage is allocated from the snapshot."""
+        if int(state["capacity"]) != self.capacity:
+            raise ValueError(
+                f"replay snapshot capacity {int(state['capacity'])} != "
+                f"configured {self.capacity} — restore with the same "
+                "replay.capacity used at save time")
+        if float(state["alpha"]) != self.alpha:
+            raise ValueError(
+                f"replay snapshot alpha {float(state['alpha'])} != "
+                f"configured {self.alpha}")
+        self._data = {k[len("data."):]: np.array(v)
+                      for k, v in state.items() if k.startswith("data.")}
+        self._pos, self._size, self.added, self.sampled = (
+            int(x) for x in state["meta"])
+        self._max_priority = float(state["max_priority"])
+        self._slot_gen = np.array(state["slot_gen"], np.int64)
+        idx = np.arange(self.capacity, dtype=np.int64)
+        mass = np.asarray(state["mass"], np.float64)
+        if self.device_sampler is not None:
+            self.device_sampler.set(idx, mass.astype(np.float32))
+        else:
+            self.tree.set(idx, mass)
+
     def generation(self, idx: np.ndarray) -> np.ndarray:
         """Write-generation stamps of the given slots (see update guard)."""
         return self._slot_gen[np.asarray(idx, np.int64)].copy()
@@ -441,3 +494,24 @@ class UniformHostReplay:
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         idx = self._rng.integers(0, self._size, size=batch_size)
         return {k: v[idx] for k, v in self._data.items()}
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Uniform-shard counterpart of PrioritizedHostReplay.state_dict
+        (no mass/priority state to carry)."""
+        if self._data is None:
+            raise ValueError("state_dict() on an unallocated shard "
+                             "(nothing added yet)")
+        out = {f"data.{k}": v for k, v in self._data.items()}
+        out.update(meta=np.array([self._pos, self._size], np.int64),
+                   capacity=np.int64(self.capacity))
+        return out
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        if int(state["capacity"]) != self.capacity:
+            raise ValueError(
+                f"replay snapshot capacity {int(state['capacity'])} != "
+                f"configured {self.capacity} — restore with the same "
+                "replay.capacity used at save time")
+        self._data = {k[len("data."):]: np.array(v)
+                      for k, v in state.items() if k.startswith("data.")}
+        self._pos, self._size = (int(x) for x in state["meta"])

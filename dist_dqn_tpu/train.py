@@ -44,8 +44,19 @@ def _pick_mesh_devices(num_devices: int, multiprocess: bool):
 def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
           checkpoint_dir: str = None, save_every_frames: int = 0,
-          profile_dir: str = None, num_devices: int = 1, stop_fn=None):
+          profile_dir: str = None, num_devices: int = 1, stop_fn=None,
+          checkpoint_replay: bool = False):
     """Run training; returns (final_carry, history list of metric dicts).
+
+    With ``checkpoint_replay`` the checkpoint holds the WHOLE fused
+    carry — replay ring, env states, rng, episode trackers — so a
+    resumed run continues BIT-EQUAL to an uninterrupted one (no replay
+    refill, no distribution shift). Cost: the ring dominates the
+    checkpoint (a 65k-slot pixel ring is ~1.8 GB vs ~7 MB of learner
+    state), so saves are proportionally slower — the default
+    learner-only mode instead refills replay from live experience in
+    ``min_fill / steady-rate`` seconds (sub-second at fused-loop rates;
+    see utils/checkpoint.py for the trade-off numbers).
 
     With ``checkpoint_dir`` set, the learner state is checkpointed every
     ``save_every_frames`` env frames (default: every eval period) and the
@@ -117,30 +128,47 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     carry = init(np.asarray(k_init))
 
     ckpt = None
-    frame_offset = 0
+    frame_offset = 0      # added to the carry's cumulative frame metric
+    resumed_frames = 0    # where the loop's cursor actually starts
     if checkpoint_dir:
-        from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+        from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                                   record_checkpoint_kind)
         ckpt = TrainCheckpointer(
             checkpoint_dir,
             save_every_frames=save_every_frames or cfg.eval_every_steps)
-        restored = ckpt.restore_latest(carry.learner)
+        # Raises with the actual cause if the directory was written with
+        # the OTHER --checkpoint-replay setting (the restore would
+        # otherwise fail as a misleading structure-mismatch error).
+        record_checkpoint_kind(checkpoint_dir,
+                               "carry" if checkpoint_replay else "learner")
+        restored = ckpt.restore_latest(
+            carry if checkpoint_replay else carry.learner)
         if restored is not None:
             # Resume continues toward the SAME total: the frame cursor picks
             # up at the checkpoint step so relaunching the identical command
             # finishes the remaining frames (and later saves land at
             # monotonically increasing orbax steps).
-            frame_offset, learner = restored
+            frame_offset, tree = restored
+            resumed_frames = frame_offset
             # Mesh path: the restore is templated on the live learner's
             # shardings (utils/checkpoint.py), so global replicated arrays
             # come back as such. Multi-process runs call save/restore on
             # every process (orbax collective IO) against a SHARED
             # checkpoint directory.
-            carry = carry._replace(learner=learner)
-            log_fn(json.dumps({"resumed_at_frames": frame_offset}))
+            log_fn(json.dumps({"resumed_at_frames": frame_offset,
+                               "with_replay": checkpoint_replay}))
+            if checkpoint_replay:
+                # The carry's own iteration counter came back with it, so
+                # the cumulative env_frames metric already continues from
+                # the checkpoint — a host-side offset would double-count.
+                carry = tree
+                frame_offset = 0
+            else:
+                carry = carry._replace(learner=tree)
 
     B = cfg.actor.num_envs
     history = []
-    frames = frame_offset
+    frames = resumed_frames
     # 0 disables eval entirely (same convention as the apex runtime's
     # eval_every_steps); otherwise the first chunk gets a baseline eval.
     next_eval = frames if cfg.eval_every_steps else float("inf")
@@ -179,7 +207,8 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
         log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
                            for k, v in row.items()}))
         if ckpt is not None:
-            ckpt.maybe_save(frames, carry.learner)
+            ckpt.maybe_save(frames,
+                            carry if checkpoint_replay else carry.learner)
         # Early stop (single-process only: a data-dependent exit would
         # desync multi-process lockstep): stop_fn sees each metric row —
         # solve-detection for tests, target-return stops for users.
@@ -187,7 +216,7 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                 and stop_fn(row):
             break
     if ckpt is not None:
-        ckpt.save(frames, carry.learner)
+        ckpt.save(frames, carry if checkpoint_replay else carry.learner)
         ckpt.close()
     return carry, history
 
@@ -210,6 +239,15 @@ def main():
     parser.add_argument("--save-every-frames", type=int, default=0,
                         help="checkpoint period in env frames "
                              "(default: eval_every_steps)")
+    parser.add_argument("--checkpoint-replay", action="store_true",
+                        help="also checkpoint replay state: the fused "
+                             "runtime saves the WHOLE carry (resume is "
+                             "bit-equal to an uninterrupted run); the "
+                             "apex runtime snapshots the host shard "
+                             "beside the learner checkpoint (warm-buffer "
+                             "resume). Ring-sized checkpoints (a 65k "
+                             "pixel ring is ~1.8 GB vs ~7 MB learner-"
+                             "only); default refills from live experience")
     parser.add_argument("--eval-every-steps", type=int, default=None,
                         help="eval period in env steps. Default: config "
                              "value on the fused runtime; DISABLED on the "
@@ -326,6 +364,7 @@ def main():
             envs_per_actor=args.envs_per_actor,
             total_env_steps=args.total_env_steps or cfg.total_env_steps,
             checkpoint_dir=args.checkpoint_dir,
+            checkpoint_replay=args.checkpoint_replay,
             save_every_steps=args.save_every_frames or cfg.eval_every_steps,
             eval_every_steps=args.eval_every_steps or 0,
             eval_episodes=cfg.eval_episodes,
@@ -386,7 +425,7 @@ def main():
           chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
           save_every_frames=args.save_every_frames,
           profile_dir=args.profile_dir, num_devices=args.mesh_devices,
-          stop_fn=stop_fn)
+          stop_fn=stop_fn, checkpoint_replay=args.checkpoint_replay)
 
 
 if __name__ == "__main__":

@@ -3,10 +3,30 @@
 Failure model of the actor/learner architecture: actors are stateless
 workers (they re-pull params after a restart), replay refills from live
 experience, so the *learner state* — params, target params, optimizer
-moments, step counters — is the recovery point. Checkpoints therefore hold
-the learner pytree plus the host-side training cursor (env frames), not the
-replay ring: a pixel ring is GBs of HBM that regenerates in minutes, and
-skipping it keeps checkpoints small enough to write frequently.
+moments, step counters — is the recovery point. By DEFAULT checkpoints
+hold the learner pytree plus the host-side training cursor (env
+frames), not the replay ring.
+
+The replay trade-off, quantified (VERDICT round-3 next #7): a 65k-slot
+84x84x4 pixel ring is ~1.8 GB vs ~7 MB of Nature-CNN learner state —
+~260x the checkpoint bytes. Refill on resume costs
+``min_fill / steady-rate`` env steps of training delay: at the fused
+loop's measured 569k steps/s/chip that is 4096/569k ~= **7 ms**; even
+a full 65k-slot ring re-reaches capacity in ~0.12 s (the apex host
+shard's 20k min_fill at the 1-core dev box's ~13k steps/s host rate:
+~1.5 s; at a pod's per-host rates, sub-second). What refill does NOT
+recover is the ring's *contents* — a resumed run trains on freshly
+generated experience, so it is statistically equivalent, not
+bit-equal. Runs that need bit-exact resume (debugging, preemption-
+heavy pods where distribution continuity matters) opt into
+``train(..., checkpoint_replay=True)`` / ``--checkpoint-replay``,
+which checkpoints the WHOLE fused carry (ring + env states + rng) at
+ring-sized save cost; ``tests/test_checkpoint.py`` pins the bit-equal
+resume property. The apex runtime's same flag
+(``ApexRuntimeConfig.checkpoint_replay``) snapshots the host replay
+shard beside the learner checkpoint (``replay/host.py state_dict``) —
+warm-buffer, statistically-continuous resume; the async service is not
+bit-replayable by design.
 
 Orbax handles the pytree IO (async-capable, atomic renames, works with
 sharded jax.Arrays on a mesh — global arrays are saved/restored with their
@@ -115,6 +135,43 @@ class TrainCheckpointer:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+_KIND_FILE = "CHECKPOINT_KIND"
+
+
+def record_checkpoint_kind(directory: str, kind: str) -> None:
+    """Stamp what a checkpoint directory's items contain — ``learner``
+    (the default recovery point) or ``carry`` (--checkpoint-replay's
+    whole fused carry). Restore paths read this to template correctly
+    and to say THE ACTUAL CAUSE when the flavors mismatch, instead of
+    orbax's structure error being rewrapped as a config drift."""
+    import os
+
+    path = os.path.join(directory, _KIND_FILE)
+    existing = read_checkpoint_kind(directory)
+    if existing is not None and existing != kind:
+        raise ValueError(
+            f"checkpoint directory {directory!r} holds {existing!r} "
+            f"checkpoints but this run would write {kind!r} — the "
+            "--checkpoint-replay flag differs from the run that created "
+            "the directory. Resume with the same flag, or use a fresh "
+            "--checkpoint-dir.")
+    if existing is None:
+        with open(path, "w") as fh:
+            fh.write(kind)
+
+
+def read_checkpoint_kind(directory: str):
+    """The recorded kind, or None (pre-marker directories: learner-only
+    by construction, since the marker landed with --checkpoint-replay)."""
+    import os
+
+    try:
+        with open(os.path.join(directory, _KIND_FILE)) as fh:
+            return fh.read().strip() or None
+    except OSError:
+        return None
 
 
 def list_checkpoint_steps(directory: str) -> Tuple[int, ...]:

@@ -472,3 +472,115 @@ def test_host_all_steps_skips_only_missing_checkpoints(tmp_path, capsys):
                               side_effect=FileNotFoundError("no ROM")), \
             pytest.raises(FileNotFoundError, match="no ROM"):
         ev.main()
+
+
+def test_checkpoint_replay_resumes_bit_equal(tmp_path):
+    """--checkpoint-replay saves the WHOLE fused carry, so an
+    interrupted+resumed run must reproduce the uninterrupted run's
+    parameters BIT-EXACTLY — the property learner-only checkpoints
+    cannot give (replay refills with fresh experience there). VERDICT
+    round-3 next #7."""
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(16,)),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        eval_every_steps=0,
+    )
+    quiet = lambda s: None  # noqa: E731
+
+    ref_carry, _ = train(cfg, total_env_steps=600, chunk_iters=75,
+                         log_fn=quiet)
+
+    d = str(tmp_path / "run")
+    train(cfg, total_env_steps=300, chunk_iters=75, log_fn=quiet,
+          checkpoint_dir=d, checkpoint_replay=True)
+    carry2, hist = train(cfg, total_env_steps=600, chunk_iters=75,
+                         log_fn=quiet, checkpoint_dir=d,
+                         checkpoint_replay=True)
+    assert hist[-1]["env_frames"] == 600
+    for a, b in zip(jax.tree.leaves(ref_carry.learner.params),
+                    jax.tree.leaves(carry2.learner.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The replay ring came back too (contents, not just shapes).
+    for a, b in zip(jax.tree.leaves(ref_carry.replay),
+                    jax.tree.leaves(carry2.replay)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_replay_completed_run_does_not_rerun(tmp_path):
+    """Relaunching a FINISHED --checkpoint-replay run must be a no-op
+    (the restored carry's cumulative counter must not reset the loop
+    cursor to zero and train the whole budget again)."""
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(16,)),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        eval_every_steps=0,
+    )
+    quiet = lambda s: None  # noqa: E731
+    d = str(tmp_path / "run")
+    train(cfg, total_env_steps=300, chunk_iters=75, log_fn=quiet,
+          checkpoint_dir=d, checkpoint_replay=True)
+    _, hist = train(cfg, total_env_steps=300, chunk_iters=75, log_fn=quiet,
+                    checkpoint_dir=d, checkpoint_replay=True)
+    assert hist == []
+
+
+def test_checkpoint_replay_runs_stay_evaluable(tmp_path):
+    """evaluate.py must handle --checkpoint-replay (full-carry)
+    checkpoints: the kind marker routes the restore through a carry
+    template and extracts the learner — single-point and --all-steps
+    curve both work (code-review round 4)."""
+    from dist_dqn_tpu.evaluate import (evaluate_checkpoint,
+                                       evaluate_checkpoint_curve)
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(16,)),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        eval_every_steps=0,
+    )
+    d = str(tmp_path / "run")
+    train(cfg, total_env_steps=600, chunk_iters=75, log_fn=lambda s: None,
+          checkpoint_dir=d, checkpoint_replay=True, save_every_frames=300)
+    out = evaluate_checkpoint(cfg, d, episodes=2)
+    assert out["frames"] == 600 and 1.0 <= out["eval_return"] <= 500.0
+    rows = evaluate_checkpoint_curve(cfg, d, episodes=1)
+    assert [r["frames"] for r in rows] and rows[-1]["frames"] == 600
+
+
+def test_checkpoint_kind_mismatch_names_the_flag(tmp_path):
+    """Resuming a directory with the OTHER --checkpoint-replay setting
+    must say the flag is the cause, not claim an architecture drift."""
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(16,)),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        eval_every_steps=0,
+    )
+    d = str(tmp_path / "run")
+    train(cfg, total_env_steps=300, chunk_iters=75, log_fn=lambda s: None,
+          checkpoint_dir=d)
+    with pytest.raises(ValueError, match="checkpoint-replay"):
+        train(cfg, total_env_steps=600, chunk_iters=75,
+              log_fn=lambda s: None, checkpoint_dir=d,
+              checkpoint_replay=True)
