@@ -59,7 +59,8 @@ VARIANTS = {
                           "BENCH_TRAIN_EVERY": "4"},
     # Proven OVERSIZED on v5e (watchdog timeout + tunnel wedge
     # 2026-07-31); excluded from the default run — opt in explicitly
-    # with --variants lanes2048_b1024, and only run it LAST.
+    # with --variants lanes2048_b1024 AND BENCH_ALLOW_UNPROVEN=1 (the
+    # round-4 sizing gate refuses it otherwise), and only run it LAST.
     "lanes2048_b1024":   {"BENCH_NUM_ENVS": "2048", "BENCH_BATCH": "1024",
                           "BENCH_TRAIN_EVERY": "4"},
 }
