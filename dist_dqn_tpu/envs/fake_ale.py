@@ -64,6 +64,19 @@ _ACTION_DY = np.array([0.0, 0.0, -_PAD_SPEED, _PAD_SPEED,
                        -_PAD_SPEED, _PAD_SPEED], np.float32)
 
 
+def _paint_box(img: np.ndarray, y: float, x: float, hy: float, hx: float,
+               color) -> None:
+    """Fill the integer-pixel set {(r, c): |r-y|<=hy and |c-x|<=hx},
+    clipped to the frame — the slice form of a centered-box mask."""
+    h, w = img.shape[:2]
+    r0 = max(int(np.ceil(y - hy)), 0)
+    r1 = min(int(np.floor(y + hy)), h - 1)
+    c0 = max(int(np.ceil(x - hx)), 0)
+    c1 = min(int(np.floor(x + hx)), w - 1)
+    if r0 <= r1 and c0 <= c1:
+        img[r0:r1 + 1, c0:c1 + 1] = color
+
+
 class _DiscreteSpace:
     """The one attribute the adapter reads from gymnasium's action space."""
 
@@ -81,7 +94,8 @@ class _FakeALEBase:
     metadata = {"render_modes": []}
 
     def __init__(self, game: str, num_actions: int, max_frames: int,
-                 repeat_action_probability: float):
+                 repeat_action_probability: float,
+                 court_color=(0, 0, 0)):
         self.game = game
         self.max_frames = max_frames
         self.action_space = _DiscreteSpace(num_actions)
@@ -90,6 +104,11 @@ class _FakeALEBase:
         self._last_action = 0
         self._lives = 0
         self._t = 0
+        # Court template: np.full with a color TUPLE broadcasts
+        # per-element (~200us); copying a prebuilt frame is ~3us, and
+        # the renderer runs every emulator frame.
+        self._court = np.empty((_H, _W, 3), np.uint8)
+        self._court[:] = court_color
 
     # subclass hooks ---------------------------------------------------------
     def _reset_game(self) -> None:
@@ -134,22 +153,25 @@ class FakePongEnv(_FakeALEBase):
 
     def __init__(self, game: str = "Pong", max_frames: int = 20_000,
                  repeat_action_probability: float = 0.0):
-        super().__init__(game, 6, max_frames, repeat_action_probability)
+        super().__init__(game, 6, max_frames, repeat_action_probability,
+                         court_color=(30, 60, 30))
 
     def _frame(self) -> np.ndarray:
-        """Raw 210x160x3 uint8: dark court, light paddles, white ball."""
-        img = np.full((_H, _W, 3), (30, 60, 30), np.uint8)
-        r = np.arange(_H, dtype=np.float32)[:, None]
-        c = np.arange(_W, dtype=np.float32)[None, :]
+        """Raw 210x160x3 uint8: dark court, light paddles, white ball.
+
+        Sprites are rectangle SLICES, the exact integer-pixel set of the
+        centered-box masks ``|r-y|<=hy & |c-x|<=hx`` (pinned by
+        tests/test_fake_ale.py) — O(sprite) instead of O(image) per
+        sprite, which matters because the emulator renders every raw
+        frame and the host side of the Ape-X split is env-stepping-bound
+        on a shared core (benchmarks/apex_split_bench.py)."""
+        img = self._court.copy()
         bx, by = float(self._ball[0]), float(self._ball[1])
-        ball_m = (np.abs(r - by) <= 2.0) & (np.abs(c - bx) <= 1.5)
-        pad_m = (np.abs(r - self._pad_y) <= _PAD_HALF) \
-            & (np.abs(c - _AGENT_X) <= 2.0)
-        opp_m = (np.abs(r - self._opp_y) <= _PAD_HALF) \
-            & (np.abs(c - _OPP_X) <= 2.0)
-        img[ball_m] = (236, 236, 236)
-        img[pad_m] = (92, 186, 92)
-        img[opp_m] = (213, 130, 74)
+        _paint_box(img, by, bx, 2.0, 1.5, (236, 236, 236))
+        _paint_box(img, self._pad_y, _AGENT_X, _PAD_HALF, 2.0,
+                   (92, 186, 92))
+        _paint_box(img, self._opp_y, _OPP_X, _PAD_HALF, 2.0,
+                   (213, 130, 74))
         return img
 
     def _serve(self, toward_agent: bool) -> np.ndarray:
@@ -226,19 +248,33 @@ class FakeBreakoutEnv(_FakeALEBase):
 
     def __init__(self, game: str = "Breakout", max_frames: int = 20_000,
                  repeat_action_probability: float = 0.0):
-        super().__init__(game, 4, max_frames, repeat_action_probability)
+        super().__init__(game, 4, max_frames, repeat_action_probability,
+                         court_color=(20, 20, 30))
 
-    def _frame(self) -> np.ndarray:
-        img = np.full((_H, _W, 3), (20, 20, 30), np.uint8)
-        bw = _W / _BK_COLS
+    def _brick_rect(self, row: int, col: int):
+        y0 = int(_BK_BRICK_TOP + row * _BK_BRICK_H)
+        x0 = int(col * (_W / _BK_COLS))
+        return (slice(y0, y0 + int(_BK_BRICK_H) - 1),
+                slice(x0, x0 + int(_W / _BK_COLS) - 1))
+
+    def _rebuild_wall(self) -> None:
+        """Court + brick band cache: bricks change only on hits, so the
+        wall is drawn incrementally (_knock_brick) instead of 96 python
+        rect-fills per frame; _frame just copies this and adds the two
+        moving sprites."""
+        self._wall = self._court.copy()
         for row in range(_BK_ROWS):
-            y0 = int(_BK_BRICK_TOP + row * _BK_BRICK_H)
-            color = _BK_ROW_COLOR[row]
             for col in range(_BK_COLS):
                 if self._bricks[row, col]:
-                    x0 = int(col * bw)
-                    img[y0:y0 + int(_BK_BRICK_H) - 1,
-                        x0:x0 + int(bw) - 1] = color
+                    self._wall[self._brick_rect(row, col)] = \
+                        _BK_ROW_COLOR[row]
+
+    def _knock_brick(self, row: int, col: int) -> None:
+        rect = self._brick_rect(row, col)
+        self._wall[rect] = self._court[rect]  # one source of court color
+
+    def _frame(self) -> np.ndarray:
+        img = self._wall.copy()
         px = self._pad_x
         img[int(_BK_PAD_Y):int(_BK_PAD_Y) + 4,
             int(max(px - _BK_PAD_HALF, 0)):
@@ -250,6 +286,7 @@ class FakeBreakoutEnv(_FakeALEBase):
 
     def _reset_game(self) -> None:
         self._bricks = np.ones((_BK_ROWS, _BK_COLS), bool)
+        self._rebuild_wall()
         self._pad_x = _W / 2.0
         self._lives = _BK_LIVES
         self._held = True          # ball on the paddle until FIRE
@@ -290,10 +327,12 @@ class FakeBreakoutEnv(_FakeALEBase):
         if 0 <= row < _BK_ROWS and 0 <= col < _BK_COLS \
                 and self._bricks[row, col]:
             self._bricks[row, col] = False
+            self._knock_brick(row, col)
             reward = float(_BK_ROW_REWARD[row])
             vy = -vy
             if not self._bricks.any():      # level cleared: fresh wall
                 self._bricks[:] = True
+                self._rebuild_wall()
         # Paddle bounce (ball moving down through the paddle row).
         if vy > 0 and by >= _BK_PAD_Y - 2.0 \
                 and abs(bx - self._pad_x) <= _BK_PAD_HALF + 2.0:

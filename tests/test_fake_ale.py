@@ -219,3 +219,43 @@ def test_apex_split_over_fake_ale(monkeypatch):
     assert result["replay_size"] > 50
     assert result["grad_steps"] >= 1
     assert result["ring_dropped"] == 0 and result["bad_records"] == 0
+
+
+def test_pong_frame_slices_match_mask_semantics():
+    """The renderer's rectangle slices are pixel-identical to the
+    centered-box masks they replaced (round-4 host-rate optimization —
+    the split benches are env-stepping-bound on a shared core)."""
+    from dist_dqn_tpu.envs.fake_ale import _H, _W, FakePongEnv
+
+    env = FakePongEnv()
+    env.reset(seed=7)
+    # Reference grid in float64: positions the PHYSICS can produce are
+    # float32-representable (ball state is a float32 array; paddle ys
+    # come from float32 clips), and float32 values convert to float64
+    # exactly — so jam float32-representable positions and the slice
+    # bounds (computed in float64) match the mask exactly, boundary
+    # cases included.
+    r = np.arange(_H, dtype=np.float64)[:, None]
+    c = np.arange(_W, dtype=np.float64)[None, :]
+    f32 = lambda v: float(np.float32(v))  # noqa: E731
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        # Drive real dynamics AND jam sprites to random subpixel spots
+        # (boundary-exact ceil/floor cases included).
+        for _ in range(5):
+            env.step(int(rng.integers(0, 6)))
+        env._ball[0] = rng.uniform(-2.0, _W + 2.0)
+        env._ball[1] = rng.uniform(-2.0, _H + 2.0)
+        env._pad_y = f32(rng.uniform(10.0, _H - 11.0))
+        env._opp_y = float(int(rng.uniform(10.0, _H - 11.0)))  # exact int
+
+        got = env._frame()
+        want = np.full((_H, _W, 3), (30, 60, 30), np.uint8)
+        bx, by = float(env._ball[0]), float(env._ball[1])
+        want[(np.abs(r - by) <= 2.0) & (np.abs(c - bx) <= 1.5)] = \
+            (236, 236, 236)
+        want[(np.abs(r - env._pad_y) <= 10.0) & (np.abs(c - 140.0) <= 2.0)] \
+            = (92, 186, 92)
+        want[(np.abs(r - env._opp_y) <= 10.0) & (np.abs(c - 16.0) <= 2.0)] \
+            = (213, 130, 74)
+        np.testing.assert_array_equal(got, want)
