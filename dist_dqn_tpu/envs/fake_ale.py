@@ -187,20 +187,24 @@ class FakePongEnv(_FakeALEBase):
         self._lives = 0   # real ALE Pong reports lives() == 0
 
     def _step_game(self, action: int):
-        dy = _ACTION_DY[action]
-        self._pad_y = float(np.clip(self._pad_y + dy, _PAD_HALF,
-                                    _H - 1 - _PAD_HALF))
-        opp_dy = float(np.clip(self._ball[1] - self._opp_y, -_OPP_SPEED,
-                               _OPP_SPEED))
-        self._opp_y = float(np.clip(self._opp_y + opp_dy, _PAD_HALF,
-                                    _H - 1 - _PAD_HALF))
+        # Scalar clamps are python min/max: np.clip on python floats
+        # costs ~8us per call through numpy's dispatch machinery, and
+        # this runs several times per emulator frame on the actor hot
+        # path (identical values either way).
+        dy = float(_ACTION_DY[action])
+        self._pad_y = min(max(self._pad_y + dy, _PAD_HALF),
+                          _H - 1 - _PAD_HALF)
+        opp_dy = min(max(float(self._ball[1]) - self._opp_y, -_OPP_SPEED),
+                     _OPP_SPEED)
+        self._opp_y = min(max(self._opp_y + opp_dy, _PAD_HALF),
+                          _H - 1 - _PAD_HALF)
 
-        bx = self._ball[0] + self._ball[2]
-        by = self._ball[1] + self._ball[3]
-        vy = -self._ball[3] if (by <= 2.0 or by >= _H - 3.0) \
-            else self._ball[3]
-        by = float(np.clip(by, 2.0, _H - 3.0))
-        vx = self._ball[2]
+        bx = float(self._ball[0]) + float(self._ball[2])
+        by = float(self._ball[1]) + float(self._ball[3])
+        vy = -float(self._ball[3]) if (by <= 2.0 or by >= _H - 3.0) \
+            else float(self._ball[3])
+        by = min(max(by, 2.0), _H - 3.0)
+        vx = float(self._ball[2])
 
         hit_agent = (bx >= _AGENT_X - 2.0 and vx > 0
                      and abs(by - self._pad_y) <= _PAD_HALF + 2.0)
@@ -212,7 +216,7 @@ class FakePongEnv(_FakeALEBase):
         elif hit_opp:
             vy += (by - self._opp_y) / _PAD_HALF * 0.5
             vx, bx = -vx, _OPP_X + 2.0
-        vy = float(np.clip(vy, -1.2, 1.2))
+        vy = min(max(vy, -1.2), 1.2)
 
         agent_point = bx <= 1.0
         opp_point = bx >= _W - 2.0
@@ -304,8 +308,8 @@ class FakeBreakoutEnv(_FakeALEBase):
         # Minimal Breakout set: 0 NOOP, 1 FIRE, 2 RIGHT, 3 LEFT.
         dx = _BK_PAD_SPEED if action == 2 else \
             (-_BK_PAD_SPEED if action == 3 else 0.0)
-        self._pad_x = float(np.clip(self._pad_x + dx, _BK_PAD_HALF,
-                                    _W - 1 - _BK_PAD_HALF))
+        self._pad_x = min(max(self._pad_x + dx, _BK_PAD_HALF),
+                          _W - 1 - _BK_PAD_HALF)
         if self._held:
             if action == 1:
                 self._serve()
@@ -317,7 +321,7 @@ class FakeBreakoutEnv(_FakeALEBase):
         vx, vy = float(self._ball[2]), float(self._ball[3])
         if bx <= 2.0 or bx >= _W - 3.0:
             vx = -vx
-            bx = float(np.clip(bx, 2.0, _W - 3.0))
+            bx = min(max(bx, 2.0), _W - 3.0)
         if by <= 2.0:
             vy, by = -vy, 2.0
         reward = 0.0
@@ -338,7 +342,7 @@ class FakeBreakoutEnv(_FakeALEBase):
                 and abs(bx - self._pad_x) <= _BK_PAD_HALF + 2.0:
             vy = -vy
             vx += (bx - self._pad_x) / _BK_PAD_HALF * 0.6
-            vx = float(np.clip(vx, -1.5, 1.5))
+            vx = min(max(vx, -1.5), 1.5)
             by = _BK_PAD_Y - 2.0
         terminated = False
         if by >= _H - 3.0:                  # dropped ball: life lost
