@@ -110,6 +110,17 @@ class ApexRuntimeConfig:
     # The call BLOCKS until every host joins, so this is a minimum period,
     # not a timer the hosts must hit together.
     sync_every_s: float = 0.05
+    # Loop-responsiveness bound: at most this many train steps per
+    # service-loop pass. The cadence target is a RATIO (grad steps per
+    # inserts); when the learner is slower than the ratio asks, an
+    # unbounded catch-up loop would monopolize the host thread and
+    # starve ingestion/acting (measured: the round-4 CPU calibration
+    # run stalled ingest ~100s at a time). Bounding the per-pass work
+    # keeps actors fed while the learner runs flat out; the debt simply
+    # persists — standard Ape-X "learner as fast as it can" semantics.
+    # Multi-host lockstep stays intact: every host computes the same
+    # bounded step count from agreed counters.
+    train_steps_per_pass: int = 4
     # Learner pipelining: keep up to this many train steps in flight —
     # the host samples/stages upcoming batches and writes completed steps'
     # priorities while the device works (JAX dispatch is async). Priority
@@ -325,6 +336,9 @@ class ApexLearnerService:
         self._ep_accum: Dict[int, np.ndarray] = {}
         self._ep_returns: deque = deque(maxlen=64)
         self.episodes_completed = 0
+        # Pipelined priority bootstraps: (device prios, items, count)
+        # awaiting materialization+insert (see _flush_pending).
+        self._boot_inflight: deque = deque()
         from dist_dqn_tpu.utils.trace import make_tracer
         self.tracer = make_tracer(rt.trace_path, process_name="apex-learner")
         self.global_env_steps = 0
@@ -699,7 +713,20 @@ class ApexLearnerService:
         self._reply_actions(actor, arrays["obs"], t)
 
     def _flush_pending(self, force: bool = False):
-        """Compute initial priorities on-device and insert into the shard."""
+        """Compute initial priorities on-device and insert into the shard.
+
+        The bootstrap is PIPELINED like the train steps: each chunk's
+        jitted |TD| program is dispatched asynchronously and its result
+        is materialized on a later pass, when the device has likely
+        finished. JAX's async dispatch means ``np.asarray`` blocks on
+        the device round-trip — on a remote-tunneled accelerator that
+        is the measured ~70ms dispatch constant PER CHUNK, which a
+        synchronous bootstrap pays on the ingestion critical path
+        (capping it at ~3-4k inserts/s by itself). Items therefore
+        enter the shard up to a few chunks late — a beat of sampling
+        delay with no semantic effect.
+        """
+        self._drain_bootstraps(force)
         if self._pending_count == 0:
             return
         if not force and self._pending_count < _PRIO_CHUNK:
@@ -708,10 +735,12 @@ class ApexLearnerService:
                for k in self._pending[0]}
         self._pending, self._pending_count = [], 0
         n = cat["action"].shape[0]
-        with self.tracer.span("priority.bootstrap", count=n):
-            self._bootstrap_and_insert(cat, n)
+        with self.tracer.span("priority.bootstrap.dispatch", count=n):
+            self._dispatch_bootstraps(cat, n)
+        if force:
+            self._drain_bootstraps(True)
 
-    def _bootstrap_and_insert(self, cat, n: int):
+    def _dispatch_bootstraps(self, cat, n: int):
         jnp = self.jnp
         for lo in range(0, n, _PRIO_CHUNK):
             hi = min(lo + _PRIO_CHUNK, n)
@@ -729,9 +758,28 @@ class ApexLearnerService:
                 jnp.asarray(pad_to(cat["reward"])),
                 jnp.asarray(pad_to(cat["discount"])),
                 jnp.asarray(pad_to(cat["next_obs"])))
-            prios = np.asarray(prios)[:hi - lo]
-            self.replay.add({k: v[lo:hi] for k, v in cat.items()},
-                            priorities=prios)
+            self._boot_inflight.append(
+                (prios, {k: v[lo:hi] for k, v in cat.items()}, hi - lo))
+
+    def _drain_bootstraps(self, block: bool = False):
+        """Insert chunks whose device priorities have materialized.
+
+        Non-blocking by default (``is_ready`` probe where the runtime
+        exposes it); the backlog is bounded — past ``pipeline_depth + 2``
+        chunks the oldest is materialized blocking, so a busy device
+        cannot grow an unbounded not-yet-inserted queue.
+        """
+        limit = self.rt.pipeline_depth + 2
+        while self._boot_inflight:
+            prios, items, count = self._boot_inflight[0]
+            if not block and len(self._boot_inflight) <= limit:
+                ready = getattr(prios, "is_ready", None)
+                if ready is not None and not ready():
+                    return
+            self._boot_inflight.popleft()
+            with self.tracer.span("priority.bootstrap.insert", count=count):
+                self.replay.add(items,
+                                priorities=np.asarray(prios)[:count])
 
     def _sequence_sample(self, items, weights):
         """Host [S, L, ...] arrays -> time-major SequenceSample."""
@@ -810,6 +858,12 @@ class ApexLearnerService:
                          batch_size: int):
         cfg = self.cfg
         jnp = self.jnp
+        # Bounded per pass (see ApexRuntimeConfig.train_steps_per_pass);
+        # identical on every host in the lockstep path because both
+        # operands of the min come from agreed counters.
+        target_grad_steps = min(
+            target_grad_steps,
+            self.grad_steps + max(self.rt.train_steps_per_pass, 1))
         while self.grad_steps < target_grad_steps:
             beta = min(1.0, cfg.replay.importance_exponent
                        + (1 - cfg.replay.importance_exponent)
@@ -942,8 +996,14 @@ class ApexLearnerService:
                             f"replay_shard{suffix}.npz")
 
     def _save_replay_snapshot(self) -> None:
-        if not (self.rt.checkpoint_replay and self.rt.checkpoint_dir
-                and len(self.replay)):
+        if not (self.rt.checkpoint_replay and self.rt.checkpoint_dir):
+            return
+        # Close the pipelined-bootstrap window first: transitions whose
+        # priorities are still in flight (up to a few _PRIO_CHUNKs of
+        # the NEWEST experience) must land in the shard before it is
+        # snapshotted, or a crash-resume permanently drops them.
+        self._flush_pending(force=True)
+        if not len(self.replay):
             return
         path = self._replay_snapshot_path()
         tmp = path + ".tmp"

@@ -141,3 +141,40 @@ def test_shipped_reference_table_covers_all_57_games():
     assert out["games"] == 57
     assert out["median_hns"] == pytest.approx(100.0)
     assert out["mean_hns"] == pytest.approx(100.0)
+
+
+@pytest.mark.slow
+def test_cli_eval_mode_rolls_up_hns_with_shipped_table(tmp_path,
+                                                       monkeypatch,
+                                                       capsys):
+    """`atari57 --mode eval` with NO --scores-json uses the shipped Wang
+    et al. 2016 table (VERDICT round-3 ask #6): the rollup row carries
+    per-game HNS and the aggregates out of the box."""
+    import sys
+    from unittest import mock
+
+    from dist_dqn_tpu import atari57 as a57
+
+    monkeypatch.setenv("DQN_FAKE_ALE", "1")
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="small", hidden=32,
+                                    compute_dtype="float32"))
+    _save_untrained_checkpoint(cfg, 6, tmp_path / "Pong")
+    argv = ["atari57", "--mode", "eval", "--config", "atari",
+            "--platform", "cpu",
+            "--checkpoint-root", str(tmp_path), "--games", "Pong",
+            "--episodes", "1",
+            "--set", "network.torso=small", "--set", "network.hidden=32",
+            "--set", "network.compute_dtype=float32"]
+    with mock.patch.object(sys, "argv", argv):
+        a57.main()
+    rows = [json.loads(line) for line in
+            capsys.readouterr().out.splitlines() if line.startswith("{")]
+    rollup = rows[-1]
+    assert rollup["games_evaluated"] == 1
+    assert "Pong" in rollup["hns"]["per_game"]
+    assert "median_hns" in rollup["hns"]
+    # An untrained policy cannot beat the human reference on the fake.
+    assert rollup["hns"]["per_game"]["Pong"] < 100.0

@@ -98,6 +98,9 @@ def test_apex_service_writes_trace(tmp_path):
     assert result["env_steps"] >= 900
     names = {e["name"] for e in json.load(open(path))}
     assert "ingest.shm_record" in names
-    assert "priority.bootstrap" in names
+    # Bootstrap spans split into dispatch + deferred insert (the
+    # pipelined-bootstrap change): both legs must appear.
+    assert "priority.bootstrap.dispatch" in names
+    assert "priority.bootstrap.insert" in names
     assert "replay.sample" in names and "train_step.dispatch" in names
     assert "replay.update_priorities" in names and "act.batched" in names
