@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-4 tunnel-window playbook as one command (docs/tpu_runs/README.md).
+#
+# Run the MOMENT a probe returns. Phase order is value-per-minute with
+# the riskiest last, and each phase's artifacts are committed before the
+# next phase starts — a mid-window wedge loses nothing already captured.
+# Every underlying stage is pre-sized or self-sizing (probe-derived
+# budgets, sizing gate), so no phase should ever need killing.
+#
+# Usage:  bash benchmarks/run_window.sh
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%d_%H%M)
+
+phase () {
+    local name="$1"; shift
+    echo "=== window phase: $name ==="
+    "$@"
+    local rc=$?
+    git add -A docs/tpu_runs BASELINE.md 2>/dev/null
+    git commit -m "TPU window ${ts}: ${name} artifacts (rc=${rc})" \
+        --allow-empty-message 2>/dev/null || true
+    return $rc
+}
+
+# 1. The full battery: headline bench, learner bench (now with roofline
+#    fields), r2d2 sweep, sampler benches, r2d2 pixel learning, apex
+#    split end-to-end, fake-ALE game learning.
+phase battery python benchmarks/tpu_battery.py \
+    --out-dir "docs/tpu_runs/${ts}_battery" || exit 1
+
+# 2. The user surface on chip: train CLI -> checkpoint -> evaluate.
+phase cli_e2e python benchmarks/cli_e2e.py \
+    --out-dir "docs/tpu_runs/${ts}_cli_e2e" || exit 1
+
+# 3. Headline sweep: ring-size axis at the winning 1024x512 point +
+#    the 1536 point (gate-guarded; the proven-oversized 2048 variant is
+#    excluded and gate-refused).
+phase bench_sweep python benchmarks/bench_sweep.py \
+    --out-dir "docs/tpu_runs/${ts}_sweep" || exit 1
+
+echo "=== window complete: STOP running device jobs (leave the tunnel"
+echo "    clean for the driver's end-of-round bench.py capture) ==="
