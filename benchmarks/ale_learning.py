@@ -119,6 +119,8 @@ def main() -> int:
     p.add_argument("--smoke", action="store_true",
                    help="CPU harness smoke: tiny sizes, bar not enforced "
                         "(1-core boxes cannot learn a game in minutes)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="experiment seed (default: the apex preset's)")
     p.add_argument("--actors", type=int, default=None,
                    help="default: 4 (chip/smoke), 2 (--calibrate-cpu)")
     p.add_argument("--lanes-per-actor", type=int, default=8)
@@ -177,6 +179,8 @@ def main() -> int:
             return gate_rc
 
     cfg = _cfg(args)
+    if args.seed is not None:
+        cfg = dataclasses.replace(cfg, seed=args.seed)
     t0 = time.time()
 
     # Probe phase: all compiles + the sustainable end-to-end rate.

@@ -12,6 +12,15 @@ set -u
 cd "$(dirname "$0")/.."
 ts=$(date -u +%Y%m%d_%H%M)
 
+# Persistent XLA compilation cache: repeated programs across THIS
+# script's stages (bench re-runs, battery stages) skip their 30-90s
+# compiles. Scope note: the exports die with this process — a later
+# capture run in a fresh shell must export the same dir to benefit.
+# If the tunnel backend does not support executable serialization,
+# jax logs a warning and runs uncached — harmless.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${PWD}/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-2}"
+
 phase () {
     local name="$1"; shift
     echo "=== window phase: $name ==="
