@@ -68,6 +68,13 @@ def main() -> int:
     total = "16000" if args.allow_cpu else "128000"
     chunk = "250" if args.allow_cpu else "500"
     eval_every = "8000" if args.allow_cpu else "64000"
+    # The atari preset's 200k-slot device ring OOM'd HBM at compile time
+    # on v5e (16.41G used of 15.75G, 2026-08-01 window) — the ring plus
+    # its sampled-batch gather temporaries don't fit next to the Nature
+    # CNN training program. 65536 slots cover the 128k-frame run's
+    # recency window and compile with ~4G headroom. Both stages get the
+    # override so the checkpoint/config match check sees one config.
+    overrides = [] if args.allow_cpu else ["--set", "replay.capacity=65536"]
 
     try:
         stages = [
@@ -75,12 +82,13 @@ def main() -> int:
              [sys.executable, "-m", "dist_dqn_tpu.train", "--config", config,
               "--total-env-steps", total, "--chunk-iters", chunk,
               "--eval-every-steps", eval_every,
-              "--checkpoint-dir", str(ckpt_dir)] + platform_flags,
+              "--checkpoint-dir", str(ckpt_dir)] + overrides
+             + platform_flags,
              420),
             ("evaluate_cli",
              [sys.executable, "-m", "dist_dqn_tpu.evaluate",
               "--config", config, "--checkpoint-dir", str(ckpt_dir),
-              "--episodes", "5"] + platform_flags,
+              "--episodes", "5"] + overrides + platform_flags,
              300),
         ]
         results = []
