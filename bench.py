@@ -131,7 +131,7 @@ def _sizes(smoke: bool) -> dict:
         "num_envs": _env_int("BENCH_NUM_ENVS", 8 if smoke else 1024),
         "chunk": _env_int("BENCH_CHUNK", 20 if smoke else 200),
         "measure_chunks": _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25),
-        "ring": _env_int("BENCH_RING", 2_048 if smoke else 65_536),
+        "ring": _env_int("BENCH_RING", 2_048 if smoke else 32_768),
         "batch": _env_int("BENCH_BATCH", 32 if smoke else 512),
         "train_every": _env_int("BENCH_TRAIN_EVERY",
                                 CONFIGS["atari"].train_every),
@@ -261,9 +261,10 @@ def _measure(jax, device, smoke: bool):
     cfg = dataclasses.replace(
         cfg,
         actor=dataclasses.replace(cfg.actor, num_envs=num_envs),
-        # 65536 pixel slots ~= 1.8 GB of HBM for the obs ring: big enough to
-        # exercise real sampling, small enough to leave the chip headroom
-        # (a 131k ring was measurably slower on a 16 GB v5e).
+        # 32768 pixel slots ~= 0.9 GB of HBM for the obs ring: big enough to
+        # exercise real sampling, small enough to keep the gather hot —
+        # the 2026-08-01 ring-size sweep measured 598k steps/s at 32k vs
+        # 572k at 65k and 527k at 131k on a 16 GB v5e.
         replay=dataclasses.replace(
             cfg.replay,
             capacity=s["ring"],
