@@ -1,0 +1,220 @@
+"""Full-game Pong learning AT CHIP RATE through the fused on-device loop.
+
+The two existing full-game proofs split along the dev box's constraint:
+the CPU leg learned fake-ALE Pong end-to-end through the REAL
+AtariPreprocessing path (744k frames, 49 min on one core —
+``ale_learning.py --calibrate-cpu``), and the chip leg of that same
+harness is host-bound (~36 frames/s: emulator + actors + service share
+one CPU core), so battery stage 8 cannot reach learning frames inside
+any window budget. This script closes the remaining gap from the other
+side: the FUSED on-device loop — the very program whose throughput is
+the headline bench (bench.py steps this exact env at ~600k
+env-steps/s/chip) — trained until it is WINNING whole games of the
+device-native Pong (envs/pixel_pong.py: ±1 per point, first-to-5
+episodes, tracking opponent, spin). Same production stack as the atari
+config: Nature CNN bf16, uint8 84x84x4 frame stacks, n-step TD, PER
+ring, epsilon-greedy per lane.
+
+Bar (ale_learning convention): FIRST chunk's training episode-return
+window (epsilon ~1 -> the de-facto random baseline, ~-5 of the 5-point
+game) vs the BEST window; cleared iff best >= first + --margin
+(default +2.0 game points). Exit 0 iff cleared.
+
+Wedge discipline: sizes are the bench-proven ones (1024 lanes x batch
+512 x 32k ring — `docs/tpu_runs/20260801_0128_sweep/`), the pre-flight
+sizing gate (utils/sizing.py) refuses anything predicted to overrun
+--budget-seconds, and a wall-clock stop_fn ends the run at the chunk
+boundary that crosses the post-compile budget, so the process always
+exits cleanly on its own.
+
+Usage:  python benchmarks/pong_learning.py [--budget-seconds 300]
+            [--smoke] [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpu_battery import gate_backend  # noqa: E402
+
+
+def _cfg(args):
+    from dist_dqn_tpu.config import CONFIGS
+
+    cfg = CONFIGS["atari"]
+    if args.smoke:
+        # CPU harness check: tiny everything, bar not enforced.
+        return dataclasses.replace(
+            cfg,
+            network=dataclasses.replace(cfg.network, torso="small",
+                                        hidden=32),
+            actor=dataclasses.replace(cfg.actor, num_envs=8,
+                                      epsilon_decay_steps=2_000),
+            replay=dataclasses.replace(cfg.replay, capacity=2_048,
+                                       min_fill=256),
+            learner=dataclasses.replace(cfg.learner, batch_size=16),
+            train_every=2, eval_every_steps=0)
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(
+            cfg.actor, num_envs=args.lanes,
+            epsilon_decay_steps=args.eps_decay_frames),
+        replay=dataclasses.replace(
+            cfg.replay, capacity=args.ring, min_fill=args.min_fill),
+        learner=dataclasses.replace(
+            cfg.learner, batch_size=args.batch_size,
+            learning_rate=args.lr,
+            target_update_period=args.target_update),
+        train_every=args.train_every,
+        eval_every_steps=0,   # training returns are the signal; greedy
+                              # eval would add per-period device programs
+    )
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--budget-seconds", type=float, default=300.0,
+                   help="post-compile wall budget for the learning loop; "
+                        "a stop_fn ends the run at the first chunk "
+                        "boundary past it")
+    p.add_argument("--margin", type=float, default=2.0,
+                   help="improvement over the first (epsilon~1) chunk's "
+                        "episode-return that counts as learning")
+    p.add_argument("--total-env-steps", type=int, default=120_000_000,
+                   help="frame-budget CAP; the wall-clock stop usually "
+                        "fires first")
+    p.add_argument("--lanes", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--ring", type=int, default=131_072,
+               help="4x the bench ring: at 1024 lanes the ring "
+                    "holds 128 iterations of history — replay "
+                    "diversity matters here, throughput does not")
+    p.add_argument("--min-fill", type=int, default=32_768)
+    p.add_argument("--train-every", type=int, default=2,
+                   help="2 -> 0.25 examples/frame: twice the bench "
+                        "cadence's learning signal, still learner-"
+                        "underutilized at batch 512")
+    p.add_argument("--lr", type=float, default=2.5e-4)
+    p.add_argument("--target-update", type=int, default=500)
+    p.add_argument("--eps-decay-frames", type=int, default=8_000_000)
+    p.add_argument("--chunk-iters", type=int, default=250,
+                   help="250 x 1024 lanes = 256k frames per logged chunk")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU harness smoke: tiny sizes, bar not enforced")
+    args = p.parse_args()
+
+    if args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        args.total_env_steps = 16_000
+        args.chunk_iters = 100
+        args.budget_seconds = 120.0
+        platforms = "cpu"
+    else:
+        platforms, gate_rc = gate_backend(allow_cpu=False,
+                                          tool="pong_learning")
+        if gate_rc is not None:
+            return gate_rc
+
+    cfg = _cfg(args)
+
+    if not args.smoke:
+        from dist_dqn_tpu.utils import sizing
+
+        # Wedge-safety analysis. This run is WALL-bounded: the stop_fn
+        # exits cleanly at the first chunk boundary past the budget, so
+        # the worst case is compile + budget + one chunk of overshoot —
+        # independent of the frame cap. The envelope rules (measured
+        # proven-safe lanes/batch/ring) still apply; the gate's
+        # chunk-count cost model does not, because it would bound a
+        # quantity (total frames) that is not what bounds this run.
+        envelope = sizing.check_envelope(
+            num_envs=args.lanes, batch_size=args.batch_size,
+            ring=args.ring)
+        if envelope is not None:
+            print(json.dumps({"sizing": envelope}), flush=True)
+            return 4
+        per_chunk_s = sizing.predict_fused_seconds(
+            num_envs=args.lanes, batch_size=args.batch_size,
+            train_every=args.train_every, chunk_iters=args.chunk_iters,
+            num_chunks=1, compile_s=0.0)
+        worst_case_s = (sizing.COMPILE_BUDGET_S + args.budget_seconds
+                        + per_chunk_s)
+        kill_budget = worst_case_s / sizing.BUDGET_FRACTION
+        print(json.dumps({"sizing": "ok",
+                          "sizing_predicted_s": round(worst_case_s, 1),
+                          "external_timeout_s": round(kill_budget, 0)}),
+              flush=True)
+
+    from dist_dqn_tpu.train import train
+
+    rows = []
+    t_start = time.perf_counter()
+
+    def log(line):
+        print(line, flush=True)
+        try:
+            rows.append(json.loads(line))
+        except (TypeError, ValueError):
+            pass
+
+    state = {"first": None, "deadline": None}
+
+    def stop(row):
+        # The clock starts at the FIRST chunk boundary (compile +
+        # warmup excluded), so the budget buys measured learning time.
+        if state["deadline"] is None:
+            state["deadline"] = time.perf_counter() + args.budget_seconds
+        # Baseline = the first chunk that actually finished episodes
+        # (episode_return is a 0.0 sentinel when episodes == 0).
+        if state["first"] is None and row["episodes"] > 0:
+            state["first"] = row["episode_return"]
+        cleared = (state["first"] is not None
+                   and row["episodes"] > 0
+                   and row["episode_return"]
+                   >= state["first"] + args.margin)
+        return cleared or time.perf_counter() >= state["deadline"]
+
+    carry, history = train(cfg, total_env_steps=args.total_env_steps,
+                           seed=args.seed, chunk_iters=args.chunk_iters,
+                           log_fn=log, stop_fn=stop)
+    wall = time.perf_counter() - t_start
+
+    returns = [r["episode_return"] for r in history if r["episodes"] > 0]
+    if not returns:          # smoke runs can end before any episode does
+        returns = [0.0]
+    first, best = returns[0], max(returns)
+    frames = history[-1]["env_frames"]
+    grad_steps = sum(r["grad_steps_in_chunk"] for r in history)
+    cleared = best >= first + args.margin and not args.smoke
+    summary = {
+        "summary": "pong_learning", "env": cfg.env_name,
+        "platform": platforms, "torso": cfg.network.torso,
+        "lanes": cfg.actor.num_envs, "batch_size": cfg.learner.batch_size,
+        "train_every": cfg.train_every,
+        "first_return": round(float(first), 3),
+        "best_return": round(float(best), 3),
+        "final_return": round(float(returns[-1]), 3),
+        "frames": int(frames), "grad_steps": int(grad_steps),
+        "wall_s": round(wall, 1),
+        "env_steps_per_sec": round(frames / wall, 1),
+        "cleared_bar": bool(cleared), "margin": args.margin,
+        "smoke": args.smoke,
+    }
+    print(json.dumps(summary), flush=True)
+    if args.smoke:
+        return 0
+    return 0 if cleared else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -192,8 +192,12 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
         row = {
             "env_frames": frames,
             "episode_return": float(metrics["episode_return"]),
+            # Disambiguates episode_return's no-episodes sentinel (0.0
+            # with episodes == 0) from a genuine 0.0 average return.
+            "episodes": float(metrics["episodes"]),
             "loss": float(metrics["loss"]),
             "env_steps_per_sec": chunk_iters * B / dt,
+            "grad_steps_in_chunk": float(metrics["grad_steps_in_chunk"]),
             "grad_steps_per_sec": float(metrics["grad_steps_in_chunk"]) / dt,
         }
         if frames >= next_eval:
