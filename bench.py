@@ -131,7 +131,7 @@ def _sizes(smoke: bool) -> dict:
         "num_envs": _env_int("BENCH_NUM_ENVS", 8 if smoke else 1024),
         "chunk": _env_int("BENCH_CHUNK", 20 if smoke else 200),
         "measure_chunks": _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25),
-        "ring": _env_int("BENCH_RING", 2_048 if smoke else 32_768),
+        "ring": _env_int("BENCH_RING", 2_048 if smoke else 16_384),
         "batch": _env_int("BENCH_BATCH", 32 if smoke else 512),
         "train_every": _env_int("BENCH_TRAIN_EVERY",
                                 CONFIGS["atari"].train_every),
@@ -261,10 +261,14 @@ def _measure(jax, device, smoke: bool):
     cfg = dataclasses.replace(
         cfg,
         actor=dataclasses.replace(cfg.actor, num_envs=num_envs),
-        # 32768 pixel slots ~= 0.9 GB of HBM for the obs ring: big enough to
-        # exercise real sampling, small enough to keep the gather hot —
-        # the 2026-08-01 ring-size sweep measured 598k steps/s at 32k vs
-        # 572k at 65k and 527k at 131k on a 16 GB v5e.
+        # 16384 pixel slots ~= 0.5 GB of HBM for the obs ring. The
+        # 2026-08-01 ring-size axis on a 16 GB v5e: 627k/619k/605k/572k/
+        # 527k env-steps/s at 8k/16k/32k/65k/131k slots — smaller rings
+        # keep the PER tree + stack-gather hot. 16k is the default: near
+        # the knee while still a credible replay window (16 iterations of
+        # history at 1024 lanes; PER sampling work is size-independent
+        # per draw). Production configs size their rings for learning
+        # (e.g. atari: 200k), not for this contract metric.
         replay=dataclasses.replace(
             cfg.replay,
             capacity=s["ring"],
