@@ -46,8 +46,11 @@ VARIANTS = {
                           "BENCH_TRAIN_EVERY": "2"},
     "lanes256_b128":     {"BENCH_NUM_ENVS": "256", "BENCH_BATCH": "128",
                           "BENCH_TRAIN_EVERY": "4"},
-    # Ring-size axis at the winning 1024x512 point (both ring sizes ran
-    # on-chip before: 131k in round 1, 64k default everywhere).
+    # Ring-size axis at the winning 1024x512 point. Measured 2026-08-01:
+    # 627k/619k/598k/572k/527k env-steps/s at 8k/16k/32k/65k/131k slots
+    # (16k is now the bench.py default; 8k is past the credibility knee).
+    "lanes1024_ring8k":  {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                          "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "8192"},
     "lanes1024_ring32k": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
                           "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "32768"},
     "lanes1024_ring131k": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
@@ -69,7 +72,8 @@ OVERSIZED = ("lanes2048_b1024",)
 # winning point), re-measurements of known points after, the one
 # unproven size last.
 DEFAULT_VARIANTS = [
-    "lanes1024_b512", "lanes1024_ring32k", "lanes1024_ring131k",
+    "lanes1024_b512", "lanes1024_ring8k", "lanes1024_ring32k",
+    "lanes1024_ring131k",
     "default_512x256", "lanes1024_b256te2", "lanes256_b128",
     "lanes1536_b768",
 ]
