@@ -45,6 +45,34 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from tpu_battery import gate_backend  # noqa: E402
 
 
+def _apply_head(cfg, head: str):
+    """Head surgery mirroring tests/test_pixel_learning.py, with C51's
+    support sized to Pong's ±5 returns. dqn = the atari config as-is."""
+    import dataclasses as dc
+
+    if head == "dqn":
+        return cfg
+    if head == "c51":
+        net = dc.replace(cfg.network, num_atoms=51, v_min=-6.0, v_max=6.0)
+        return dc.replace(cfg, network=net)
+    if head == "qrdqn":
+        return dc.replace(cfg, network=dc.replace(cfg.network,
+                                                  num_atoms=64,
+                                                  quantile=True))
+    if head == "iqn":
+        return dc.replace(cfg, network=dc.replace(
+            cfg.network, iqn=True, iqn_embed_dim=32, iqn_tau_samples=16,
+            iqn_tau_target_samples=16, iqn_tau_act=16))
+    if head == "mdqn":
+        # Munchausen requires n_step=1 (LearnerConfig.munchausen);
+        # train_every=1 compensates the slower credit propagation.
+        return dc.replace(
+            cfg, learner=dc.replace(cfg.learner, munchausen=True,
+                                    double_dqn=False, n_step=1),
+            train_every=1)
+    raise ValueError(head)
+
+
 def _cfg(args):
     from dist_dqn_tpu.config import CONFIGS
 
@@ -61,7 +89,7 @@ def _cfg(args):
                                        min_fill=256),
             learner=dataclasses.replace(cfg.learner, batch_size=16),
             train_every=2, eval_every_steps=0)
-    return dataclasses.replace(
+    cfg = dataclasses.replace(
         cfg,
         actor=dataclasses.replace(
             cfg.actor, num_envs=args.lanes,
@@ -76,6 +104,7 @@ def _cfg(args):
         eval_every_steps=0,   # training returns are the signal; greedy
                               # eval would add per-period device programs
     )
+    return _apply_head(cfg, args.head)
 
 
 def main() -> int:
@@ -107,6 +136,10 @@ def main() -> int:
     p.add_argument("--chunk-iters", type=int, default=250,
                    help="250 x 1024 lanes = 256k frames per logged chunk")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--head", default="dqn",
+                   choices=["dqn", "c51", "qrdqn", "iqn", "mdqn"],
+                   help="algorithm family on the same torso/replay stack "
+                        "(surgery mirrors tests/test_pixel_learning.py)")
     p.add_argument("--smoke", action="store_true",
                    help="CPU harness smoke: tiny sizes, bar not enforced")
     args = p.parse_args()
@@ -198,6 +231,7 @@ def main() -> int:
     cleared = best >= first + args.margin and not args.smoke
     summary = {
         "summary": "pong_learning", "env": cfg.env_name,
+        "head": args.head,
         "platform": platforms, "torso": cfg.network.torso,
         "lanes": cfg.actor.num_envs, "batch_size": cfg.learner.batch_size,
         "train_every": cfg.train_every,
