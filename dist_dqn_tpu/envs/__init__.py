@@ -21,6 +21,9 @@ def make_jax_env(name: str, **kwargs):
     if name == "pixel_catch":
         from dist_dqn_tpu.envs.pixel_catch import PixelCatch
         return PixelCatch(**kwargs)
+    if name == "pixel_breakout":
+        from dist_dqn_tpu.envs.pixel_breakout import PixelBreakout
+        return PixelBreakout(**kwargs)
     if name == "dmc_pixels":
         # The fused on-device loop cannot host MuJoCo; it runs the synthetic
         # DMC-shaped reacher. Real dm_control pixels go through the host
