@@ -91,6 +91,7 @@ def _cfg(args):
             train_every=2, eval_every_steps=0)
     cfg = dataclasses.replace(
         cfg,
+        env_name=args.env,
         actor=dataclasses.replace(
             cfg.actor, num_envs=args.lanes,
             epsilon_decay_steps=args.eps_decay_frames),
@@ -113,9 +114,16 @@ def main() -> int:
                    help="post-compile wall budget for the learning loop; "
                         "a stop_fn ends the run at the first chunk "
                         "boundary past it")
-    p.add_argument("--margin", type=float, default=2.0,
+    p.add_argument("--env", default="pixel_pong",
+                   choices=["pixel_pong", "pixel_breakout"],
+                   help="device-native game (envs/pixel_pong.py ±5 "
+                        "rally game; envs/pixel_breakout.py 72-brick "
+                        "wall with fire-to-serve and 5 lives)")
+    p.add_argument("--margin", type=float, default=None,
                    help="improvement over the first (epsilon~1) chunk's "
-                        "episode-return that counts as learning")
+                        "episode-return that counts as learning "
+                        "(default per env: pong +2.0 of the ±5 game, "
+                        "breakout +15 bricks over random's ~6)")
     p.add_argument("--total-env-steps", type=int, default=120_000_000,
                    help="frame-budget CAP; the wall-clock stop usually "
                         "fires first")
@@ -143,6 +151,8 @@ def main() -> int:
     p.add_argument("--smoke", action="store_true",
                    help="CPU harness smoke: tiny sizes, bar not enforced")
     args = p.parse_args()
+    if args.margin is None:
+        args.margin = {"pixel_pong": 2.0, "pixel_breakout": 15.0}[args.env]
 
     if args.smoke:
         import jax
