@@ -77,6 +77,43 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     use_pallas, pallas_interpret = loop_common.pallas_routing(
         prioritized and cfg.replay.pallas_sampler)
 
+    # Multi-dim obs can be STORED FLAT in the ring ([slots, B, 28224]
+    # for 84x84x4) and reshaped at the insert/sample boundary: XLA lays
+    # out multi-dim u8 buffers with (8,128) tiling on the minor dims,
+    # padding 84x84 to ~1.6x its logical bytes (measured: the atari
+    # config's 200k-slot ring was 8.39G padded vs 5.26G flat in the
+    # 2026-08-01 compile OOM) — but the tiled layout also gathers ~3%
+    # faster at small rings (619k vs 602k env-steps/s at 16k slots).
+    # Auto rule (cfg.replay.flat_storage=None): flat only when the
+    # ring's logical bytes exceed _FLAT_AUTO_BYTES, where memory wins.
+    _obs_shape = tuple(env.observation_shape)
+    _FLAT_AUTO_BYTES = 2 << 30
+    if cfg.replay.flat_storage is None:
+        _obs_bytes = (num_slots * B
+                      * int(jnp.dtype(env.observation_dtype).itemsize))
+        for d in _obs_shape:
+            _obs_bytes *= d
+        flat_storage = (len(_obs_shape) >= 2
+                        and _obs_bytes * (2 if store_final else 1)
+                        > _FLAT_AUTO_BYTES)
+    else:
+        flat_storage = cfg.replay.flat_storage and len(_obs_shape) >= 2
+
+    def _flatten_batched(tree):
+        """[B, *obs_shape] leaves -> [B, prod] (pass-through when tiled)."""
+        if not flat_storage:
+            return tree
+        return jax.tree.map(
+            lambda x: x.reshape(x.shape[0], -1) if x.ndim >= 3 else x,
+            tree)
+
+    def _unflatten_batched(tree):
+        """[N, prod] leaves -> [N, *obs_shape]."""
+        if not flat_storage:
+            return tree
+        return jax.tree.map(
+            lambda x: x.reshape((x.shape[0],) + _obs_shape), tree)
+
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay
 
@@ -103,11 +140,14 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         # phys vector); the carry is donated, so every leaf must be distinct.
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
+        ring_example = (jax.tree.map(
+            lambda x: x.reshape(-1) if x.ndim >= 2 else x, obs_example)
+            if flat_storage else obs_example)
         if prioritized:
             replay = pring.prioritized_ring_init(
-                num_slots, B, obs_example, store_final_obs=store_final)
+                num_slots, B, ring_example, store_final_obs=store_final)
         else:
-            replay = ring.time_ring_init(num_slots, B, obs_example,
+            replay = ring.time_ring_init(num_slots, B, ring_example,
                                          store_final_obs=store_final)
         learner = init_learner(k_learn, obs_example)
         zero = jnp.float32(0.0)
@@ -126,9 +166,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         env_state, out = env.v_step(carry.env_state, actions)
         add = (pring.prioritized_ring_add if prioritized
                else ring.time_ring_add)
-        replay = add(carry.replay, carry.obs, actions, out.reward,
-                     out.terminated, out.truncated,
-                     final_obs=out.next_obs if store_final else None)
+        replay = add(carry.replay, _flatten_batched(carry.obs), actions,
+                     out.reward, out.terminated, out.truncated,
+                     final_obs=_flatten_batched(out.next_obs)
+                     if store_final else None)
         beta = beta_at(carry.iteration)
 
         def do_train(operand):
@@ -142,7 +183,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                         cfg.learner.gamma, cfg.replay.priority_exponent,
                         beta, use_pallas=use_pallas,
                         pallas_interpret=pallas_interpret)
-                    l, metrics = train_step(l, s.batch, s.weights)
+                    batch = s.batch._replace(
+                        obs=_unflatten_batched(s.batch.obs),
+                        next_obs=_unflatten_batched(s.batch.next_obs))
+                    l, metrics = train_step(l, batch, s.weights)
                     rep = pring.prioritized_ring_update(
                         rep, s.t_idx, s.b_idx, metrics["priorities"],
                         eps=cfg.replay.priority_eps)
@@ -150,6 +194,9 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                     batch = ring.time_ring_sample(rep, key, batch_size,
                                                   cfg.learner.n_step,
                                                   cfg.learner.gamma)
+                    batch = batch._replace(
+                        obs=_unflatten_batched(batch.obs),
+                        next_obs=_unflatten_batched(batch.next_obs))
                     l, metrics = train_step(l, batch)
                 return (l, rep), metrics["loss"]
 

@@ -14,14 +14,16 @@ import pytest
 
 pytestmark = pytest.mark.slow  # convergence/multiprocess: full-suite selection only
 
-def test_atari_config_fused_smoke():
+@pytest.mark.parametrize("flat", [False, True], ids=["tiled", "flat"])
+def test_atari_config_fused_smoke(flat):
     cfg = CONFIGS["atari"]
     cfg = dataclasses.replace(
         cfg,
         network=dataclasses.replace(cfg.network, hidden=64,
                                     compute_dtype="float32"),
         actor=dataclasses.replace(cfg.actor, num_envs=4),
-        replay=dataclasses.replace(cfg.replay, capacity=256, min_fill=32),
+        replay=dataclasses.replace(cfg.replay, capacity=256, min_fill=32,
+                                   flat_storage=flat),
         learner=dataclasses.replace(cfg.learner, batch_size=8),
         train_every=4,
     )
@@ -34,10 +36,16 @@ def test_atari_config_fused_smoke():
     assert int(metrics["env_frames"]) == 48 * 4
     assert float(metrics["grad_steps_in_chunk"]) > 0
     assert abs(float(metrics["loss"])) < 1e3
-    # uint8 pixel ring: final_obs not stored (memory), stack shape honored.
+    # uint8 pixel ring: final_obs not stored (memory). Storage layout is
+    # the replay.flat_storage knob: tiled keeps the obs shape (faster
+    # gathers), flat stores [slots, B, 28224] to dodge ~1.6x XLA tile
+    # padding on multi-GB rings (train_loop.py; the sample path reshapes
+    # back before the learner sees the batch — this parametrization runs
+    # the SAME training both ways).
     ring = carry.replay
     assert ring.final_obs is None
-    assert ring.obs.shape[2:] == (84, 84, 4)
+    expected = (84 * 84 * 4,) if flat else (84, 84, 4)
+    assert ring.obs.shape[2:] == expected
     assert ring.obs.dtype.name == "uint8"
 
 
@@ -64,3 +72,38 @@ def test_store_final_obs_override_enables_exact_truncation_path():
     carry, metrics = jax.jit(run_chunk, static_argnums=1,
                              donate_argnums=0)(carry, 24)
     assert abs(float(metrics["loss"])) < 1e3
+
+
+def test_flat_storage_bit_equal_to_tiled():
+    """Ring storage layout must be invisible to training: the same seed
+    run under tiled and flat storage yields bit-identical learner
+    params (reshape is a pure re-layout; any divergence means the
+    insert/sample boundary changed numerics)."""
+    import numpy as np
+
+    def run(flat):
+        cfg = CONFIGS["atari"]
+        cfg = dataclasses.replace(
+            cfg,
+            network=dataclasses.replace(cfg.network, hidden=32,
+                                        compute_dtype="float32"),
+            actor=dataclasses.replace(cfg.actor, num_envs=4),
+            replay=dataclasses.replace(cfg.replay, capacity=128,
+                                       min_fill=24, flat_storage=flat),
+            learner=dataclasses.replace(cfg.learner, batch_size=8),
+            train_every=4,
+        )
+        env = make_jax_env(cfg.env_name)
+        net = build_network(cfg.network, env.num_actions)
+        init, run_chunk = make_fused_train(cfg, env, net)
+        run_j = jax.jit(run_chunk, static_argnums=1)
+        carry = init(jax.random.PRNGKey(7))
+        carry, metrics = run_j(carry, 40)
+        return jax.device_get(carry.learner.params), \
+            float(metrics["loss"])
+
+    p_tiled, loss_tiled = run(False)
+    p_flat, loss_flat = run(True)
+    assert loss_tiled == loss_flat
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 p_tiled, p_flat)
