@@ -45,9 +45,25 @@ class TimeRingState(NamedTuple):
 
 
 def time_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
-                   store_final_obs: bool = False) -> TimeRingState:
-    """Allocate a zeroed ring; ``obs_example`` fixes per-env obs shape/dtype."""
+                   store_final_obs: bool = False,
+                   merge_obs_rows: bool = False) -> TimeRingState:
+    """Allocate a zeroed ring; ``obs_example`` fixes per-env obs shape/dtype.
+
+    ``merge_obs_rows`` stores obs leaves as ``[num_slots * num_envs, ...]``
+    instead of ``[num_slots, num_envs, ...]``. Same records, same order —
+    slot ``t`` of env ``b`` lives at row ``t * num_envs + b`` — but a 2-D
+    buffer is immune to XLA layout assignment putting a small dim (the
+    lanes) minormost and tile-padding it: measured on v5e (2026-08-01),
+    the atari config's 200k-slot flat ring compiled at 10.51G as
+    ``[3125, 64, 28224]`` (lanes padded 64->128, 2.0x) vs its 5.26G
+    logical size as ``[200000, 28224]``. Callers pass the same flag to
+    add/gather/sample. Only obs/final_obs merge; the small per-step
+    fields keep ``[T, B]`` (their padding is irrelevant and the n-step
+    window math wants the time axis explicit).
+    """
     def zeros(x):
+        if merge_obs_rows:
+            return jnp.zeros((num_slots * num_envs,) + x.shape, x.dtype)
         return jnp.zeros((num_slots, num_envs) + x.shape, x.dtype)
 
     obs = jax.tree.map(zeros, obs_example)
@@ -66,21 +82,29 @@ def time_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
 
 def time_ring_add(state: TimeRingState, obs: PyTree, action: Array,
                   reward: Array, terminated: Array, truncated: Array,
-                  final_obs: PyTree = None) -> TimeRingState:
+                  final_obs: PyTree = None,
+                  merge_obs_rows: bool = False) -> TimeRingState:
     """Append one time slice (all envs) at ``pos``; wraps around."""
-    num_slots = state.action.shape[0]
+    num_slots, num_envs = state.action.shape
     p = state.pos
 
     def write(buf, x):
         return buf.at[p].set(x)
 
+    def write_obs(buf, x):
+        if merge_obs_rows:
+            # Rows [p*B, (p+1)*B) — x is the [B, ...] time slice.
+            start = (p * num_envs,) + (0,) * (buf.ndim - 1)
+            return jax.lax.dynamic_update_slice(buf, x, start)
+        return buf.at[p].set(x)
+
     return TimeRingState(
-        obs=jax.tree.map(write, state.obs, obs),
+        obs=jax.tree.map(write_obs, state.obs, obs),
         action=write(state.action, action.astype(jnp.int32)),
         reward=write(state.reward, reward.astype(jnp.float32)),
         terminated=write(state.terminated, terminated),
         truncated=write(state.truncated, truncated),
-        final_obs=jax.tree.map(write, state.final_obs, final_obs)
+        final_obs=jax.tree.map(write_obs, state.final_obs, final_obs)
         if state.final_obs is not None else None,
         pos=(p + 1) % num_slots,
         size=jnp.minimum(state.size + 1, num_slots),
@@ -134,25 +158,31 @@ def compute_n_step(reward_w: Array, term_w: Array, trunc_w: Array,
 
 
 def gather_transitions(state: TimeRingState, t_idx: Array, b_idx: Array,
-                       n_step: int, gamma: float) -> Transition:
+                       n_step: int, gamma: float,
+                       merge_obs_rows: bool = False) -> Transition:
     """Window-gather + n-step fold for explicit (t_idx, b_idx) pairs.
 
     Shared by the uniform and prioritized samplers so the episode-boundary
     semantics live in exactly one place.
     """
-    num_slots = state.action.shape[0]
+    num_slots, num_envs = state.action.shape
     reward_w = _gather_window(state.reward, t_idx, b_idx, n_step, num_slots)
     term_w = _gather_window(state.terminated, t_idx, b_idx, n_step, num_slots)
     trunc_w = _gather_window(state.truncated, t_idx, b_idx, n_step, num_slots)
     returns, discount, kstar = compute_n_step(reward_w, term_w, trunc_w,
                                               gamma)
 
-    obs = jax.tree.map(lambda x: x[t_idx, b_idx], state.obs)
+    def take(tree, t):
+        if merge_obs_rows:
+            return jax.tree.map(lambda x: x[t * num_envs + b_idx], tree)
+        return jax.tree.map(lambda x: x[t, b_idx], tree)
+
+    obs = take(state.obs, t_idx)
     action = state.action[t_idx, b_idx]
     if state.final_obs is not None:
         # Exact path: the stored pre-reset successor of step k*.
         boot_t = (t_idx + kstar) % num_slots
-        next_obs = jax.tree.map(lambda x: x[boot_t, b_idx], state.final_obs)
+        next_obs = take(state.final_obs, boot_t)
     else:
         # The next slot's obs is post-reset at episode ends, so it is only a
         # valid bootstrap within an episode: zero the discount at truncation
@@ -161,13 +191,14 @@ def gather_transitions(state: TimeRingState, t_idx: Array, b_idx: Array,
                                          axis=-1)[:, 0]
         discount = discount * (1.0 - trunc_at_k.astype(jnp.float32))
         boot_t = (t_idx + kstar + 1) % num_slots
-        next_obs = jax.tree.map(lambda x: x[boot_t, b_idx], state.obs)
+        next_obs = take(state.obs, boot_t)
     return Transition(obs=obs, action=action, reward=returns,
                       discount=discount, next_obs=next_obs)
 
 
 def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
-                     n_step: int, gamma: float) -> Transition:
+                     n_step: int, gamma: float,
+                     merge_obs_rows: bool = False) -> Transition:
     """Uniformly sample ``batch_size`` n-step transitions.
 
     Valid window starts are the oldest ``size - n_step`` slots, so the
@@ -180,4 +211,5 @@ def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
     u = jax.random.randint(k_t, (batch_size,), 0, jnp.maximum(num_valid, 1))
     t_idx = (state.pos - state.size + u) % num_slots
     b_idx = jax.random.randint(k_b, (batch_size,), 0, num_envs)
-    return gather_transitions(state, t_idx, b_idx, n_step, gamma)
+    return gather_transitions(state, t_idx, b_idx, n_step, gamma,
+                              merge_obs_rows=merge_obs_rows)

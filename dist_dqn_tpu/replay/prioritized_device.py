@@ -38,11 +38,13 @@ class PrioritizedSample(NamedTuple):
 
 
 def prioritized_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
-                          store_final_obs: bool = False
+                          store_final_obs: bool = False,
+                          merge_obs_rows: bool = False
                           ) -> PrioritizedRingState:
     return PrioritizedRingState(
         ring=ring.time_ring_init(num_slots, num_envs, obs_example,
-                                 store_final_obs=store_final_obs),
+                                 store_final_obs=store_final_obs,
+                                 merge_obs_rows=merge_obs_rows),
         priorities=jnp.zeros((num_slots, num_envs), jnp.float32),
         max_priority=jnp.float32(1.0),
     )
@@ -50,14 +52,16 @@ def prioritized_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
 
 def prioritized_ring_add(state: PrioritizedRingState, obs: PyTree,
                          action: Array, reward: Array, terminated: Array,
-                         truncated: Array, final_obs: PyTree = None
+                         truncated: Array, final_obs: PyTree = None,
+                         merge_obs_rows: bool = False
                          ) -> PrioritizedRingState:
     """Append a time slice; fresh transitions get the running max priority
     so every new experience is sampled at least once with high probability
     (standard Ape-X seeding)."""
     p = state.ring.pos
     new_ring = ring.time_ring_add(state.ring, obs, action, reward,
-                                  terminated, truncated, final_obs=final_obs)
+                                  terminated, truncated, final_obs=final_obs,
+                                  merge_obs_rows=merge_obs_rows)
     priorities = state.priorities.at[p].set(
         jnp.full((state.priorities.shape[1],), state.max_priority))
     return PrioritizedRingState(ring=new_ring, priorities=priorities,
@@ -78,7 +82,8 @@ def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
                             batch_size: int, n_step: int, gamma: float,
                             alpha: float, beta: Array,
                             use_pallas: bool = False,
-                            pallas_interpret: bool = False
+                            pallas_interpret: bool = False,
+                            merge_obs_rows: bool = False
                             ) -> PrioritizedSample:
     """Stratified sample ~ P(i) = p_i^alpha / sum p^alpha over valid slots.
 
@@ -98,7 +103,8 @@ def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
         interpret=pallas_interpret)
     weights = importance_weights(mass_sel, total, n_valid, beta)
 
-    batch = ring.gather_transitions(state.ring, t_idx, b_idx, n_step, gamma)
+    batch = ring.gather_transitions(state.ring, t_idx, b_idx, n_step, gamma,
+                                    merge_obs_rows=merge_obs_rows)
     return PrioritizedSample(batch=batch, weights=weights, t_idx=t_idx,
                              b_idx=b_idx)
 

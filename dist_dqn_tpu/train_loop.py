@@ -77,15 +77,17 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     use_pallas, pallas_interpret = loop_common.pallas_routing(
         prioritized and cfg.replay.pallas_sampler)
 
-    # Multi-dim obs can be STORED FLAT in the ring ([slots, B, 28224]
-    # for 84x84x4) and reshaped at the insert/sample boundary: XLA lays
-    # out multi-dim u8 buffers with (8,128) tiling on the minor dims,
-    # padding 84x84 to ~1.6x its logical bytes (measured: the atari
-    # config's 200k-slot ring was 8.39G padded vs 5.26G flat in the
-    # 2026-08-01 compile OOM) — but the tiled layout also gathers ~3%
-    # faster at small rings (619k vs 602k env-steps/s at 16k slots).
-    # Auto rule (cfg.replay.flat_storage=None): flat only when the
-    # ring's logical bytes exceed _FLAT_AUTO_BYTES, where memory wins.
+    # Multi-dim obs can be STORED FLAT in the ring — [slots*B, 28224]
+    # for 84x84x4, via replay/device.py merge_obs_rows — with reshapes
+    # at the insert/sample boundary: XLA lays out multi-dim u8 ring
+    # buffers with (8,128) tiling on whichever dims it puts minormost,
+    # padding 84x84 to ~1.6x its logical bytes, and a [slots, B, flat]
+    # 3-D form to 2.0x (lanes transposed minormost and padded 64->128 —
+    # both measured in the 2026-08-01 compile OOMs). A 2-D merged-row
+    # buffer pads <1%, but the tiled layout also gathers ~3% faster at
+    # small rings (619k vs 602k env-steps/s at 16k slots). Auto rule
+    # (cfg.replay.flat_storage=None): flat only when the ring's logical
+    # bytes exceed _FLAT_AUTO_BYTES, where memory dominates.
     _obs_shape = tuple(env.observation_shape)
     _FLAT_AUTO_BYTES = 2 << 30
     if cfg.replay.flat_storage is None:
@@ -145,10 +147,12 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
             if flat_storage else obs_example)
         if prioritized:
             replay = pring.prioritized_ring_init(
-                num_slots, B, ring_example, store_final_obs=store_final)
+                num_slots, B, ring_example, store_final_obs=store_final,
+                merge_obs_rows=flat_storage)
         else:
             replay = ring.time_ring_init(num_slots, B, ring_example,
-                                         store_final_obs=store_final)
+                                         store_final_obs=store_final,
+                                         merge_obs_rows=flat_storage)
         learner = init_learner(k_learn, obs_example)
         zero = jnp.float32(0.0)
         return TrainCarry(env_state=env_state, obs=obs, replay=replay,
@@ -169,7 +173,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         replay = add(carry.replay, _flatten_batched(carry.obs), actions,
                      out.reward, out.terminated, out.truncated,
                      final_obs=_flatten_batched(out.next_obs)
-                     if store_final else None)
+                     if store_final else None,
+                     merge_obs_rows=flat_storage)
         beta = beta_at(carry.iteration)
 
         def do_train(operand):
@@ -182,7 +187,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                         rep, key, batch_size, cfg.learner.n_step,
                         cfg.learner.gamma, cfg.replay.priority_exponent,
                         beta, use_pallas=use_pallas,
-                        pallas_interpret=pallas_interpret)
+                        pallas_interpret=pallas_interpret,
+                        merge_obs_rows=flat_storage)
                     batch = s.batch._replace(
                         obs=_unflatten_batched(s.batch.obs),
                         next_obs=_unflatten_batched(s.batch.next_obs))
@@ -193,7 +199,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                 else:
                     batch = ring.time_ring_sample(rep, key, batch_size,
                                                   cfg.learner.n_step,
-                                                  cfg.learner.gamma)
+                                                  cfg.learner.gamma,
+                                                  merge_obs_rows=flat_storage)
                     batch = batch._replace(
                         obs=_unflatten_batched(batch.obs),
                         next_obs=_unflatten_batched(batch.next_obs))

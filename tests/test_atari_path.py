@@ -37,15 +37,19 @@ def test_atari_config_fused_smoke(flat):
     assert float(metrics["grad_steps_in_chunk"]) > 0
     assert abs(float(metrics["loss"])) < 1e3
     # uint8 pixel ring: final_obs not stored (memory). Storage layout is
-    # the replay.flat_storage knob: tiled keeps the obs shape (faster
-    # gathers), flat stores [slots, B, 28224] to dodge ~1.6x XLA tile
-    # padding on multi-GB rings (train_loop.py; the sample path reshapes
-    # back before the learner sees the batch — this parametrization runs
-    # the SAME training both ways).
+    # the replay.flat_storage knob: tiled keeps [slots, B, 84, 84, 4]
+    # (faster gathers), flat stores merged 2-D rows [slots*B, 28224] —
+    # immune to XLA tile padding on multi-GB rings (train_loop.py /
+    # replay/device.py merge_obs_rows; the sample path reshapes back
+    # before the learner sees the batch — this parametrization runs the
+    # SAME training both ways).
     ring = carry.replay
     assert ring.final_obs is None
-    expected = (84 * 84 * 4,) if flat else (84, 84, 4)
-    assert ring.obs.shape[2:] == expected
+    if flat:
+        assert ring.obs.shape == (ring.action.shape[0]
+                                  * ring.action.shape[1], 84 * 84 * 4)
+    else:
+        assert ring.obs.shape[2:] == (84, 84, 4)
     assert ring.obs.dtype.name == "uint8"
 
 
