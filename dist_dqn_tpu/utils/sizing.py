@@ -55,12 +55,50 @@ BUDGET_FRACTION = 0.6       # predicted time must fit in this fraction of the ki
 
 # Envelope rules (v5e, incident #3). "Proven safe" = the largest sizes
 # that completed a measured run on this box's chip; update when a larger
-# size completes cleanly.
-PROVEN_SAFE = {"num_envs": 1024, "batch_size": 512, "ring": 131_072}
+# size completes cleanly. ring=200_000: the atari preset's full ring
+# trained clean under merged-row flat storage (2026-08-01, rc=0).
+PROVEN_SAFE = {"num_envs": 1024, "batch_size": 512, "ring": 200_000}
 # Measured failures: configs at or beyond these sizes died mid-window.
 KNOWN_BAD = {"num_envs": 2048}
 
 OVERRIDE_ENV = "BENCH_ALLOW_UNPROVEN"
+
+# HBM model (v5e, calibrated on the two 2026-08-01 compile OOMs and the
+# successful flat-200k run). XLA's layout padding on the ring buffer:
+# tiled multi-dim u8 pads ~1.6x (84x84 at (8,128) tiles); the 2-D
+# merged-row flat layout pads <2%. The compiler's accounting kept ~2
+# copies of the ring live in both OOMs (donation alias not elided at
+# the failure point), so the gate charges ring x2 plus a measured
+# ~1.5G program residue (CNN params/activations/env lanes).
+HBM_CAPACITY_BYTES = 15.75e9
+HBM_REFUSE_BYTES = 15.0e9
+RING_PAD_TILED = 1.6
+RING_PAD_FLAT = 1.02
+FLAT_AUTO_BYTES = float(2 << 30)   # mirror train_loop's auto rule
+PROGRAM_RESIDUE_BYTES = 1.5e9
+
+
+def predict_fused_hbm_bytes(*, ring: int, pixel_obs: bool = True,
+                            obs_elems: int = 84 * 84 * 4,
+                            obs_itemsize: int = 1,
+                            store_final_obs: bool = False,
+                            flat_storage: Optional[bool] = None) -> float:
+    """Conservative HBM footprint of a fused-loop device program.
+
+    ``ring`` is the TOTAL capacity in transitions (the config knob, not
+    per-lane slots). The flat/tiled padding factor mirrors
+    train_loop.py's ``replay.flat_storage`` auto rule so the prediction
+    matches what the program will actually allocate.
+    """
+    if not pixel_obs:
+        return PROGRAM_RESIDUE_BYTES
+    logical = float(ring) * obs_elems * obs_itemsize
+    if store_final_obs:
+        logical *= 2
+    flat = (logical > FLAT_AUTO_BYTES if flat_storage is None
+            else flat_storage)
+    padded = logical * (RING_PAD_FLAT if flat else RING_PAD_TILED)
+    return padded * 2 + PROGRAM_RESIDUE_BYTES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +202,18 @@ def gate_fused(*, budget_s: float, num_envs: int, batch_size: int,
                               ring=ring, pixel_obs=pixel_obs)
     if envelope is not None:
         return SizingVerdict(False, predicted, budget_s, envelope)
+    if ring is not None and not _override_active():
+        hbm = predict_fused_hbm_bytes(ring=ring, pixel_obs=pixel_obs)
+        if hbm > HBM_REFUSE_BYTES:
+            return SizingVerdict(
+                False, predicted, budget_s,
+                f"predicted HBM {hbm / 1e9:.1f}G exceeds the "
+                f"{HBM_REFUSE_BYTES / 1e9:.1f}G gate (v5e has "
+                f"{HBM_CAPACITY_BYTES / 1e9:.2f}G): the ring is too "
+                "large for the chip even in the merged-row flat layout "
+                "— shrink replay.capacity. (An HBM compile OOM exits "
+                "cleanly, but costs a window its compile minutes; "
+                f"{OVERRIDE_ENV}=1 to deliberately risk it)")
     limit = BUDGET_FRACTION * budget_s
     if predicted > limit:
         return SizingVerdict(

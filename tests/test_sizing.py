@@ -93,3 +93,29 @@ def test_prediction_is_conservative_vs_measured():
                           train_every=4, chunk_iters=200, num_chunks=27,
                           ring=65_536)
     assert 170.0 < v.predicted_s < 540.0
+
+
+def test_hbm_gate_refuses_oversized_ring():
+    """A 390k-slot pixel ring (~11G logical, inside the <=2x-of-proven
+    envelope now that 200k is proven) cannot fit v5e HBM even merged-row
+    flat; the gate must refuse BEFORE the compile OOM burns window
+    minutes."""
+    v = sizing.gate_fused(budget_s=10_000.0, num_envs=64, batch_size=256,
+                          train_every=4, chunk_iters=500, num_chunks=4,
+                          ring=390_000)
+    assert not v.ok
+    assert "HBM" in v.reason
+
+
+def test_hbm_model_admits_the_proven_configs():
+    """The measured-good configs must pass: the bench default (16k tiled),
+    cli_e2e's 65k tiled, and the atari preset's 200k ring under the
+    auto-flat rule (verified rc=0 on chip 2026-08-01). The same 200k
+    ring FORCED tiled is the measured 16.41G compile OOM and must be
+    predicted over the gate."""
+    for ring in (16_384, 65_536, 200_000):
+        hbm = sizing.predict_fused_hbm_bytes(ring=ring)
+        assert hbm < sizing.HBM_REFUSE_BYTES, (ring, hbm)
+    forced_tiled = sizing.predict_fused_hbm_bytes(ring=200_000,
+                                                  flat_storage=False)
+    assert forced_tiled > sizing.HBM_REFUSE_BYTES
