@@ -47,7 +47,9 @@ def test_incident_3_config_refused():
 
 @pytest.mark.parametrize("kwargs,fragment", [
     (dict(num_envs=1024, batch_size=1100, ring=65_536), "batch_size"),
-    (dict(num_envs=1024, batch_size=512, ring=300_000), "ring"),
+    # >2x the proven 200k ring (a 300-390k ring instead hits the HBM
+    # gate first — see test_hbm_gate_refuses_oversized_ring).
+    (dict(num_envs=1024, batch_size=512, ring=420_000), "ring"),
 ])
 def test_unproven_sizes_refused(kwargs, fragment):
     v = sizing.gate_fused(budget_s=10_000.0, train_every=4,
