@@ -73,9 +73,40 @@ def _apply_head(cfg, head: str):
     raise ValueError(head)
 
 
+def _r2d2_cfg(args):
+    """Recurrent variant: its own sizing (the feedforward lane/batch
+    defaults do not transfer to sequence replay). Scaled between the
+    r2d2 preset and the PixelCatch chip run (17.6k steps/s at 32 lanes,
+    small torso): more lanes for frame rate, unroll 20 to span a few
+    ball crossings, small torso to keep the 20-step BPTT affordable."""
+    import dataclasses as dc
+
+    from dist_dqn_tpu.config import CONFIGS
+
+    cfg = CONFIGS["r2d2"]
+    return dc.replace(
+        cfg,
+        env_name=args.env,
+        network=dc.replace(cfg.network, torso="small", hidden=256,
+                           lstm_size=64),
+        actor=dc.replace(cfg.actor, num_envs=256,
+                         epsilon_decay_steps=args.eps_decay_frames),
+        replay=dc.replace(cfg.replay, capacity=131_072, min_fill=16_384,
+                          burn_in=5, unroll_length=20,
+                          sequence_stride=10),
+        learner=dc.replace(cfg.learner, batch_size=64,
+                           learning_rate=5e-4, n_step=3,
+                           target_update_period=500),
+        train_every=2,
+        eval_every_steps=0,
+    )
+
+
 def _cfg(args):
     from dist_dqn_tpu.config import CONFIGS
 
+    if args.head == "r2d2" and not args.smoke:
+        return _r2d2_cfg(args)
     cfg = CONFIGS["atari"]
     if args.smoke:
         # CPU harness check: tiny everything, bar not enforced.
@@ -145,9 +176,11 @@ def main() -> int:
                    help="250 x 1024 lanes = 256k frames per logged chunk")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--head", default="dqn",
-                   choices=["dqn", "c51", "qrdqn", "iqn", "mdqn"],
+                   choices=["dqn", "c51", "qrdqn", "iqn", "mdqn", "r2d2"],
                    help="algorithm family on the same torso/replay stack "
-                        "(surgery mirrors tests/test_pixel_learning.py)")
+                        "(surgery mirrors tests/test_pixel_learning.py; "
+                        "r2d2 instead swaps in the recurrent runtime with "
+                        "its own sizing — see _r2d2_cfg)")
     p.add_argument("--smoke", action="store_true",
                    help="CPU harness smoke: tiny sizes, bar not enforced")
     args = p.parse_args()
