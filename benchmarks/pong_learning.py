@@ -105,12 +105,28 @@ def _r2d2_cfg(args):
 def _cfg(args):
     from dist_dqn_tpu.config import CONFIGS
 
-    if args.head == "r2d2" and not args.smoke:
-        return _r2d2_cfg(args)
+    if args.head == "r2d2":
+        cfg = _r2d2_cfg(args)
+        if not args.smoke:
+            return cfg
+        # Tiny recurrent smoke: same runtime, CPU-compilable sizes.
+        return dataclasses.replace(
+            cfg,
+            network=dataclasses.replace(cfg.network, torso="small",
+                                        hidden=32, lstm_size=8),
+            actor=dataclasses.replace(cfg.actor, num_envs=8,
+                                      epsilon_decay_steps=2_000),
+            replay=dataclasses.replace(cfg.replay, capacity=2_048,
+                                       min_fill=256, burn_in=2,
+                                       unroll_length=4,
+                                       sequence_stride=2),
+            learner=dataclasses.replace(cfg.learner, batch_size=4))
     cfg = CONFIGS["atari"]
     if args.smoke:
-        # CPU harness check: tiny everything, bar not enforced.
-        return dataclasses.replace(
+        # CPU harness check: tiny everything, bar not enforced — but the
+        # SAME head family as the chip run, so a head-specific config
+        # bug fails here instead of costing a window its compile time.
+        cfg = dataclasses.replace(
             cfg,
             network=dataclasses.replace(cfg.network, torso="small",
                                         hidden=32),
@@ -120,6 +136,7 @@ def _cfg(args):
                                        min_fill=256),
             learner=dataclasses.replace(cfg.learner, batch_size=16),
             train_every=2, eval_every_steps=0)
+        return _apply_head(cfg, args.head)
     cfg = dataclasses.replace(
         cfg,
         env_name=args.env,
@@ -213,15 +230,23 @@ def main() -> int:
         # proven-safe lanes/batch/ring) still apply; the gate's
         # chunk-count cost model does not, because it would bound a
         # quantity (total frames) that is not what bounds this run.
+        # Gate on the CONFIG's sizes, not the CLI args: _r2d2_cfg (and
+        # any future variant) overrides lanes/batch/ring, and the gate
+        # must describe the run that will actually execute. For r2d2
+        # the per-chunk time model is still the feedforward one — a
+        # permissive floor at its small sizes; the wall-clock stop_fn
+        # is the binding bound either way.
         envelope = sizing.check_envelope(
-            num_envs=args.lanes, batch_size=args.batch_size,
-            ring=args.ring)
+            num_envs=cfg.actor.num_envs,
+            batch_size=cfg.learner.batch_size,
+            ring=cfg.replay.capacity)
         if envelope is not None:
             print(json.dumps({"sizing": envelope}), flush=True)
             return 4
         per_chunk_s = sizing.predict_fused_seconds(
-            num_envs=args.lanes, batch_size=args.batch_size,
-            train_every=args.train_every, chunk_iters=args.chunk_iters,
+            num_envs=cfg.actor.num_envs,
+            batch_size=cfg.learner.batch_size,
+            train_every=cfg.train_every, chunk_iters=args.chunk_iters,
             num_chunks=1, compile_s=0.0)
         worst_case_s = (sizing.COMPILE_BUDGET_S + args.budget_seconds
                         + per_chunk_s)

@@ -181,9 +181,14 @@ def main() -> int:
         res = run_stage(name, cmd, timeout_s, out_dir)
         results.append(res)
         print(json.dumps(res), flush=True)
-        if res["rc"] != 0:
-            # A wedge mid-battery poisons every later device touch; stop
-            # rather than queue three more hangs.
+        if res["rc"] < 0:
+            # Killed by the stage timeout (SIGTERM/SIGKILL): possibly a
+            # wedge, which poisons every later device touch — stop
+            # rather than queue more hangs. A POSITIVE rc is a clean
+            # self-exit (a learning stage missing its bar, a sizing-gate
+            # refusal) and must NOT abort the stages after it: round 4's
+            # first battery lost nothing only because the rc=1 stage
+            # happened to be last.
             aborted = name
             break
     (out_dir / "summary.json").write_text(json.dumps(
@@ -191,7 +196,13 @@ def main() -> int:
     status = ({"battery": "aborted_after", "stage": aborted}
               if aborted else {"battery": "done"})
     print(json.dumps({**status, "out_dir": str(out_dir)}), flush=True)
-    return 0 if aborted is None else 1
+    # Exit code contract (run_window.sh keys off it): 0 = all stages
+    # green; 1 = every stage ran but some cleanly failed its bar (the
+    # window may continue); 2 = a stage had to be killed (possible
+    # wedge — later device phases should not run).
+    if aborted is not None:
+        return 2
+    return 0 if all(r["rc"] == 0 for r in results) else 1
 
 
 if __name__ == "__main__":

@@ -142,6 +142,15 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         # phys vector); the carry is donated, so every leaf must be distinct.
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
+        if flat_storage and len(jax.tree.leaves(obs_example)) != 1:
+            # _unflatten_batched reshapes every leaf to the env's single
+            # observation_shape; a multi-leaf obs tree would need
+            # per-leaf bookkeeping it doesn't do. No current env emits
+            # one — fail loudly rather than mis-shape a future one.
+            raise ValueError(
+                "replay.flat_storage supports single-array observations "
+                f"only; this env's obs is a {type(obs_example).__name__} "
+                "tree — set replay.flat_storage=False")
         ring_example = (jax.tree.map(
             lambda x: x.reshape(-1) if x.ndim >= 2 else x, obs_example)
             if flat_storage else obs_example)
