@@ -137,12 +137,14 @@ def _cfg(args):
             learner=dataclasses.replace(cfg.learner, batch_size=16),
             train_every=2, eval_every_steps=0)
         return _apply_head(cfg, args.head)
+    actor_kw = dict(num_envs=args.lanes,
+                    epsilon_decay_steps=args.eps_decay_frames)
+    if args.eps_end is not None:
+        actor_kw["epsilon_end"] = args.eps_end
     cfg = dataclasses.replace(
         cfg,
         env_name=args.env,
-        actor=dataclasses.replace(
-            cfg.actor, num_envs=args.lanes,
-            epsilon_decay_steps=args.eps_decay_frames),
+        actor=dataclasses.replace(cfg.actor, **actor_kw),
         replay=dataclasses.replace(
             cfg.replay, capacity=args.ring, min_fill=args.min_fill),
         learner=dataclasses.replace(
@@ -189,6 +191,10 @@ def main() -> int:
     p.add_argument("--lr", type=float, default=2.5e-4)
     p.add_argument("--target-update", type=int, default=500)
     p.add_argument("--eps-decay-frames", type=int, default=8_000_000)
+    p.add_argument("--eps-end", type=float, default=None,
+                   help="final exploration epsilon (default: the "
+                        "preset's 0.05; Breakout's late-game oscillation "
+                        "softens at 0.01)")
     p.add_argument("--chunk-iters", type=int, default=250,
                    help="250 x 1024 lanes = 256k frames per logged chunk")
     p.add_argument("--seed", type=int, default=0)
