@@ -50,14 +50,20 @@ def test_apex_split_bench_smoke_vector():
     assert row["platforms"] == "cpu"  # smoke must never record TPU-ish rows
 
 
-def test_pong_learning_smoke():
-    proc = _run([sys.executable, "benchmarks/pong_learning.py", "--smoke"])
+@pytest.mark.parametrize("head", ["dqn", "c51"])
+def test_pong_learning_smoke(head):
+    """--smoke must exercise the SAME head family as the chip run would
+    (a head-specific config bug caught here costs seconds; on the chip
+    it costs a window its compile minutes — review round 4)."""
+    proc = _run([sys.executable, "benchmarks/pong_learning.py", "--smoke",
+                 "--head", head])
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     rows = _json_rows(proc.stdout)
     summary = [r for r in rows if r.get("summary") == "pong_learning"]
     assert len(summary) == 1
     row = summary[0]
     assert row["platform"] == "cpu" and row["smoke"] is True
+    assert row["head"] == head
     assert row["frames"] > 0 and row["grad_steps"] > 0
     # The bar is never claimed cleared on a smoke run.
     assert row["cleared_bar"] is False
