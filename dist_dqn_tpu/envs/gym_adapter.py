@@ -242,7 +242,8 @@ def is_pixel_env(name: str) -> bool:
     """True if ``make_host_env(name)`` yields image observations (CNN torso
     required). Owned here, next to the routing, so callers (train CLI) never
     maintain their own name lists."""
-    return name == "pong" or name.startswith(("ale:", "dmc:"))
+    return name in ("pong", "breakout") \
+        or name.startswith(("ale:", "dmc:"))
 
 
 def make_host_env(name: str, num_envs: int, seed: int = 0,
@@ -252,14 +253,20 @@ def make_host_env(name: str, num_envs: int, seed: int = 0,
     ``"CartPole-v1"`` etc. -> plain gymnasium; ``"ale:<Game>"`` -> ALE with
     Atari preprocessing (requires ale-py; raises a clear error otherwise);
     ``"dmc:<domain>:<task>"`` -> DM-Control pixels with discretized torques
-    (envs/dmc_adapter.py, BASELINE.json:11); ``"pong"`` -> the numpy twin
-    of the synthetic PixelPong (envs/host_pong.py) — the offline stand-in
-    that exercises the full Atari-shaped actor/learner path without ale-py.
+    (envs/dmc_adapter.py, BASELINE.json:11); ``"pong"`` / ``"breakout"`` ->
+    the numpy twins of the device-native games (envs/host_pong.py,
+    envs/host_breakout.py) — offline stand-ins that exercise the full
+    Atari-shaped actor/learner path without ale-py.
     """
     if name == "pong":
         from dist_dqn_tpu.envs.host_pong import HostPixelPong
 
         return HostVectorEnv(HostPixelPong, num_envs, seed=seed)
+
+    if name == "breakout":
+        from dist_dqn_tpu.envs.host_breakout import HostPixelBreakout
+
+        return HostVectorEnv(HostPixelBreakout, num_envs, seed=seed)
 
     if name.startswith("dmc:"):
         from dist_dqn_tpu.envs.dmc_adapter import DMCPixelEnv

@@ -4,7 +4,7 @@ import pytest
 
 from dist_dqn_tpu.envs.gym_adapter import (
     AtariPreprocessing, HostVectorEnv, _area_resize_84, _to_gray,
-    make_host_env)
+    is_pixel_env, make_host_env)
 
 
 def test_area_resize_shapes_and_range():
@@ -170,3 +170,68 @@ def test_host_vector_env_autoreset_next_obs():
             # obs was auto-reset; next_obs is the pre-reset frame.
             assert not np.array_equal(obs[0], next_obs[0])
     assert done_seen
+
+
+def test_host_breakout_contract_and_parity_with_jax_twin():
+    """The Breakout numpy twin (envs/host_breakout.py): interface
+    contract through make_host_env, fire-to-serve/lives semantics, and
+    injected-state step parity with the JAX env — same guard as the
+    Pong twin against one-sided physics edits."""
+    import jax
+    import jax.numpy as jnp
+
+    from dist_dqn_tpu.envs import pixel_breakout
+    from dist_dqn_tpu.envs.host_breakout import HostPixelBreakout
+    from dist_dqn_tpu.envs.pixel_breakout import PixelBreakout
+
+    assert HostPixelBreakout.num_actions == PixelBreakout.num_actions
+    assert HostPixelBreakout().reset(0).shape == \
+        PixelBreakout.observation_shape
+
+    # Vector adapter wiring + pixel-env classification.
+    v = make_host_env("breakout", 2, seed=1)
+    obs = v.reset()
+    assert obs.shape == (2, 84, 84, 4) and obs.dtype == np.uint8
+    assert is_pixel_env("breakout")
+
+    # NOOP never serves; FIRE does.
+    henv = HostPixelBreakout()
+    henv.reset(seed=0)
+    for _ in range(5):
+        _, r, term, _ = henv.step(0)
+        assert r == 0.0 and not term and not henv._in_play
+    henv.step(1)
+    assert henv._in_play
+
+    # Injected-state parity: free flight, a brick hit (reward + brick
+    # removed + bounce), a paddle hit with spin, and a lost ball (life).
+    jenv = pixel_breakout.PixelBreakout()
+    cases = [
+        # (ball xyvxvy, pad_x, action)
+        ((40.0, 50.0, 1.0, -2.0), 40.0, 0),   # free flight upward
+        ((40.0, 37.0, 0.0, -2.0), 40.0, 0),   # into the brick wall
+        ((42.0, 76.5, 1.0, 2.0), 40.0, 2),    # paddle hit, off-center
+        ((70.0, 81.5, 0.0, 2.0), 20.0, 0),    # past the paddle: life lost
+    ]
+    for ball, pad_x, action in cases:
+        henv.reset(seed=0)
+        henv._in_play = True
+        henv._ball = np.array(ball, np.float32)
+        henv._pad_x = pad_x
+        jstate, _ = jenv.reset(jax.random.PRNGKey(0))
+        jstate = jstate._replace(
+            ball=jnp.asarray(ball, jnp.float32),
+            pad_x=jnp.float32(pad_x), in_play=jnp.bool_(True))
+        jnew, _, jr, jterm, _ = jenv.env_step(jstate, jnp.int32(action))
+        hobs, hr, hterm, _ = henv.step(action)
+        np.testing.assert_allclose(np.asarray(jnew.ball), henv._ball,
+                                   rtol=1e-5, err_msg=str(ball))
+        np.testing.assert_allclose(float(jnew.pad_x), henv._pad_x,
+                                   rtol=1e-6)
+        assert float(jr) == hr and bool(jterm) == hterm, ball
+        assert int(jnew.lives) == henv._lives, ball
+        assert bool(jnew.in_play) == henv._in_play, ball
+        np.testing.assert_array_equal(np.asarray(jnew.bricks),
+                                      henv._bricks, err_msg=str(ball))
+        np.testing.assert_array_equal(np.asarray(jnew.frames[:, :, -1]),
+                                      hobs[:, :, -1])
