@@ -95,10 +95,13 @@ def test_apex_split_learns_cartpole():
     assert max(evals) >= 100.0, evals
 
 
-def test_apex_split_pixel_pong_native_assembly():
-    """The full Atari-shaped split offline: host PixelPong actors stream
-    84x84x4 uint8 stacks through the NATIVE assembler into the pixel PER
-    shard, with a (tiny) Nature-CNN learner on top (BASELINE.json:9)."""
+@pytest.mark.parametrize("host_env", ["pong", "breakout"])
+def test_apex_split_pixel_game_native_assembly(host_env):
+    """The full Atari-shaped split offline: host game-twin actors
+    (envs/host_pong.py, envs/host_breakout.py) stream 84x84x4 uint8
+    stacks through the NATIVE assembler into the pixel PER shard, with
+    a (tiny) Nature-CNN learner on top (BASELINE.json:9). Both
+    device-native games have numpy twins; both must drive the split."""
     cfg = CONFIGS["apex"]
     cfg = dataclasses.replace(
         cfg,
@@ -108,7 +111,8 @@ def test_apex_split_pixel_pong_native_assembly():
                                    pallas_sampler=False),
         learner=dataclasses.replace(cfg.learner, batch_size=8, n_step=3),
     )
-    rt = ApexRuntimeConfig(host_env="pong", num_actors=1, envs_per_actor=4,
+    rt = ApexRuntimeConfig(host_env=host_env, num_actors=1,
+                           envs_per_actor=4,
                            total_env_steps=400, inserts_per_grad_step=64)
     result = run_apex(cfg, rt, log_fn=lambda s: None)
     assert result["env_steps"] >= 400
