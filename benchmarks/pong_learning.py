@@ -155,7 +155,19 @@ def _cfg(args):
         eval_every_steps=0,   # training returns are the signal; greedy
                               # eval would add per-period device programs
     )
-    return _apply_head(cfg, args.head)
+    cfg = _apply_head(cfg, args.head)
+    if args.lr_anneal_frames:
+        # The schedule counts GRAD steps (agents/dqn.py:make_optimizer);
+        # convert the frame horizon at the POST-head-surgery cadence
+        # (mdqn overrides train_every to 1).
+        grad_per_iter = cfg.actor.num_envs * cfg.train_every
+        cfg = dataclasses.replace(cfg, learner=dataclasses.replace(
+            cfg.learner,
+            lr_schedule="cosine",
+            lr_decay_steps=max(1, args.lr_anneal_frames // grad_per_iter),
+            lr_end_value=args.lr_end if args.lr_end is not None
+            else args.lr / 10.0))
+    return cfg
 
 
 def main() -> int:
@@ -189,6 +201,13 @@ def main() -> int:
                         "cadence's learning signal, still learner-"
                         "underutilized at batch 512")
     p.add_argument("--lr", type=float, default=2.5e-4)
+    p.add_argument("--lr-anneal-frames", type=int, default=None,
+                   help="cosine-anneal the lr over this many env frames "
+                        "(converted to grad steps at the run's cadence); "
+                        "Breakout's late-run 40-53-brick oscillation is "
+                        "the target")
+    p.add_argument("--lr-end", type=float, default=None,
+                   help="anneal floor (default lr/10)")
     p.add_argument("--target-update", type=int, default=500)
     p.add_argument("--eps-decay-frames", type=int, default=8_000_000)
     p.add_argument("--eps-end", type=float, default=None,

@@ -42,6 +42,40 @@ def _apply(net: nn.Module, params: PyTree, obs: Array, rng: Optional[Array],
     return net.apply(params, obs, add_noise=add_noise, rngs=rngs)
 
 
+def make_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
+    """Shared optimizer factory for the feed-forward and R2D2 learners.
+
+    Builds clip-by-global-norm + Adam, with the learning rate either
+    constant or annealed per ``cfg.lr_schedule`` over grad steps. The
+    schedule rides optax's own step counter inside the optimizer state,
+    so it checkpoints/resumes with the rest of the learner state.
+    """
+    if cfg.lr_schedule == "constant":
+        lr = cfg.learning_rate
+    elif cfg.lr_schedule in ("linear", "cosine"):
+        if cfg.lr_decay_steps <= 0:
+            raise ValueError(
+                f"lr_schedule={cfg.lr_schedule!r} needs lr_decay_steps > 0 "
+                "(the grad-step horizon the anneal spans)")
+        if cfg.lr_schedule == "linear":
+            lr = optax.linear_schedule(
+                init_value=cfg.learning_rate, end_value=cfg.lr_end_value,
+                transition_steps=cfg.lr_decay_steps)
+        else:
+            lr = optax.cosine_decay_schedule(
+                init_value=cfg.learning_rate, decay_steps=cfg.lr_decay_steps,
+                alpha=cfg.lr_end_value / cfg.learning_rate)
+    else:
+        raise ValueError(
+            f"unknown lr_schedule {cfg.lr_schedule!r}; "
+            "expected one of: constant, linear, cosine")
+    tx_parts = []
+    if cfg.max_grad_norm:
+        tx_parts.append(optax.clip_by_global_norm(cfg.max_grad_norm))
+    tx_parts.append(optax.adam(lr, eps=cfg.adam_eps))
+    return optax.chain(*tx_parts)
+
+
 def make_learner(net: nn.Module, cfg: LearnerConfig,
                  axis_name: Optional[str] = None):
     """Build (init, train_step) for a feed-forward Q-network.
@@ -56,11 +90,7 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     (BASELINE.json:5) — so replicated params stay bit-identical while each
     learner consumes its own replay shard's batch.
     """
-    tx_parts = []
-    if cfg.max_grad_norm:
-        tx_parts.append(optax.clip_by_global_norm(cfg.max_grad_norm))
-    tx_parts.append(optax.adam(cfg.learning_rate, eps=cfg.adam_eps))
-    tx = optax.chain(*tx_parts)
+    tx = make_optimizer(cfg)
 
     num_atoms = getattr(net, "num_atoms", 1)
     quantile = num_atoms > 1 and getattr(net, "quantile", False)

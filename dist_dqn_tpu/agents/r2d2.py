@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from dist_dqn_tpu.agents.dqn import LearnerState
+from dist_dqn_tpu.agents.dqn import LearnerState, make_optimizer
 from dist_dqn_tpu.config import LearnerConfig, ReplayConfig
 from dist_dqn_tpu.ops import losses
 from dist_dqn_tpu.types import PyTree, SequenceSample
@@ -53,11 +53,7 @@ def make_r2d2_learner(net, cfg: LearnerConfig, rcfg: ReplayConfig,
             "scalar head only (agents/dqn.py); unset munchausen or "
             "lstm_size")
 
-    tx_parts = []
-    if cfg.max_grad_norm:
-        tx_parts.append(optax.clip_by_global_norm(cfg.max_grad_norm))
-    tx_parts.append(optax.adam(cfg.learning_rate, eps=cfg.adam_eps))
-    tx = optax.chain(*tx_parts)
+    tx = make_optimizer(cfg)
 
     def init(rng: Array, obs_example: Array) -> LearnerState:
         rng, k_param = jax.random.split(rng)

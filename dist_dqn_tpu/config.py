@@ -91,6 +91,14 @@ class LearnerConfig:
 
     learning_rate: float = 1e-3
     adam_eps: float = 1e-8
+    # Learning-rate schedule over GRAD steps, counted by the optimizer's
+    # own state (survives checkpoint/resume): "constant" ignores the
+    # other two knobs; "linear" anneals learning_rate -> lr_end_value
+    # over lr_decay_steps; "cosine" decays along a half-cosine to
+    # lr_end_value and holds there.
+    lr_schedule: str = "constant"
+    lr_decay_steps: int = 0
+    lr_end_value: float = 0.0
     gamma: float = 0.99
     n_step: int = 1
     batch_size: int = 128

@@ -1,0 +1,132 @@
+"""Learning-rate schedule knob: optimizer math, validation, resume.
+
+The schedule is carried by optax's own step counter inside the
+optimizer state (agents/dqn.py:make_optimizer), so it must anneal per
+GRAD step and survive a checkpoint-style state round-trip.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.agents.dqn import make_learner, make_optimizer
+from dist_dqn_tpu.config import LearnerConfig
+from dist_dqn_tpu.models.qnets import QNetwork
+from dist_dqn_tpu.types import Transition
+
+
+def _batch(rng, batch_size=16, obs_dim=4, num_actions=2):
+    ks = jax.random.split(rng, 3)
+    return Transition(
+        obs=jax.random.normal(ks[0], (batch_size, obs_dim)),
+        action=jax.random.randint(ks[1], (batch_size,), 0, num_actions),
+        reward=jax.random.normal(ks[2], (batch_size,)),
+        discount=jnp.full((batch_size,), 0.99),
+        next_obs=jax.random.normal(ks[0], (batch_size, obs_dim)),
+    )
+
+
+def _update_scale(tx, steps):
+    """Adam normalizes the gradient, so with a constant gradient the
+    per-step update magnitude tracks the learning rate: measure it."""
+    params = {"w": jnp.zeros((3,))}
+    grads = {"w": jnp.ones((3,))}
+    opt_state = tx.init(params)
+    scales = []
+    for _ in range(steps):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        scales.append(float(jnp.abs(updates["w"]).max()))
+    return scales
+
+
+def test_linear_schedule_anneals_update_magnitude():
+    cfg = LearnerConfig(learning_rate=1e-2, lr_schedule="linear",
+                        lr_decay_steps=10, lr_end_value=1e-3,
+                        max_grad_norm=0.0)
+    scales = _update_scale(make_optimizer(cfg), 12)
+    # First update uses ~init lr, updates shrink monotonically, and the
+    # tail holds at ~end lr (Adam's bias correction keeps step 0 exact).
+    assert scales[0] == pytest.approx(1e-2, rel=0.05)
+    assert all(b <= a + 1e-12 for a, b in zip(scales, scales[1:]))
+    assert scales[-1] == pytest.approx(1e-3, rel=0.1)
+
+
+def test_cosine_schedule_reaches_alpha_floor():
+    cfg = LearnerConfig(learning_rate=4e-3, lr_schedule="cosine",
+                        lr_decay_steps=8, lr_end_value=4e-4,
+                        max_grad_norm=0.0)
+    scales = _update_scale(make_optimizer(cfg), 12)
+    assert scales[0] == pytest.approx(4e-3, rel=0.05)
+    assert scales[-1] == pytest.approx(4e-4, rel=0.1)
+
+
+def test_constant_schedule_is_flat():
+    cfg = LearnerConfig(learning_rate=2e-3, max_grad_norm=0.0)
+    scales = _update_scale(make_optimizer(cfg), 5)
+    assert scales[0] == pytest.approx(2e-3, rel=0.05)
+    # Adam with a constant gradient: magnitude stays at lr.
+    assert scales[-1] == pytest.approx(2e-3, rel=0.05)
+
+
+def test_schedule_validation_errors():
+    with pytest.raises(ValueError, match="lr_decay_steps"):
+        make_optimizer(LearnerConfig(lr_schedule="cosine"))
+    with pytest.raises(ValueError, match="constant, linear, cosine"):
+        make_optimizer(LearnerConfig(lr_schedule="exponential",
+                                     lr_decay_steps=10))
+
+
+def test_scheduled_learner_trains_and_resumes():
+    """The fused learner accepts a scheduled config, still descends, and
+    the anneal position survives a state round-trip (the checkpoint
+    contract: opt_state carries the schedule count)."""
+    net = QNetwork(num_actions=2, torso="mlp", mlp_features=(32, 32),
+                   hidden=0)
+    cfg = LearnerConfig(learning_rate=3e-3, lr_schedule="cosine",
+                        lr_decay_steps=100, lr_end_value=3e-5,
+                        target_update_period=10_000)
+    init, train_step = make_learner(net, cfg)
+    state = init(jax.random.PRNGKey(0), jnp.zeros((4,)))
+    batch = _batch(jax.random.PRNGKey(1))
+    step = jax.jit(train_step)
+    _, m0 = step(state, batch)
+    for _ in range(120):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < 0.5 * float(m0["loss"])
+
+    # Round-trip the state through host numpy (what orbax does) and
+    # verify the next update is bit-identical to the uninterrupted one.
+    hosted = jax.tree.map(np.asarray, state)
+    restored = jax.tree.map(jnp.asarray, hosted)
+    cont, _ = step(state, batch)
+    res, _ = step(restored, batch)
+    chex_equal = jax.tree.map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+        cont.params, res.params)
+    assert all(jax.tree.leaves(chex_equal))
+
+
+def test_r2d2_shares_the_factory():
+    """The recurrent learner builds from the same make_optimizer, so a
+    scheduled config threads through without separate plumbing."""
+    from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner
+    from dist_dqn_tpu.config import ReplayConfig
+    from dist_dqn_tpu.models.recurrent import RecurrentQNetwork
+
+    net = RecurrentQNetwork(num_actions=2, torso="mlp",
+                            mlp_features=(16,), lstm_size=8, hidden=0)
+    cfg = LearnerConfig(learning_rate=1e-3, lr_schedule="linear",
+                        lr_decay_steps=50, lr_end_value=1e-5, n_step=1,
+                        batch_size=4)
+    rcfg = ReplayConfig(burn_in=2, unroll_length=4, sequence_stride=4)
+    init, _ = make_r2d2_learner(net, cfg, rcfg)
+    state = init(jax.random.PRNGKey(0), jnp.zeros((4,)))
+    # The schedule count lives in the optimizer state.
+    leaves = jax.tree.leaves(state.opt_state)
+    assert leaves, "optimizer state should be non-empty"
+
+    bad = dataclasses.replace(cfg, lr_schedule="nope")
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_r2d2_learner(net, bad, rcfg)
