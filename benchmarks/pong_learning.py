@@ -103,6 +103,26 @@ def _r2d2_cfg(args):
 
 
 def _cfg(args):
+    """Full run config: base per head/env/smoke, then the optional lr
+    anneal applied uniformly — r2d2 and smoke builds included, so a
+    scheduled chip run's config bugs fail in the CPU smoke first."""
+    cfg = _base_cfg(args)
+    if args.lr_anneal_frames:
+        # The schedule counts GRAD steps (agents/dqn.py:make_optimizer);
+        # convert the frame horizon at the FINAL config's cadence
+        # (mdqn overrides train_every to 1, r2d2 sizes its own lanes).
+        grad_per_iter = cfg.actor.num_envs * cfg.train_every
+        lr0 = cfg.learner.learning_rate
+        cfg = dataclasses.replace(cfg, learner=dataclasses.replace(
+            cfg.learner,
+            lr_schedule="cosine",
+            lr_decay_steps=max(1, args.lr_anneal_frames // grad_per_iter),
+            lr_end_value=args.lr_end if args.lr_end is not None
+            else lr0 / 10.0))
+    return cfg
+
+
+def _base_cfg(args):
     from dist_dqn_tpu.config import CONFIGS
 
     if args.head == "r2d2":
@@ -155,19 +175,7 @@ def _cfg(args):
         eval_every_steps=0,   # training returns are the signal; greedy
                               # eval would add per-period device programs
     )
-    cfg = _apply_head(cfg, args.head)
-    if args.lr_anneal_frames:
-        # The schedule counts GRAD steps (agents/dqn.py:make_optimizer);
-        # convert the frame horizon at the POST-head-surgery cadence
-        # (mdqn overrides train_every to 1).
-        grad_per_iter = cfg.actor.num_envs * cfg.train_every
-        cfg = dataclasses.replace(cfg, learner=dataclasses.replace(
-            cfg.learner,
-            lr_schedule="cosine",
-            lr_decay_steps=max(1, args.lr_anneal_frames // grad_per_iter),
-            lr_end_value=args.lr_end if args.lr_end is not None
-            else args.lr / 10.0))
-    return cfg
+    return _apply_head(cfg, args.head)
 
 
 def main() -> int:

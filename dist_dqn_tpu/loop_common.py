@@ -31,6 +31,77 @@ def shard_sizes(cfg: ExperimentConfig, num_shards: int) -> Tuple[int, int]:
             cfg.learner.batch_size // num_shards)
 
 
+FLAT_AUTO_BYTES = 2 << 30
+
+
+def resolve_flat_storage(rcfg, obs_shape, obs_dtype, num_slots: int, B: int,
+                         store_final: bool = False) -> bool:
+    """Decide merged-row ("flat") obs storage for a device ring.
+
+    XLA lays out multi-dim u8 ring buffers with (8,128) tiling on
+    whichever dims it puts minormost, padding 84x84 to ~1.6x its logical
+    bytes — and a [slots, B, flat] 3-D form to 2.0x (lanes transposed
+    minormost and padded 64->128; both measured in the 2026-08-01 v5e
+    compile OOMs). A 2-D merged-row buffer pads <1% but gathers ~3%
+    slower at small rings (619k vs 602k env-steps/s at 16k slots). Auto
+    rule (``replay.flat_storage=None``): flat only when the ring's
+    logical bytes exceed FLAT_AUTO_BYTES, where memory dominates.
+    Shared by both fused loops so the rule cannot diverge.
+    """
+    if rcfg.flat_storage is None:
+        obs_bytes = num_slots * B * int(jnp.dtype(obs_dtype).itemsize)
+        for d in obs_shape:
+            obs_bytes *= d
+        return (len(obs_shape) >= 2
+                and obs_bytes * (2 if store_final else 1) > FLAT_AUTO_BYTES)
+    return bool(rcfg.flat_storage) and len(obs_shape) >= 2
+
+
+def flat_obs_codecs(flat_storage: bool, obs_shape):
+    """Reshape helpers for merged-row ("flat") ring storage.
+
+    ``flatten_batched``: [B, *obs_shape] leaves -> [B, prod] at the
+    insert boundary (identity when tiled). ``unflatten_rows``:
+    [..., prod] leaves -> [..., *obs_shape] after a gather —
+    rank-agnostic, so the feed-forward [N, prod] batch and the R2D2
+    [L, S, prod] sequence gather share it. Both loops must use these
+    (not local reshapes) so the layout boundary cannot diverge.
+    """
+    obs_shape = tuple(obs_shape)
+
+    def flatten_batched(tree):
+        if not flat_storage:
+            return tree
+        return jax.tree.map(
+            lambda x: x.reshape(x.shape[0], -1) if x.ndim >= 3 else x,
+            tree)
+
+    def unflatten_rows(tree):
+        if not flat_storage:
+            return tree
+        return jax.tree.map(
+            lambda x: x.reshape(x.shape[:-1] + obs_shape), tree)
+
+    return flatten_batched, unflatten_rows
+
+
+def ring_obs_example(obs_example, flat_storage: bool):
+    """Per-env obs example as the ring will store it (flattened rows
+    when flat). The unflatten codec reshapes every leaf to the env's
+    single observation_shape; a multi-leaf obs tree would need per-leaf
+    bookkeeping it doesn't do — no current env emits one, so fail
+    loudly rather than mis-shape a future one."""
+    if not flat_storage:
+        return obs_example
+    if len(jax.tree.leaves(obs_example)) != 1:
+        raise ValueError(
+            "replay.flat_storage supports single-array observations "
+            f"only; this env's obs is a {type(obs_example).__name__} "
+            "tree — set replay.flat_storage=False")
+    return jax.tree.map(
+        lambda x: x.reshape(-1) if x.ndim >= 2 else x, obs_example)
+
+
 def make_schedules(cfg: ExperimentConfig, B: int, num_shards: int
                    ) -> Tuple[Callable, Callable]:
     """(epsilon(iteration), beta(iteration)): exploration decay and the PER

@@ -68,6 +68,16 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
             f"sequence ring too small: num_slots={num_slots} < "
             f"seq_len+stride={seq_len + stride}; raise replay.capacity")
 
+    # Pixel sequence rings take the same merged-row flat storage as the
+    # feedforward ring (loop_common.resolve_flat_storage): obs rows are
+    # flattened at insert and reshaped back after the window gather.
+    _obs_shape = tuple(env.observation_shape)
+    flat_storage = loop_common.resolve_flat_storage(
+        rcfg, _obs_shape, env.observation_dtype, num_slots, B)
+
+    _flatten_batched, _unflatten_seq = loop_common.flat_obs_codecs(
+        flat_storage, _obs_shape)
+
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
     _split_rng = loop_common.make_rng_splitter(spmd)
     use_pallas, pallas_interpret = loop_common.pallas_routing(
@@ -93,8 +103,11 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
         env_state, obs = env.v_reset(k_env, B)
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
-        replay = sring.sequence_ring_init(num_slots, B, obs_example,
-                                          net.lstm_size)
+        ring_example = loop_common.ring_obs_example(obs_example,
+                                                    flat_storage)
+        replay = sring.sequence_ring_init(num_slots, B, ring_example,
+                                          net.lstm_size,
+                                          merge_obs_rows=flat_storage)
         learner = init_learner(k_learn, obs_example)
         zero = jnp.float32(0.0)
         return R2D2Carry(
@@ -114,8 +127,9 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
         env_state, out = env.v_step(carry.env_state, actions)
         # Store the *pre-step* carry: the state the actor held entering obs.
         replay = sring.sequence_ring_add(
-            carry.replay, carry.obs, actions, out.reward, out.terminated,
-            out.truncated, carry.actor_carry, seq_len, stride)
+            carry.replay, _flatten_batched(carry.obs), actions, out.reward,
+            out.terminated, out.truncated, carry.actor_carry, seq_len,
+            stride, merge_obs_rows=flat_storage)
         # Zero the carry for envs that just finished an episode so the next
         # act (and the state stored with it) starts the new episode fresh.
         done = jnp.logical_or(out.terminated, out.truncated)
@@ -132,7 +146,9 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
                 s = sring.sequence_ring_sample(
                     rep, key, batch_size, seq_len,
                     rcfg.priority_exponent, beta, use_pallas=use_pallas,
-                    pallas_interpret=pallas_interpret)
+                    pallas_interpret=pallas_interpret,
+                    merge_obs_rows=flat_storage)
+                s = s._replace(obs=_unflatten_seq(s.obs))
                 l, metrics = train_step(l, s)
                 rep = sring.sequence_ring_update(
                     rep, s.t_idx, s.b_idx, metrics["priorities"],

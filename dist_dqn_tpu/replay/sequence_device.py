@@ -41,10 +41,17 @@ class SequenceRingState(NamedTuple):
 
 
 def sequence_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
-                       lstm_size: int) -> SequenceRingState:
+                       lstm_size: int,
+                       merge_obs_rows: bool = False) -> SequenceRingState:
+    """``merge_obs_rows`` stores obs as flat ``[T*B, ...]`` rows (same
+    records, same order — see replay/device.py:time_ring_init); callers
+    pass the same flag to add/sample. The carry planes and priority
+    plane keep ``[T, B]``: they are small and the seeding math wants the
+    time axis explicit."""
     return SequenceRingState(
         ring=ring.time_ring_init(num_slots, num_envs, obs_example,
-                                 store_final_obs=False),
+                                 store_final_obs=False,
+                                 merge_obs_rows=merge_obs_rows),
         state_c=jnp.zeros((num_slots, num_envs, lstm_size), jnp.float32),
         state_h=jnp.zeros((num_slots, num_envs, lstm_size), jnp.float32),
         priorities=jnp.zeros((num_slots, num_envs), jnp.float32),
@@ -56,7 +63,8 @@ def sequence_ring_init(num_slots: int, num_envs: int, obs_example: PyTree,
 def sequence_ring_add(state: SequenceRingState, obs: PyTree, action: Array,
                       reward: Array, terminated: Array, truncated: Array,
                       carry: Tuple[Array, Array], seq_len: int,
-                      stride: int) -> SequenceRingState:
+                      stride: int,
+                      merge_obs_rows: bool = False) -> SequenceRingState:
     """Append one time slice plus the actor carry that produced ``action``.
 
     ``seq_len`` (L) and ``stride`` are static. Overwriting slot ``p``
@@ -68,7 +76,8 @@ def sequence_ring_add(state: SequenceRingState, obs: PyTree, action: Array,
     num_slots = state.priorities.shape[0]
     p = state.ring.pos
     new_ring = ring.time_ring_add(state.ring, obs, action, reward,
-                                  terminated, truncated)
+                                  terminated, truncated,
+                                  merge_obs_rows=merge_obs_rows)
     writes = state.writes + 1
 
     priorities = state.priorities.at[p].set(0.0)
@@ -104,7 +113,8 @@ def _gather_seq(field: Array, t_idx: Array, b_idx: Array, L: int,
 def sequence_ring_sample(state: SequenceRingState, rng: Array,
                          batch_size: int, seq_len: int, alpha: float,
                          beta: Array, use_pallas: bool = False,
-                         pallas_interpret: bool = False) -> SequenceSample:
+                         pallas_interpret: bool = False,
+                         merge_obs_rows: bool = False) -> SequenceSample:
     """Stratified-CDF sample of ``batch_size`` length-``seq_len`` sequences.
 
     Same inverse-CDF machinery as the transition sampler — the priority
@@ -123,8 +133,16 @@ def sequence_ring_sample(state: SequenceRingState, rng: Array,
     weights = importance_weights(mass_sel, total, n_valid, beta)
 
     r = state.ring
-    obs = jax.tree.map(
-        lambda x: _gather_seq(x, t_idx, b_idx, seq_len, num_slots), r.obs)
+    if merge_obs_rows:
+        # Flat rows: slot t of env b lives at row t*B + b.
+        offs = jnp.arange(seq_len, dtype=jnp.int32)
+        tt = (t_idx[None, :] + offs[:, None]) % num_slots      # [L, S]
+        rows = tt * num_envs + b_idx[None, :]
+        obs = jax.tree.map(lambda x: x[rows], r.obs)
+    else:
+        obs = jax.tree.map(
+            lambda x: _gather_seq(x, t_idx, b_idx, seq_len, num_slots),
+            r.obs)
     action = _gather_seq(r.action, t_idx, b_idx, seq_len, num_slots)
     reward = _gather_seq(r.reward, t_idx, b_idx, seq_len, num_slots)
     term = _gather_seq(r.terminated, t_idx, b_idx, seq_len, num_slots)

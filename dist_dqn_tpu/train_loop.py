@@ -79,42 +79,15 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
 
     # Multi-dim obs can be STORED FLAT in the ring — [slots*B, 28224]
     # for 84x84x4, via replay/device.py merge_obs_rows — with reshapes
-    # at the insert/sample boundary: XLA lays out multi-dim u8 ring
-    # buffers with (8,128) tiling on whichever dims it puts minormost,
-    # padding 84x84 to ~1.6x its logical bytes, and a [slots, B, flat]
-    # 3-D form to 2.0x (lanes transposed minormost and padded 64->128 —
-    # both measured in the 2026-08-01 compile OOMs). A 2-D merged-row
-    # buffer pads <1%, but the tiled layout also gathers ~3% faster at
-    # small rings (619k vs 602k env-steps/s at 16k slots). Auto rule
-    # (cfg.replay.flat_storage=None): flat only when the ring's logical
-    # bytes exceed _FLAT_AUTO_BYTES, where memory dominates.
+    # at the insert/sample boundary (rationale + measured padding
+    # factors: loop_common.resolve_flat_storage).
     _obs_shape = tuple(env.observation_shape)
-    _FLAT_AUTO_BYTES = 2 << 30
-    if cfg.replay.flat_storage is None:
-        _obs_bytes = (num_slots * B
-                      * int(jnp.dtype(env.observation_dtype).itemsize))
-        for d in _obs_shape:
-            _obs_bytes *= d
-        flat_storage = (len(_obs_shape) >= 2
-                        and _obs_bytes * (2 if store_final else 1)
-                        > _FLAT_AUTO_BYTES)
-    else:
-        flat_storage = cfg.replay.flat_storage and len(_obs_shape) >= 2
+    flat_storage = loop_common.resolve_flat_storage(
+        cfg.replay, _obs_shape, env.observation_dtype, num_slots, B,
+        store_final=store_final)
 
-    def _flatten_batched(tree):
-        """[B, *obs_shape] leaves -> [B, prod] (pass-through when tiled)."""
-        if not flat_storage:
-            return tree
-        return jax.tree.map(
-            lambda x: x.reshape(x.shape[0], -1) if x.ndim >= 3 else x,
-            tree)
-
-    def _unflatten_batched(tree):
-        """[N, prod] leaves -> [N, *obs_shape]."""
-        if not flat_storage:
-            return tree
-        return jax.tree.map(
-            lambda x: x.reshape((x.shape[0],) + _obs_shape), tree)
+    _flatten_batched, _unflatten_batched = loop_common.flat_obs_codecs(
+        flat_storage, _obs_shape)
 
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay
@@ -142,18 +115,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         # phys vector); the carry is donated, so every leaf must be distinct.
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
-        if flat_storage and len(jax.tree.leaves(obs_example)) != 1:
-            # _unflatten_batched reshapes every leaf to the env's single
-            # observation_shape; a multi-leaf obs tree would need
-            # per-leaf bookkeeping it doesn't do. No current env emits
-            # one — fail loudly rather than mis-shape a future one.
-            raise ValueError(
-                "replay.flat_storage supports single-array observations "
-                f"only; this env's obs is a {type(obs_example).__name__} "
-                "tree — set replay.flat_storage=False")
-        ring_example = (jax.tree.map(
-            lambda x: x.reshape(-1) if x.ndim >= 2 else x, obs_example)
-            if flat_storage else obs_example)
+        ring_example = loop_common.ring_obs_example(obs_example,
+                                                    flat_storage)
         if prioritized:
             replay = pring.prioritized_ring_init(
                 num_slots, B, ring_example, store_final_obs=store_final,

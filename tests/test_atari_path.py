@@ -111,3 +111,45 @@ def test_flat_storage_bit_equal_to_tiled():
     assert loss_tiled == loss_flat
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
                  p_tiled, p_flat)
+
+
+def test_r2d2_flat_storage_bit_equal_to_tiled():
+    """Same layout-invisibility contract for the SEQUENCE ring: pixel
+    R2D2 training under tiled vs flat obs storage is bit-identical."""
+    import numpy as np
+
+    from dist_dqn_tpu.r2d2_loop import make_r2d2_train
+
+    def run(flat):
+        cfg = CONFIGS["r2d2"]
+        cfg = dataclasses.replace(
+            cfg,
+            env_name=CONFIGS["atari"].env_name,
+            network=dataclasses.replace(cfg.network, torso="small",
+                                        hidden=32, lstm_size=8,
+                                        compute_dtype="float32",
+                                        lstm_dtype="float32"),
+            actor=dataclasses.replace(cfg.actor, num_envs=4),
+            replay=dataclasses.replace(cfg.replay, capacity=256,
+                                       min_fill=32, burn_in=2,
+                                       unroll_length=4,
+                                       sequence_stride=2,
+                                       flat_storage=flat),
+            learner=dataclasses.replace(cfg.learner, n_step=2,
+                                        batch_size=8),
+            train_every=4,
+        )
+        env = make_jax_env(cfg.env_name)
+        net = build_network(cfg.network, env.num_actions)
+        init, run_chunk = make_r2d2_train(cfg, env, net)
+        run_j = jax.jit(run_chunk, static_argnums=1)
+        carry = init(jax.random.PRNGKey(7))
+        carry, metrics = run_j(carry, 40)
+        return jax.device_get(carry.learner.params), \
+            float(metrics["loss"])
+
+    p_tiled, loss_tiled = run(False)
+    p_flat, loss_flat = run(True)
+    assert loss_tiled == loss_flat
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 p_tiled, p_flat)

@@ -60,6 +60,38 @@ def _seq_fill(state, steps, num_envs, seq_len, stride, dones=()):
     return state
 
 
+def test_sequence_ring_merged_rows_matches_tiled():
+    """Flat [T*B, ...] obs storage (replay.flat_storage for pixel
+    sequence rings) is a pure re-layout: the same adds and sample key
+    must yield identical sequences, states, and weights."""
+    def drive(merge):
+        state = sring.sequence_ring_init(12, 2, jnp.zeros((3, 2)),
+                                         lstm_size=4,
+                                         merge_obs_rows=merge)
+        for w in range(14):               # wraps past slot 11
+            obs = (jnp.full((2, 3, 2), float(w))
+                   + jnp.arange(2, dtype=jnp.float32)[:, None, None] * 100)
+            carry = (jnp.full((2, 4), float(w)), jnp.zeros((2, 4)))
+            state = sring.sequence_ring_add(
+                state, obs, jnp.full((2,), w % 3, jnp.int32),
+                jnp.full((2,), float(w)),
+                jnp.full((2,), w == 6), jnp.zeros((2,), jnp.bool_),
+                carry, seq_len=4, stride=1, merge_obs_rows=merge)
+        return sring.sequence_ring_sample(
+            state, jax.random.PRNGKey(3), batch_size=6, seq_len=4,
+            alpha=0.6, beta=jnp.float32(0.4), merge_obs_rows=merge)
+
+    a, b = drive(False), drive(True)
+    np.testing.assert_array_equal(np.asarray(a.obs), np.asarray(b.obs))
+    for name in ("action", "reward", "done", "reset", "weights",
+                 "t_idx", "b_idx"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, name)),
+                                      np.asarray(getattr(b, name)))
+    for i in range(2):
+        np.testing.assert_array_equal(np.asarray(a.start_state[i]),
+                                      np.asarray(b.start_state[i]))
+
+
 def test_sequence_seeding_alignment_and_overwrite():
     # 10 slots, L=4, stride=2: writes 0..9; start w becomes seedable when
     # write w+3 lands; seeded starts are the even write indices.
