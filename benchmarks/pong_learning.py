@@ -47,13 +47,18 @@ from tpu_battery import gate_backend  # noqa: E402
 
 def _apply_head(cfg, head: str):
     """Head surgery mirroring tests/test_pixel_learning.py, with C51's
-    support sized to Pong's ±5 returns. dqn = the atari config as-is."""
+    support sized per game (cfg.env_name). dqn = the atari config as-is."""
     import dataclasses as dc
 
     if head == "dqn":
         return cfg
     if head == "c51":
-        net = dc.replace(cfg.network, num_atoms=51, v_min=-6.0, v_max=6.0)
+        # Support sized to the game's return range: Pong is a ±5 rally
+        # game; Breakout returns count bricks (0..72).
+        v_min, v_max = {"pixel_breakout": (-1.0, 80.0)}.get(
+            cfg.env_name, (-6.0, 6.0))
+        net = dc.replace(cfg.network, num_atoms=51, v_min=v_min,
+                         v_max=v_max)
         return dc.replace(cfg, network=net)
     if head == "qrdqn":
         return dc.replace(cfg, network=dc.replace(cfg.network,
@@ -144,10 +149,12 @@ def _base_cfg(args):
     cfg = CONFIGS["atari"]
     if args.smoke:
         # CPU harness check: tiny everything, bar not enforced — but the
-        # SAME head family as the chip run, so a head-specific config
-        # bug fails here instead of costing a window its compile time.
+        # SAME head family AND env as the chip run, so a head- or
+        # env-specific config bug (e.g. the per-game C51 support) fails
+        # here instead of costing a window its compile time.
         cfg = dataclasses.replace(
             cfg,
+            env_name=args.env,
             network=dataclasses.replace(cfg.network, torso="small",
                                         hidden=32),
             actor=dataclasses.replace(cfg.actor, num_envs=8,
