@@ -264,11 +264,12 @@ def _measure(jax, device, smoke: bool):
         # 16384 pixel slots ~= 0.5 GB of HBM for the obs ring. The
         # 2026-08-01 ring-size axis on a 16 GB v5e: 627k/619k/605k/572k/
         # 527k env-steps/s at 8k/16k/32k/65k/131k slots — smaller rings
-        # keep the PER tree + stack-gather hot. 16k is the default: near
-        # the knee while still a credible replay window (16 iterations of
-        # history at 1024 lanes; PER sampling work is size-independent
-        # per draw). Production configs size their rings for learning
-        # (e.g. atari: 200k), not for this contract metric.
+        # keep the frame-stack gather hot (the atari preset samples the
+        # ring UNIFORMLY; there is no PER tree in this program). 16k is
+        # the default: near the knee while still a credible replay
+        # window (16 iterations of history at 1024 lanes). Production
+        # configs size their rings for learning (e.g. atari: 200k), not
+        # for this contract metric.
         replay=dataclasses.replace(
             cfg.replay,
             capacity=s["ring"],

@@ -12,8 +12,9 @@ the headline bench (bench.py steps this exact env at ~600k
 env-steps/s/chip) — trained until it is WINNING whole games of the
 device-native Pong (envs/pixel_pong.py: ±1 per point, first-to-5
 episodes, tracking opponent, spin). Same production stack as the atari
-config: Nature CNN bf16, uint8 84x84x4 frame stacks, n-step TD, PER
-ring, epsilon-greedy per lane.
+config: Nature CNN bf16, uint8 84x84x4 frame stacks, n-step TD, uniform
+replay ring (the atari preset is plain Nature DQN; --head rainbow adds
+PER + dueling + noisy), epsilon-greedy per lane.
 
 Bar (ale_learning convention): FIRST chunk's training episode-return
 window (epsilon ~1 -> the de-facto random baseline, ~-5 of the 5-point
@@ -52,14 +53,29 @@ def _apply_head(cfg, head: str):
 
     if head == "dqn":
         return cfg
-    if head == "c51":
+    if head in ("c51", "rainbow"):
         # Support sized to the game's return range: Pong is a ±5 rally
         # game; Breakout returns count bricks (0..72).
         v_min, v_max = {"pixel_breakout": (-1.0, 80.0)}.get(
             cfg.env_name, (-6.0, 6.0))
         net = dc.replace(cfg.network, num_atoms=51, v_min=v_min,
-                         v_max=v_max)
-        return dc.replace(cfg, network=net)
+                         v_max=v_max, noisy=(head == "rainbow"),
+                         dueling=(head == "rainbow" or cfg.network.dueling))
+        cfg = dc.replace(cfg, network=net)
+        if head == "rainbow":
+            # The FULL Rainbow combination on the atari torso: the
+            # base preset is plain Nature DQN (uniform replay, no
+            # dueling), so add PER + dueling here, and NoisyNet
+            # exploration replaces the epsilon ladder (rainbow preset
+            # convention, config.py).
+            cfg = dc.replace(
+                cfg,
+                actor=dc.replace(cfg.actor, epsilon_start=0.0,
+                                 epsilon_end=0.0),
+                replay=dc.replace(cfg.replay, prioritized=True,
+                                  priority_exponent=0.5,
+                                  importance_exponent=0.4))
+        return cfg
     if head == "qrdqn":
         return dc.replace(cfg, network=dc.replace(cfg.network,
                                                   num_atoms=64,
@@ -233,7 +249,8 @@ def main() -> int:
                    help="250 x 1024 lanes = 256k frames per logged chunk")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--head", default="dqn",
-                   choices=["dqn", "c51", "qrdqn", "iqn", "mdqn", "r2d2"],
+                   choices=["dqn", "c51", "rainbow", "qrdqn", "iqn",
+                            "mdqn", "r2d2"],
                    help="algorithm family on the same torso/replay stack "
                         "(surgery mirrors tests/test_pixel_learning.py; "
                         "r2d2 instead swaps in the recurrent runtime with "

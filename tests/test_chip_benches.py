@@ -50,7 +50,7 @@ def test_apex_split_bench_smoke_vector():
     assert row["platforms"] == "cpu"  # smoke must never record TPU-ish rows
 
 
-@pytest.mark.parametrize("head", ["dqn", "c51"])
+@pytest.mark.parametrize("head", ["dqn", "c51", "rainbow"])
 def test_pong_learning_smoke(head):
     """--smoke must exercise the SAME head family as the chip run would
     (a head-specific config bug caught here costs seconds; on the chip
