@@ -135,6 +135,14 @@ def _sizes(smoke: bool) -> dict:
         "batch": _env_int("BENCH_BATCH", 32 if smoke else 512),
         "train_every": _env_int("BENCH_TRAIN_EVERY",
                                 CONFIGS["atari"].train_every),
+        # BENCH_PRIORITIZED=1 swaps the uniform ring for device PER
+        # (ReplayConfig default alpha 0.6 / beta 0.4) — the Ape-X-shaped
+        # fused program, measured beside the default Nature-DQN one.
+        # Sampler routing follows production: XLA stratified-CDF by
+        # default (the small-ring regime), the Pallas kernel with
+        # BENCH_PALLAS_SAMPLER=1 (what the apex preset's 1M shard uses).
+        "prioritized": os.environ.get("BENCH_PRIORITIZED") == "1",
+        "pallas_sampler": os.environ.get("BENCH_PALLAS_SAMPLER") == "1",
     }
 
 
@@ -273,6 +281,8 @@ def _measure(jax, device, smoke: bool):
         replay=dataclasses.replace(
             cfg.replay,
             capacity=s["ring"],
+            prioritized=s["prioritized"],
+            pallas_sampler=s["pallas_sampler"],
             min_fill=128 if smoke else 4_096),
         learner=dataclasses.replace(
             cfg.learner,
@@ -302,6 +312,9 @@ def _measure(jax, device, smoke: bool):
     value = measure_chunks * chunk * num_envs / dt
     extras = {"platform": device.platform,
               "device_kind": getattr(device, "device_kind", "unknown")}
+    if s["prioritized"]:
+        extras["prioritized"] = True  # default contract line unchanged
+        extras["sampler"] = "pallas" if s["pallas_sampler"] else "xla"
     # Conventional MFU: learner fwd+bwd+optimizer FLOPs only. Grad-step
     # count uses the last chunk's census — the cadence is deterministic in
     # steady state, so every measured chunk ran the same number (reading

@@ -260,6 +260,10 @@ def main() -> int:
     args = p.parse_args()
     if args.margin is None:
         args.margin = {"pixel_pong": 2.0, "pixel_breakout": 15.0}[args.env]
+    if args.head == "rainbow" and args.eps_end is not None:
+        print(json.dumps({"warning": "--head rainbow uses NoisyNet "
+                          "exploration with epsilon pinned to 0; "
+                          "--eps-end is ignored"}), flush=True)
 
     if args.smoke:
         import jax
