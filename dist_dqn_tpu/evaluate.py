@@ -26,53 +26,37 @@ class CheckpointMissingError(FileNotFoundError):
     (ADVICE round 3)."""
 
 
-def _carry_template(cfg: ExperimentConfig):
-    """A fused-loop carry pytree matching what a --checkpoint-replay run
-    saved — the restore template for ``carry``-kind checkpoints. Note
-    the replay ring is allocated at full config size (that is what the
-    checkpoint holds), so evaluating a big pixel run's carry checkpoint
-    costs ring-sized memory."""
-    from dist_dqn_tpu.envs import make_jax_env
-    from dist_dqn_tpu.models import build_network
+def _ckpt_prefix(checkpoint_dir: str):
+    """Where the params live inside this directory's checkpoints:
+    learner-kind saves the learner at the root, --checkpoint-replay
+    (carry-kind) nests it one level down."""
+    from dist_dqn_tpu.utils.checkpoint import read_checkpoint_kind
 
-    env = make_jax_env(cfg.env_name)
-    net = build_network(cfg.network, env.num_actions)
-    if cfg.network.lstm_size:
-        from dist_dqn_tpu.r2d2_loop import make_r2d2_train
-        init, _ = make_r2d2_train(cfg, env, net)
-    else:
-        from dist_dqn_tpu.train_loop import make_fused_train
-        init, _ = make_fused_train(cfg, env, net)
-    return init(jax.random.PRNGKey(0))
+    return (("learner",) if read_checkpoint_kind(checkpoint_dir) == "carry"
+            else ())
 
 
-def _restore_latest(checkpoint_dir: str, example, step=None, cfg=None):
-    """(frames, learner) from the newest checkpoint (or a specific
+def _restore_latest(checkpoint_dir: str, example_params, step=None):
+    """(frames, params) from the newest checkpoint (or a specific
     retained ``step``). Read-only surface: never create the directory on
     a typo'd path, and release the orbax manager after the one restore.
 
-    With ``cfg`` given, directories stamped as ``carry`` kind (fused
-    --checkpoint-replay runs) are restored against a full carry
-    template and the learner is extracted — those runs stay evaluable.
+    Eval needs only the policy parameters, so this partial-restores the
+    params subtree (utils/checkpoint.py restore_params): the training
+    run's optimizer structure (e.g. lr-schedule state) never constrains
+    an eval invocation, and carry-kind (--checkpoint-replay) runs are
+    evaluable without a ring-sized carry template.
     """
-    from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
-                                               read_checkpoint_kind)
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
     if not os.path.isdir(checkpoint_dir):
         raise CheckpointMissingError(
             f"no checkpoint found under {checkpoint_dir!r}")
-    unwrap = False
-    if read_checkpoint_kind(checkpoint_dir) == "carry":
-        if cfg is None:
-            raise ValueError(
-                f"{checkpoint_dir!r} holds --checkpoint-replay (full-"
-                "carry) checkpoints; this surface cannot rebuild the "
-                "carry template without the experiment config")
-        example = _carry_template(cfg)
-        unwrap = True
+    prefix = _ckpt_prefix(checkpoint_dir)
     ckpt = TrainCheckpointer(checkpoint_dir)
     try:
-        restored = ckpt.restore_latest(example, step=step)
+        restored = ckpt.restore_params(example_params, step=step,
+                                       prefix=prefix)
     except FileNotFoundError as e:
         # Convert to the skippable type ONLY when the requested step is
         # genuinely gone from the retained set (live retention race) —
@@ -86,9 +70,6 @@ def _restore_latest(checkpoint_dir: str, example, step=None, cfg=None):
     if restored is None:
         raise CheckpointMissingError(
             f"no checkpoint found under {checkpoint_dir!r}")
-    if unwrap:
-        frames, carry = restored
-        return frames, carry.learner
     return restored
 
 
@@ -135,9 +116,9 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     Raises FileNotFoundError if the directory holds no checkpoint.
     """
     example, evaluator, k_eval = _build_eval(cfg, episodes, epsilon, seed)
-    frames, learner = _restore_latest(checkpoint_dir, example, step=step,
-                                      cfg=cfg)
-    mean_return = float(evaluator(learner.params, k_eval))
+    frames, params = _restore_latest(checkpoint_dir, example.params,
+                                     step=step)
+    mean_return = float(evaluator(params, k_eval))
     return {"eval_return": mean_return, "frames": frames,
             "episodes": episodes, "config": cfg.name}
 
@@ -161,14 +142,13 @@ def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
     collected mid-walk by a live training run's retention are skipped
     with a log line rather than aborting the walk.
     """
-    from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
-                                               read_checkpoint_kind)
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
     if not os.path.isdir(checkpoint_dir):
         raise FileNotFoundError(
             f"no checkpoint found under {checkpoint_dir!r}")
     rows = []
-    carry_kind = read_checkpoint_kind(checkpoint_dir) == "carry"
+    prefix = _ckpt_prefix(checkpoint_dir)
     ckpt = TrainCheckpointer(checkpoint_dir)
     try:
         steps = ckpt.all_steps()
@@ -179,21 +159,17 @@ def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
         # exists — an empty dir errors without paying the build.
         example, evaluator, k_eval = _build_eval(cfg, episodes, epsilon,
                                                  seed)
-        if carry_kind:
-            # --checkpoint-replay runs saved the whole carry; template
-            # accordingly and read .learner off each restore.
-            example = _carry_template(cfg)
         for step in steps:
             try:
-                frames, restored = ckpt.restore_latest(example, step=step)
+                frames, params = ckpt.restore_params(
+                    example.params, step=step, prefix=prefix)
             except FileNotFoundError:
                 # Narrow scope: only the restore is guarded, so an
                 # unrelated FileNotFoundError cannot be mislabeled.
                 if log_fn:
                     log_fn(_skip_row(step))
                 continue
-            learner = restored.learner if carry_kind else restored
-            row = {"eval_return": float(evaluator(learner.params, k_eval)),
+            row = {"eval_return": float(evaluator(params, k_eval)),
                    "frames": frames, "episodes": episodes,
                    "config": cfg.name}
             rows.append(row)
@@ -242,11 +218,11 @@ def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
     rng = jax.random.PRNGKey(seed)
     rng, k_init = jax.random.split(rng)
     example = init(k_init, jax.numpy.asarray(obs[0]))
-    frames, learner = _restore_latest(checkpoint_dir, example, step=step,
-                                      cfg=cfg)
+    frames, params = _restore_latest(checkpoint_dir, example.params,
+                                     step=step)
 
     returns, truncated, _ = run_greedy_episodes(
-        env, act, learner.params, rng, episodes=episodes,
+        env, act, params, rng, episodes=episodes,
         recurrent_carry=carry if recurrent else None, epsilon=epsilon,
         max_steps=max_steps)
     return {"eval_return": float(returns.mean()), "frames": frames,

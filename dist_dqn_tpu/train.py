@@ -133,9 +133,13 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     if checkpoint_dir:
         from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
                                                    record_checkpoint_kind)
+        # The cadence chain must never bottom out at 0 (an explicit
+        # --eval-every-steps 0 zeroes the eval period): save_every=0
+        # would make maybe_save fire on EVERY chunk.
         ckpt = TrainCheckpointer(
             checkpoint_dir,
-            save_every_frames=save_every_frames or cfg.eval_every_steps)
+            save_every_frames=save_every_frames or cfg.eval_every_steps
+            or 100_000)
         # Raises with the actual cause if the directory was written with
         # the OTHER --checkpoint-replay setting (the restore would
         # otherwise fail as a misleading structure-mismatch error).
@@ -339,7 +343,9 @@ def main():
         cfg = apply_overrides(CONFIGS[args.config], args.overrides)
     except ValueError as e:
         parser.error(str(e))
-    if args.eval_every_steps:
+    if args.eval_every_steps is not None:
+        # An explicit 0 DISABLES eval (the loop convention) — a plain
+        # truthiness test here silently fell back to the config period.
         import dataclasses as _dc
         cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)
     if args.runtime == "apex":
@@ -369,8 +375,10 @@ def main():
             total_env_steps=args.total_env_steps or cfg.total_env_steps,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_replay=args.checkpoint_replay,
-            save_every_steps=args.save_every_frames or cfg.eval_every_steps,
-            eval_every_steps=args.eval_every_steps or 0,
+            save_every_steps=(args.save_every_frames or cfg.eval_every_steps
+                              or 100_000),
+            eval_every_steps=(args.eval_every_steps
+                              if args.eval_every_steps is not None else 0),
             eval_episodes=cfg.eval_episodes,
             tcp_port=args.tcp_port,
             num_remote_actors=args.num_remote_actors,

@@ -66,6 +66,7 @@ class TrainCheckpointer:
                 max_to_keep=self.max_to_keep, create=True),
         )
         self._next_save = 0
+        self._meta_mgr = None  # lazy; only eval's restore_params needs it
 
     def maybe_save(self, frames: int, learner: PyTree) -> bool:
         """Save when the frame cursor crosses the next save boundary."""
@@ -132,9 +133,114 @@ class TrainCheckpointer:
             self._next_save = step + self.save_every_frames
         return int(step), restored
 
+    def restore_params(self, example_params: PyTree,
+                       step: Optional[int] = None,
+                       prefix: Tuple[str, ...] = ()
+                       ) -> Optional[Tuple[int, PyTree]]:
+        """Restore ONLY the policy parameters of a checkpoint.
+
+        Deploy surfaces (evaluate) need the params to match the live
+        network — the true requirement — but ``restore_latest`` also
+        demands the optimizer/counter structure match, coupling eval
+        invocations to training-only knobs (an lr schedule adds a count
+        leaf to opt_state, so an eval without the exact training
+        ``--set`` flags would fail its restore). This surface templates
+        just the ``(*prefix, "params")`` subtree from the live example
+        and partial-restores it; optimizer contents never constrain
+        eval, and carry-kind checkpoints (``prefix=("learner",)``) no
+        longer pay a ring-sized template either. Read-only: never
+        advances the save schedule.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        default_dev = jax.local_devices()[0]
+        live_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(x), x.dtype,
+                sharding=getattr(x, "sharding", None)
+                or jax.sharding.SingleDeviceSharding(default_dev)),
+            example_params)
+        # Partial restore takes the INTERSECTION silently, so a network
+        # drift in either direction (template leaves missing on disk, OR
+        # on-disk heads the live net lacks) must be caught up front by
+        # comparing the params subtree against the on-disk metadata —
+        # otherwise a mismatched eval runs with wrong/unrestored params
+        # instead of erroring.
+        self._check_params_match(step, live_abs, prefix)
+        rargs = ocp.checkpoint_utils.construct_restore_args(live_abs)
+        item: Any = live_abs
+        for key in reversed(prefix + ("params",)):
+            item = {key: item}
+            rargs = {key: rargs}
+        restored = self._mgr.restore(
+            step, args=ocp.args.PyTreeRestore(
+                item, restore_args=rargs, partial_restore=True))
+        out = restored
+        for key in prefix + ("params",):
+            out = out[key]
+        bad = [str(p) for p, leaf
+               in jax.tree_util.tree_flatten_with_path(out)[0]
+               if not hasattr(leaf, "addressable_data")
+               and isinstance(leaf, jax.ShapeDtypeStruct)]
+        if bad:  # defense in depth behind _check_params_match
+            raise ValueError(
+                f"checkpoint restore left {len(bad)} parameter leaves "
+                f"unrestored (first: {bad[0]}) — network architecture "
+                "drift between save and eval.")
+        return int(step), out
+
+    def _check_params_match(self, step: int, live_abs: PyTree,
+                            prefix: Tuple[str, ...]) -> None:
+        """Raise the config-drift error unless the on-disk params
+        subtree matches ``live_abs`` in structure, shape and dtype."""
+        if self._meta_mgr is None:
+            # The main manager has no handler registry (restore args
+            # pick its handlers), so item_metadata on it returns None;
+            # cache one metadata-capable manager for the whole walk.
+            self._meta_mgr = ocp.CheckpointManager(
+                self.directory,
+                item_handlers=ocp.StandardCheckpointHandler())
+        meta = self._meta_mgr.item_metadata(step)
+        try:
+            for key in prefix + ("params",):
+                meta = meta[key]
+        except (KeyError, TypeError) as e:
+            raise ValueError(
+                f"checkpoint at step {step} has no "
+                f"{'/'.join(prefix + ('params',))} subtree — wrong "
+                "checkpoint kind or directory") from e
+        meta = jax.tree.map(lambda m: m, meta)  # plain containers
+        live_paths = {
+            tuple(str(k) for k in p): (tuple(leaf.shape), leaf.dtype)
+            for p, leaf in jax.tree_util.tree_flatten_with_path(
+                live_abs)[0]}
+        disk_paths = {
+            tuple(str(k) for k in p): (tuple(m.shape),
+                                       np.dtype(m.dtype))
+            for p, m in jax.tree_util.tree_flatten_with_path(meta)[0]}
+        if live_paths != disk_paths:
+            only_live = sorted(set(live_paths) - set(disk_paths))[:3]
+            only_disk = sorted(set(disk_paths) - set(live_paths))[:3]
+            shape_drift = sorted(
+                k for k in set(live_paths) & set(disk_paths)
+                if live_paths[k] != disk_paths[k])[:3]
+            raise ValueError(
+                "checkpoint parameters do not match the current config's "
+                "network structure — it was saved with a different "
+                "network architecture. Rebuild with the same --config "
+                "and --set overrides used at save time.\n"
+                f"param leaves only in the live net: {only_live}\n"
+                f"only in the checkpoint: {only_disk}\n"
+                f"shape/dtype drift: {shape_drift}")
+
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+        if self._meta_mgr is not None:
+            self._meta_mgr.close()
+            self._meta_mgr = None
 
 
 _KIND_FILE = "CHECKPOINT_KIND"

@@ -192,6 +192,49 @@ def test_architecture_mismatch_error_names_the_cause(tmp_path):
     with pytest.raises(ValueError,
                        match="same --config and --set overrides"):
         evaluate_checkpoint(mismatched, ckpt_dir, episodes=1)
+    # The opposite drift (checkpoint has heads the live net lacks) must
+    # also error — partial restore would otherwise silently evaluate a
+    # structural subset of the saved policy.
+    dueling_dir = str(tmp_path / "dueling")
+    train(mismatched, total_env_steps=300, chunk_iters=75,
+          log_fn=lambda s: None, checkpoint_dir=dueling_dir)
+    with pytest.raises(ValueError,
+                       match="same --config and --set overrides"):
+        evaluate_checkpoint(cfg, dueling_dir, episodes=1)
+
+
+def test_evaluate_is_optimizer_agnostic(tmp_path):
+    """evaluate needs only the policy params: a checkpoint saved with a
+    SCHEDULED optimizer (extra schedule-count leaf in opt_state) must
+    evaluate WITHOUT the training run's optimizer flags — the deploy
+    surface partial-restores the params subtree (restore_params)."""
+    from dist_dqn_tpu.evaluate import evaluate_checkpoint
+    from dist_dqn_tpu.train import train
+
+    scheduled = CONFIGS["cartpole"]
+    scheduled = dataclasses.replace(
+        scheduled,
+        network=dataclasses.replace(scheduled.network, mlp_features=(32,)),
+        replay=dataclasses.replace(scheduled.replay, capacity=512,
+                                   min_fill=64),
+        learner=dataclasses.replace(scheduled.learner, batch_size=16,
+                                    lr_schedule="cosine",
+                                    lr_decay_steps=100,
+                                    lr_end_value=1e-5),
+        actor=dataclasses.replace(scheduled.actor, num_envs=4),
+        eval_every_steps=10**9,
+    )
+    ckpt_dir = str(tmp_path / "run")
+    train(scheduled, total_env_steps=300, chunk_iters=75,
+          log_fn=lambda s: None, checkpoint_dir=ckpt_dir)
+    # Same network, DEFAULT (constant-lr) optimizer: restore must work.
+    plain = dataclasses.replace(
+        scheduled, learner=dataclasses.replace(
+            scheduled.learner, lr_schedule="constant", lr_decay_steps=0,
+            lr_end_value=0.0))
+    out = evaluate_checkpoint(plain, ckpt_dir, episodes=2)
+    assert out["frames"] > 0
+    assert 1.0 <= out["eval_return"] <= 500.0
 
 
 def test_standalone_evaluate_risk_profile_swap(tmp_path):
