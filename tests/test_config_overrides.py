@@ -91,6 +91,40 @@ def test_train_cli_honors_set(tmp_path, capsys):
     assert rows and rows[0]["env_frames"] == 600
 
 
+def test_train_cli_eval_zero_disables_without_save_churn(tmp_path, capsys):
+    """An explicit --eval-every-steps 0 DISABLES eval (it used to fall
+    through a truthiness test to the config period), and the checkpoint
+    cadence must not collapse to save-every-chunk when it does."""
+    import json
+    import os
+    import sys
+    from unittest import mock
+
+    from dist_dqn_tpu.train import main
+
+    ckpt_dir = str(tmp_path / "ck")
+    argv = ["train", "--config", "cartpole", "--platform", "cpu",
+            "--total-env-steps", "1200", "--chunk-iters", "100",
+            "--eval-every-steps", "0",
+            "--checkpoint-dir", ckpt_dir,
+            "--set", "actor.num_envs=4",
+            "--set", "network.mlp_features=16",
+            "--set", "replay.capacity=512",
+            "--set", "replay.min_fill=64",
+            "--set", "learner.batch_size=16"]
+    with mock.patch.object(sys, "argv", argv):
+        main()
+    rows = [json.loads(line) for line in
+            capsys.readouterr().out.splitlines()
+            if line.startswith("{") and "env_frames" in line]
+    assert rows and all("eval_return" not in r for r in rows)
+    # 3 chunks ran; the save cadence fell back to a sane default —
+    # first boundary crossing (400) plus the end-of-run save (1200),
+    # NOT one per chunk (800 would appear if the cadence collapsed).
+    steps = {d for d in os.listdir(ckpt_dir) if d.isdigit()}
+    assert steps == {"400", "1200"}
+
+
 def test_train_cli_reports_bad_set_cleanly(capsys):
     """A bad --set exits via parser.error (clean usage message naming the
     failing path), not a traceback."""
