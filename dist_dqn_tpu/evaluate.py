@@ -108,9 +108,16 @@ def _build_eval(cfg: ExperimentConfig, episodes: int, epsilon: float,
 
 def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
                         episodes: int = 10, seed: int = 0,
-                        epsilon: float = 0.001, step: int = None) -> dict:
+                        epsilon: float = 0.001, step: int = None,
+                        export_params: str = None) -> dict:
     """Restore the newest checkpoint (or retained ``step``) and play
     greedy episodes.
+
+    ``export_params`` additionally writes the restored policy parameters
+    as a standalone pytree checkpoint (utils/checkpoint.save_pytree) —
+    the deploy artifact: a few MB of params with no optimizer state,
+    loadable anywhere via ``restore_pytree(path, example_params)``
+    without the training run's directory or flags.
 
     Returns {"eval_return": mean, "frames": checkpoint cursor, ...}.
     Raises FileNotFoundError if the directory holds no checkpoint.
@@ -119,8 +126,14 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     frames, params = _restore_latest(checkpoint_dir, example.params,
                                      step=step)
     mean_return = float(evaluator(params, k_eval))
-    return {"eval_return": mean_return, "frames": frames,
-            "episodes": episodes, "config": cfg.name}
+    out = {"eval_return": mean_return, "frames": frames,
+           "episodes": episodes, "config": cfg.name}
+    if export_params:
+        from dist_dqn_tpu.utils.checkpoint import save_pytree
+
+        save_pytree(os.path.abspath(export_params), params)
+        out["exported_params"] = os.path.abspath(export_params)
+    return out
 
 
 def _skip_row(step: int) -> dict:
@@ -272,7 +285,16 @@ def main():
                              "(oldest first, one JSON line each) — a "
                              "learning curve from the run directory "
                              "instead of just the newest point")
+    parser.add_argument("--export-params", default=None, metavar="PATH",
+                        help="also write the restored policy parameters "
+                             "as a standalone pytree checkpoint at PATH "
+                             "(params only, no optimizer state — the "
+                             "deploy artifact; JAX-env surface, newest/"
+                             "single step)")
     args = parser.parse_args()
+    if args.export_params and (args.all_steps or args.host_env):
+        parser.error("--export-params applies to the single-point JAX-env "
+                     "surface (not --all-steps or --host-env)")
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     try:
@@ -295,7 +317,8 @@ def main():
         else:
             out = evaluate_checkpoint(
                 cfg, args.checkpoint_dir,
-                episodes=args.episodes, seed=args.seed, step=step)
+                episodes=args.episodes, seed=args.seed, step=step,
+                export_params=args.export_params)
         tag_and_print(out)
 
     if args.all_steps and not args.host_env:

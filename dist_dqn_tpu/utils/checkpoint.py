@@ -298,8 +298,14 @@ def list_checkpoint_steps(directory: str) -> Tuple[int, ...]:
 
 
 def save_pytree(path: str, tree: PyTree) -> None:
-    """One-shot pytree save (e.g. final params export)."""
-    ocp.StandardCheckpointer().save(path, tree, force=True)
+    """One-shot pytree save (e.g. the --export-params deploy artifact).
+
+    The checkpointer saves asynchronously; close (which blocks on the
+    outstanding save) before returning so a CLI process can exit
+    immediately after — a dropped instance races interpreter shutdown
+    and loses the write."""
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=True)
 
 
 def restore_pytree(path: str, example: PyTree) -> Any:

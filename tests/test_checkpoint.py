@@ -236,6 +236,27 @@ def test_evaluate_is_optimizer_agnostic(tmp_path):
     assert out["frames"] > 0
     assert 1.0 <= out["eval_return"] <= 500.0
 
+    # --export-params: the deploy artifact round-trips bit-equal.
+    import numpy as np
+
+    from dist_dqn_tpu.evaluate import _build_eval
+    from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                               restore_pytree)
+
+    export = str(tmp_path / "deploy_params")
+    out = evaluate_checkpoint(plain, ckpt_dir, episodes=2,
+                              export_params=export)
+    assert out["exported_params"] == export
+    example, _, _ = _build_eval(plain, 2, 0.001, 0)
+    reloaded = restore_pytree(export, example.params)
+    ckpt = TrainCheckpointer(ckpt_dir)
+    try:
+        _, direct = ckpt.restore_params(example.params)
+    finally:
+        ckpt.close()
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), reloaded, direct)
+
 
 def test_standalone_evaluate_risk_profile_swap(tmp_path):
     """An IQN checkpoint restores under a DIFFERENT deploy-time risk
