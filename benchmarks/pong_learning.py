@@ -132,7 +132,10 @@ def _cfg(args):
         # The schedule counts GRAD steps (agents/dqn.py:make_optimizer);
         # convert the frame horizon at the FINAL config's cadence
         # (mdqn overrides train_every to 1, r2d2 sizes its own lanes).
-        grad_per_iter = cfg.actor.num_envs * cfg.train_every
+        # frames-per-grad-step = num_envs * train_every / updates_per_train
+        # (each train event runs updates_per_train grad steps).
+        grad_per_iter = max(
+            1, cfg.actor.num_envs * cfg.train_every // cfg.updates_per_train)
         lr0 = cfg.learner.learning_rate
         cfg = dataclasses.replace(cfg, learner=dataclasses.replace(
             cfg.learner,

@@ -62,6 +62,11 @@ def make_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
                 init_value=cfg.learning_rate, end_value=cfg.lr_end_value,
                 transition_steps=cfg.lr_decay_steps)
         else:
+            if cfg.learning_rate <= 0:
+                raise ValueError(
+                    "lr_schedule='cosine' needs learning_rate > 0 (the "
+                    "decay floor is expressed as the ratio "
+                    "lr_end_value / learning_rate)")
             lr = optax.cosine_decay_schedule(
                 init_value=cfg.learning_rate, decay_steps=cfg.lr_decay_steps,
                 alpha=cfg.lr_end_value / cfg.learning_rate)
@@ -87,8 +92,12 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     meant to run under ``shard_map`` over that mesh axis: gradients (and the
     scalar loss) are ``pmean``-ed across learners — the TPU-native
     equivalent of the reference's multi-learner NCCL allreduce
-    (BASELINE.json:5) — so replicated params stay bit-identical while each
-    learner consumes its own replay shard's batch.
+    (BASELINE.json:5) — so every learner applies the same averaged
+    gradient (replicas stay consistent) while each consumes its own
+    replay shard's batch. The sharded step is numerically equivalent to
+    the single-device full-batch step (rtol 2e-5 — cross-shard pmean
+    reorders the reduction, so exact bit-equality is not expected;
+    tests/test_distributed.py).
     """
     tx = make_optimizer(cfg)
 
@@ -179,10 +188,12 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
             # online draws conditioned into the net, N' independent
             # target draws as Bellman samples (Dabney et al., 2018b).
             # Tau keys fold in each example's GLOBAL batch position so
-            # the draws are identical whether the batch is whole on one
-            # device or row-sharded over the dp mesh — that makes the
-            # sharded IQN step bit-equal to single-device, like the
-            # deterministic heads (VERDICT round-3 ask #8).
+            # the draws are bit-identical whether the batch is whole on
+            # one device or row-sharded over the dp mesh — that lets the
+            # sharded IQN step join the same numerical-equivalence test
+            # (rtol 2e-5) as the deterministic heads (VERDICT round-3
+            # ask #8; exact bit-equality is not expected — pmean
+            # reorders the cross-shard reduction).
             local_b = batch.obs.shape[0]
             ids = jnp.arange(local_b, dtype=jnp.uint32)
             if axis_name is not None:
