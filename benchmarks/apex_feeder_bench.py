@@ -1,0 +1,204 @@
+"""Chip-side Ape-X service ceiling: in-RAM feeders, no emulator.
+
+VERDICT round-4 missing #1 / next #1: the end-to-end split bench
+(apex_split_bench.py) honestly measures this dev box's single CPU core
+running emulator + preprocessing + actors + service — the chip-side
+service idle-waits, so the number a v4-32 deployment actually plans
+around (how many records/s the TPU-side service can sustain when the
+host side is NOT starved) stayed unmeasured. This bench replaces the
+rollout actors with ``actors/feeder.py`` processes that replay
+pre-generated, pre-encoded trajectory records through the PRODUCTION
+shm transport at maximum rate; everything downstream is the production
+service — ``_drain_transports`` -> batched act -> C++ n-step assembly ->
+|TD| priority bootstrap -> PER insert -> bounded train passes ->
+priority write-back.
+
+Reported per variant: sustained records/s, env-steps/s-equivalent
+(records x lanes), grad-steps/s, and the cadence debt (whether the
+learner kept the configured inserts-per-grad ratio at that ingest rate
+— if not, trains-flat-out is the ceiling's meaning, standard Ape-X
+semantics).
+
+Honesty note: feeders and service still share this box's ONE core, so
+the feeder-side memcpy pump steals some service CPU — the measured
+ceiling is a LOWER bound on what the service does with a dedicated
+core. The emulator/preprocessing cost (the thing the split bench is
+bound by) is gone, which is the point.
+
+Wedge discipline (verify skill): probe phase pays all compiles and
+measures the achievable rate; the measure phase's frame budget is
+derived from it, so the run cannot be oversized.
+
+Usage:  python benchmarks/apex_feeder_bench.py [--allow-cpu]
+            [--variants pixel vector] [--measure-seconds 120]
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpu_battery import gate_backend  # noqa: E402
+
+
+def _configs(variant: str, smoke: bool):
+    """(cfg, rt_kwargs, probe_total) per variant; probe sizes only —
+    the measure phase is sized from the probe's measured rate."""
+    from dist_dqn_tpu.config import CONFIGS
+
+    if variant == "pixel":
+        cfg = CONFIGS["apex"]
+        cfg = dataclasses.replace(
+            cfg,
+            # Host-DRAM shard: 200k pixel slots ~ 5.6 GB on this box
+            # (the 1M-slot pod shard would fit the 125 GB DRAM too, but
+            # prefilling it would dominate the bench; C++ sum-tree cost
+            # is measured separately and near-flat in shard size).
+            replay=dataclasses.replace(
+                cfg.replay, capacity=200_000 if not smoke else 8_192,
+                min_fill=2_000 if not smoke else 200),
+            learner=dataclasses.replace(
+                cfg.learner, batch_size=512 if not smoke else 32),
+        )
+        rt_kwargs = dict(host_env="feeder:pixel", num_actors=2,
+                         envs_per_actor=8)
+        probe_total = 20_000 if not smoke else 1_000
+    elif variant == "vector":
+        cfg = CONFIGS["apex"]
+        cfg = dataclasses.replace(
+            cfg,
+            network=dataclasses.replace(cfg.network, torso="mlp",
+                                        mlp_features=(256, 256), hidden=0,
+                                        compute_dtype="float32"),
+            replay=dataclasses.replace(
+                cfg.replay, capacity=500_000 if not smoke else 8_192,
+                min_fill=2_000 if not smoke else 200),
+            learner=dataclasses.replace(
+                cfg.learner, batch_size=512 if not smoke else 32),
+        )
+        rt_kwargs = dict(host_env="feeder:vector", num_actors=2,
+                         envs_per_actor=16)
+        probe_total = 60_000 if not smoke else 2_000
+    else:
+        raise ValueError(f"unknown variant {variant!r}")
+    return cfg, rt_kwargs, probe_total
+
+
+def _run(cfg, rt_kwargs, total: int):
+    """One service run; returns (summary, wall_s, steady_rates)."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+
+    rows = []
+
+    def capture(line):
+        try:
+            rows.append(json.loads(line))
+        except (TypeError, ValueError):
+            pass
+
+    rt = ApexRuntimeConfig(total_env_steps=total, log_every_s=5.0,
+                           **rt_kwargs)
+    t0 = time.perf_counter()
+    summary = run_apex(cfg, rt, log_fn=capture)
+    wall = time.perf_counter() - t0
+    rate_rows = [r for r in rows
+                 if r.get("env_steps_per_sec_per_chip", 0) > 0]
+    steady = rate_rows[-1] if rate_rows else {}
+    return summary, wall, steady
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="smoke the harness on CPU (tiny sizes; NOT for "
+                        "BASELINE numbers)")
+    p.add_argument("--variants", nargs="*", default=["vector", "pixel"])
+    p.add_argument("--measure-seconds", type=float, default=120.0)
+    args = p.parse_args()
+
+    if args.allow_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platforms = "cpu"
+    else:
+        platforms, gate_rc = gate_backend(allow_cpu=False,
+                                          tool="apex_feeder")
+        if gate_rc is not None:
+            return gate_rc
+
+    ok = True
+    for variant in args.variants:
+        cfg, rt_kwargs, probe_total = _configs(variant, args.allow_cpu)
+        lanes = rt_kwargs["envs_per_actor"]
+
+        # Phase 1 — fixed small probe: pays every compile, measures the
+        # saturated ingest rate on this host.
+        summary, wall, steady = _run(cfg, rt_kwargs, probe_total)
+        probe_rate = summary["env_steps"] / max(wall, 1e-9)
+        print(json.dumps({"bench": "apex_feeder", "variant": variant,
+                          "phase": "probe", "wall_s": round(wall, 1),
+                          "avg_env_steps_per_sec": round(probe_rate, 1),
+                          **{k: summary[k] for k in
+                             ("env_steps", "grad_steps", "ring_dropped",
+                              "bad_records")}}), flush=True)
+
+        # Phase 2 — measure run sized FROM the probe rate (compiles
+        # cached in-process): ~measure-seconds of steady state.
+        best_rate = max(probe_rate,
+                        steady.get("env_steps_per_sec_per_chip") or 0.0)
+        measure_total = max(int(best_rate * args.measure_seconds),
+                            2 * probe_total)
+        summary, wall, steady = _run(cfg, rt_kwargs, measure_total)
+        avg_rate = summary["env_steps"] / max(wall, 1e-9)
+        steady_rate = steady.get("env_steps_per_sec_per_chip") or avg_rate
+        # Cadence debt: the ratio the config ASKS for vs what the
+        # learner delivered at this ingest rate. Read the real runtime
+        # default rather than duplicating the literal.
+        from dist_dqn_tpu.actors.service import ApexRuntimeConfig
+        inserts_per_grad = ApexRuntimeConfig(
+            **rt_kwargs).inserts_per_grad_step
+        target_grad = summary["env_steps"] // inserts_per_grad
+        row = {
+            "bench": "apex_feeder", "variant": variant, "phase": "measure",
+            "platforms": platforms,
+            "host_env": rt_kwargs["host_env"],
+            "feeders": rt_kwargs["num_actors"],
+            "lanes_per_record": lanes,
+            "batch_size": cfg.learner.batch_size,
+            "replay_capacity": cfg.replay.capacity,
+            "total_env_steps": measure_total,
+            "wall_s": round(wall, 1),
+            "avg_env_steps_per_sec": round(avg_rate, 1),
+            "steady_env_steps_per_sec_per_chip": steady_rate,
+            "steady_records_per_sec": round(steady_rate / lanes, 1),
+            "steady_grad_steps_per_sec":
+                steady.get("grad_steps_per_sec"),
+            "grad_steps_target_at_cadence": int(target_grad),
+            "learner_kept_cadence":
+                bool(summary["grad_steps"] >= 0.95 * target_grad),
+            "note": "feeders share the 1 host core with the service -> "
+                    "lower bound on a dedicated-host service; no "
+                    "emulator/preprocessing in the loop (see module "
+                    "docstring)",
+            **{k: summary[k] for k in
+               ("env_steps", "grad_steps", "replay_size", "ring_dropped",
+                "tcp_backpressure", "bad_records", "actor_restarts")},
+        }
+        print(json.dumps(row), flush=True)
+        # ring_dropped counts ring-FULL push rejections: for feeders that
+        # is the normal backpressure signal (the payload is retried, not
+        # lost — actors/feeder.py pump loop), so unlike the split bench
+        # it is reported, not failed on. bad_records is still corruption.
+        ok = ok and summary["bad_records"] == 0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -411,8 +411,15 @@ class ApexLearnerService:
         from dist_dqn_tpu.actors.actor import run_actor, run_remote_actor
         ctx = mp.get_context("spawn")
         if actor_id < self.rt.num_actors:
+            # feeder:<spec> host envs swap the rollout actor for the
+            # in-RAM trajectory feeder (actors/feeder.py) — identical
+            # spawn contract, no emulator in the loop.
+            target = run_actor
+            if self.rt.host_env.startswith("feeder:"):
+                from dist_dqn_tpu.actors.feeder import run_feeder
+                target = run_feeder
             p = ctx.Process(
-                target=run_actor,
+                target=target,
                 args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
                       1000 + 7 * actor_id, f"req_{self.run_id}",
                       f"act_{self.run_id}_{actor_id}", self.stop_path),

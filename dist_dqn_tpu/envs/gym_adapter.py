@@ -242,7 +242,7 @@ def is_pixel_env(name: str) -> bool:
     """True if ``make_host_env(name)`` yields image observations (CNN torso
     required). Owned here, next to the routing, so callers (train CLI) never
     maintain their own name lists."""
-    return name in ("pong", "breakout") \
+    return name in ("pong", "breakout", "feeder:pixel") \
         or name.startswith(("ale:", "dmc:"))
 
 
@@ -258,6 +258,15 @@ def make_host_env(name: str, num_envs: int, seed: int = 0,
     envs/host_breakout.py) — offline stand-ins that exercise the full
     Atari-shaped actor/learner path without ale-py.
     """
+    if name.startswith("feeder:"):
+        # Null spec env for the in-RAM feeder harness (actors/feeder.py):
+        # carries shapes/action count for the service probe; dynamics are
+        # random draws (feeder runs replace actor stepping entirely).
+        from dist_dqn_tpu.actors.feeder import FeederSpecEnv
+
+        return HostVectorEnv(lambda: FeederSpecEnv(name), num_envs,
+                             seed=seed)
+
     if name == "pong":
         from dist_dqn_tpu.envs.host_pong import HostPixelPong
 
