@@ -90,7 +90,7 @@ def _configs(variant: str, smoke: bool):
     return cfg, rt_kwargs, probe_total
 
 
-def _run(cfg, rt_kwargs, total: int):
+def _run(cfg, rt_kwargs, total: int, trace_path=None):
     """One service run; returns (summary, wall_s, steady_rates)."""
     from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
 
@@ -103,7 +103,7 @@ def _run(cfg, rt_kwargs, total: int):
             pass
 
     rt = ApexRuntimeConfig(total_env_steps=total, log_every_s=5.0,
-                           **rt_kwargs)
+                           trace_path=trace_path, **rt_kwargs)
     t0 = time.perf_counter()
     summary = run_apex(cfg, rt, log_fn=capture)
     wall = time.perf_counter() - t0
@@ -120,6 +120,12 @@ def main() -> int:
                         "BASELINE numbers)")
     p.add_argument("--variants", nargs="*", default=["vector", "pixel"])
     p.add_argument("--measure-seconds", type=float, default=120.0)
+    p.add_argument("--trace", default=None,
+                   help="path PREFIX for the measure phase's host-span "
+                        "Chrome trace (utils/trace.py): writes "
+                        "<prefix>.<variant>.json per variant — "
+                        "attributes the per-pass cost: ingest vs act vs "
+                        "train dispatch vs priority write-back")
     args = p.parse_args()
 
     if args.allow_cpu:
@@ -155,7 +161,9 @@ def main() -> int:
                         steady.get("env_steps_per_sec_per_chip") or 0.0)
         measure_total = max(int(best_rate * args.measure_seconds),
                             2 * probe_total)
-        summary, wall, steady = _run(cfg, rt_kwargs, measure_total)
+        trace = (f"{args.trace}.{variant}.json" if args.trace else None)
+        summary, wall, steady = _run(cfg, rt_kwargs, measure_total,
+                                     trace_path=trace)
         avg_rate = summary["env_steps"] / max(wall, 1e-9)
         steady_rate = steady.get("env_steps_per_sec_per_chip") or avg_rate
         # Cadence debt: the ratio the config ASKS for vs what the
