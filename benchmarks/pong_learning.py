@@ -112,9 +112,13 @@ def _r2d2_cfg(args):
                            lstm_size=64),
         actor=dc.replace(cfg.actor, num_envs=256,
                          epsilon_decay_steps=args.eps_decay_frames),
+        # frame_dedup propagates so --frame-dedup with --head r2d2 hits
+        # the sequence ring's named not-implemented error instead of
+        # silently ignoring the flag.
         replay=dc.replace(cfg.replay, capacity=131_072, min_fill=16_384,
                           burn_in=5, unroll_length=20,
-                          sequence_stride=10),
+                          sequence_stride=10,
+                          frame_dedup=args.frame_dedup),
         learner=dc.replace(cfg.learner, batch_size=64,
                            learning_rate=5e-4, n_step=3,
                            target_update_period=500),
@@ -179,7 +183,8 @@ def _base_cfg(args):
             actor=dataclasses.replace(cfg.actor, num_envs=8,
                                       epsilon_decay_steps=2_000),
             replay=dataclasses.replace(cfg.replay, capacity=2_048,
-                                       min_fill=256),
+                                       min_fill=256,
+                                       frame_dedup=args.frame_dedup),
             learner=dataclasses.replace(cfg.learner, batch_size=16),
             train_every=2, eval_every_steps=0)
         return _apply_head(cfg, args.head)
@@ -192,7 +197,8 @@ def _base_cfg(args):
         env_name=args.env,
         actor=dataclasses.replace(cfg.actor, **actor_kw),
         replay=dataclasses.replace(
-            cfg.replay, capacity=args.ring, min_fill=args.min_fill),
+            cfg.replay, capacity=args.ring, min_fill=args.min_fill,
+            frame_dedup=args.frame_dedup),
         learner=dataclasses.replace(
             cfg.learner, batch_size=args.batch_size,
             learning_rate=args.lr,
@@ -225,6 +231,11 @@ def main() -> int:
                         "fires first")
     p.add_argument("--lanes", type=int, default=1024)
     p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--frame-dedup", action="store_true",
+                   help="replay.frame_dedup: store single frames, "
+                        "rebuild stacks at sample time — 4x the "
+                        "affordable window (a >=1M-transition ring "
+                        "fits the v5e; VERDICT round-4 next #2/#4)")
     p.add_argument("--ring", type=int, default=131_072,
                help="4x the bench ring: at 1024 lanes the ring "
                     "holds 128 iterations of history — replay "
@@ -300,10 +311,14 @@ def main() -> int:
         # the per-chunk time model is still the feedforward one — a
         # permissive floor at its small sizes; the wall-clock stop_fn
         # is the binding bound either way.
+        from dist_dqn_tpu.envs import make_jax_env as _mke
+        dedup_stack = (getattr(_mke(cfg.env_name), "frame_stack", 0)
+                       if cfg.replay.frame_dedup else 0)
         envelope = sizing.check_envelope(
             num_envs=cfg.actor.num_envs,
             batch_size=cfg.learner.batch_size,
-            ring=cfg.replay.capacity)
+            ring=cfg.replay.capacity,
+            frame_dedup_stack=dedup_stack)
         if envelope is not None:
             print(json.dumps({"sizing": envelope}), flush=True)
             return 4
@@ -367,6 +382,8 @@ def main() -> int:
         "platform": platforms, "torso": cfg.network.torso,
         "lanes": cfg.actor.num_envs, "batch_size": cfg.learner.batch_size,
         "train_every": cfg.train_every,
+        "ring": cfg.replay.capacity,
+        "frame_dedup": cfg.replay.frame_dedup,
         "first_return": round(float(first), 3),
         "best_return": round(float(best), 3),
         "final_return": round(float(returns[-1]), 3),

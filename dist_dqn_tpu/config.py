@@ -78,6 +78,16 @@ class ReplayConfig:
     # 5.26G flat vs 8.39G tiled — the difference between fitting a v5e
     # beside the training program and OOM).
     flat_storage: "bool | None" = None
+    # Frame-dedup storage for rolling-stack pixel obs (fused loop only):
+    # store each step's NEWEST frame instead of the whole stack and
+    # rebuild stacks at sample time from frame_stack consecutive slots
+    # (exact, including reset-boundary re-tiling — replay/device.py
+    # stack_rebuild_indices). A 4x HBM saving on Atari stacks: the v5e
+    # pixel window cap lifts from ~200k to ~1M transitions. Requires the
+    # env to declare the rolling-stack contract (JaxEnv.frame_stack > 0)
+    # and store_final_obs off; not implemented for the R2D2 sequence
+    # ring (its gather is windowed already).
+    frame_dedup: bool = False
     # R2D2 sequence replay (>0 enables sequence mode):
     burn_in: int = 0
     unroll_length: int = 0

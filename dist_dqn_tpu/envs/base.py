@@ -28,6 +28,13 @@ class JaxEnv:
     num_actions: int
     observation_shape: Tuple[int, ...]
     observation_dtype = jnp.float32
+    # Rolling frame-stack depth of the observation's LAST axis, or 0 when
+    # obs is not a rolling stack. Non-zero promises the Atari contract:
+    # obs_t[..., 1:] == obs_{t-1}[..., :-1] within an episode, and reset
+    # re-tiles the first frame across the stack — exactly what
+    # ``replay.frame_dedup`` (replay/device.py) relies on to rebuild
+    # stacks from single stored frames.
+    frame_stack: int = 0
 
     def reset(self, rng: Array) -> Tuple[PyTree, Array]:
         raise NotImplementedError

@@ -81,6 +81,7 @@ def _serve(rng: Array, pad_x: Array) -> Array:
 class PixelBreakout(JaxEnv):
     num_actions = 4    # NOOP, FIRE, RIGHT, LEFT (ale-py minimal order)
     observation_shape = (_H, _W, 4)
+    frame_stack = 4  # rolling stack (envs/base.py contract; replay.frame_dedup)
     observation_dtype = jnp.uint8
 
     def __init__(self, max_steps: int = 2000):

@@ -54,6 +54,7 @@ def _render(ball_x: Array, ball_y: Array, pad_x: Array) -> Array:
 class PixelCatch(JaxEnv):
     num_actions = 3    # NOOP, LEFT, RIGHT (minimal-set convention)
     observation_shape = (_H, _W, 4)
+    frame_stack = 4  # rolling stack (envs/base.py contract; replay.frame_dedup)
     observation_dtype = jnp.uint8
 
     def __init__(self, max_steps: int = 200):

@@ -72,6 +72,7 @@ def _serve(rng: Array, toward_agent: Array) -> Array:
 class PixelPong(JaxEnv):
     num_actions = 6
     observation_shape = (_H, _W, 4)
+    frame_stack = 4  # rolling stack (envs/base.py contract; replay.frame_dedup)
     observation_dtype = jnp.uint8
 
     def __init__(self, max_steps: int = 2000):

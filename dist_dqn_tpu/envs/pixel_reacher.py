@@ -111,6 +111,7 @@ class PixelReacher(JaxEnv):
 
     num_actions = 9
     observation_shape = (_H, _W, 4)
+    frame_stack = 4  # rolling stack (envs/base.py contract; replay.frame_dedup)
     observation_dtype = jnp.uint8
 
     def __init__(self, max_steps: int = 1000, shaping: float = 0.0):

@@ -51,6 +51,11 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
     """Returns (init, run_chunk) — same contract as train_loop.make_fused_train."""
     spmd = axis_name is not None
     rcfg = cfg.replay
+    if rcfg.frame_dedup:
+        raise ValueError(
+            "replay.frame_dedup is not implemented for the R2D2 sequence "
+            "ring (its windowed gather already amortizes storage "
+            "differently) — unset it for recurrent configs")
     seq_len = rcfg.burn_in + rcfg.unroll_length + cfg.learner.n_step
     stride = rcfg.sequence_stride or rcfg.unroll_length
     init_learner, train_step = make_r2d2_learner(net, cfg.learner, rcfg,

@@ -111,9 +111,13 @@ def time_ring_add(state: TimeRingState, obs: PyTree, action: Array,
     )
 
 
-def time_ring_can_sample(state: TimeRingState, n_step: int) -> Array:
-    """True once windows of length ``n_step`` (plus bootstrap slot) exist."""
-    return state.size > n_step
+def time_ring_can_sample(state: TimeRingState, n_step: int,
+                         frame_stack: int = 0) -> Array:
+    """True once windows of length ``n_step`` (plus bootstrap slot) exist.
+
+    With frame-dedup storage (``frame_stack`` > 0) a sampled start also
+    needs ``frame_stack - 1`` PRIOR slots stored to rebuild its stack."""
+    return state.size > n_step + max(frame_stack - 1, 0)
 
 
 def _gather_window(field: Array, t_idx: Array, b_idx: Array, n: int,
@@ -157,14 +161,53 @@ def compute_n_step(reward_w: Array, term_w: Array, trunc_w: Array,
     return returns, discount, kstar
 
 
+def stack_rebuild_indices(done_at, t_idx: Array, frame_stack: int,
+                          num_slots: int):
+    """Per-channel ring slots that rebuild a frame stack stored deduped.
+
+    The rolling-stack contract (envs/base.py ``frame_stack``): within an
+    episode ``obs_t`` channel with lookback ``d`` (d=0 newest) is the
+    single frame from step ``t-d``; a reset at boundary ``done[t-1-j]``
+    re-tiled the stack, so frames older than the episode start are the
+    episode's FIRST frame repeated. Hence channel ``d`` comes from slot
+    ``t - min(d, age_t)`` where ``age_t`` = j-1 for the nearest j in
+    [1, S-1] with ``done[t-j]`` (S-1 when none — unconstrained).
+
+    ``done_at(slots) -> [len(t_idx)] bool`` abstracts the done-flag
+    lookup so callers own the (merge-rows vs tiled) indexing. Returns
+    slot indices per lookback, NEWEST-first: [(d, [S] slots), ...].
+    """
+    S = frame_stack
+    age = jnp.full_like(t_idx, S - 1)
+    for j in range(S - 1, 0, -1):  # descending: the NEAREST done wins
+        age = jnp.where(done_at((t_idx - j) % num_slots), j - 1, age)
+    return [(d, (t_idx - jnp.minimum(d, age)) % num_slots)
+            for d in range(S)]
+
+
 def gather_transitions(state: TimeRingState, t_idx: Array, b_idx: Array,
                        n_step: int, gamma: float,
-                       merge_obs_rows: bool = False) -> Transition:
+                       merge_obs_rows: bool = False,
+                       frame_stack: int = 0,
+                       frame_shape=None) -> Transition:
     """Window-gather + n-step fold for explicit (t_idx, b_idx) pairs.
 
     Shared by the uniform and prioritized samplers so the episode-boundary
     semantics live in exactly one place.
+
+    ``frame_stack=S > 0``: the ring stores only each step's NEWEST frame
+    (obs leaves [..., H, W, 1] — a 4x HBM saving for Atari stacks) and
+    this gather rebuilds the full [N, H, W, S] stacks exactly, including
+    the reset-boundary re-tiling (see ``stack_rebuild_indices``). In
+    merge_obs_rows mode the stored rows are flat; ``frame_shape`` (e.g.
+    (84, 84, 1)) is then required to reshape gathered rows — gathered
+    stacks come back UNFLATTENED either way.
     """
+    if frame_stack and state.final_obs is not None:
+        raise ValueError(
+            "frame_stack rebuild is undefined for rings with final_obs "
+            "(the final-obs buffer is not a rolling frame stream) — "
+            "build the ring with store_final_obs=False for frame dedup")
     num_slots, num_envs = state.action.shape
     reward_w = _gather_window(state.reward, t_idx, b_idx, n_step, num_slots)
     term_w = _gather_window(state.terminated, t_idx, b_idx, n_step, num_slots)
@@ -172,10 +215,26 @@ def gather_transitions(state: TimeRingState, t_idx: Array, b_idx: Array,
     returns, discount, kstar = compute_n_step(reward_w, term_w, trunc_w,
                                               gamma)
 
-    def take(tree, t):
+    done = jnp.logical_or(state.terminated, state.truncated)
+
+    def take_one(x, t):
         if merge_obs_rows:
-            return jax.tree.map(lambda x: x[t * num_envs + b_idx], tree)
-        return jax.tree.map(lambda x: x[t, b_idx], tree)
+            out = x[t * num_envs + b_idx]
+            if frame_stack and frame_shape is not None:
+                out = out.reshape(out.shape[:1] + tuple(frame_shape))
+            return out
+        return x[t, b_idx]
+
+    def take(tree, t):
+        if not frame_stack:
+            return jax.tree.map(lambda x: take_one(x, t), tree)
+        slots = stack_rebuild_indices(lambda tt: done[tt, b_idx], t,
+                                      frame_stack, num_slots)
+        # Channel order oldest -> newest = lookback S-1 -> 0.
+        return jax.tree.map(
+            lambda x: jnp.concatenate(
+                [take_one(x, ts) for d, ts in reversed(slots)], axis=-1),
+            tree)
 
     obs = take(state.obs, t_idx)
     action = state.action[t_idx, b_idx]
@@ -198,18 +257,24 @@ def gather_transitions(state: TimeRingState, t_idx: Array, b_idx: Array,
 
 def time_ring_sample(state: TimeRingState, rng: Array, batch_size: int,
                      n_step: int, gamma: float,
-                     merge_obs_rows: bool = False) -> Transition:
+                     merge_obs_rows: bool = False,
+                     frame_stack: int = 0, frame_shape=None) -> Transition:
     """Uniformly sample ``batch_size`` n-step transitions.
 
     Valid window starts are the oldest ``size - n_step`` slots, so the
     bootstrap slot (start + k* + 1 <= start + n_step) is always a stored,
-    in-order step of the same env.
+    in-order step of the same env. Frame-dedup rings additionally skip
+    the oldest ``frame_stack - 1`` starts (their rebuild context is not
+    stored — time_ring_can_sample gates the same way).
     """
     num_slots, num_envs = state.action.shape
+    extra = max(frame_stack - 1, 0)
     k_t, k_b = jax.random.split(rng)
-    num_valid = state.size - n_step  # traced; callers gate on can_sample
+    num_valid = state.size - n_step - extra  # traced; gated by can_sample
     u = jax.random.randint(k_t, (batch_size,), 0, jnp.maximum(num_valid, 1))
-    t_idx = (state.pos - state.size + u) % num_slots
+    t_idx = (state.pos - state.size + extra + u) % num_slots
     b_idx = jax.random.randint(k_b, (batch_size,), 0, num_envs)
     return gather_transitions(state, t_idx, b_idx, n_step, gamma,
-                              merge_obs_rows=merge_obs_rows)
+                              merge_obs_rows=merge_obs_rows,
+                              frame_stack=frame_stack,
+                              frame_shape=frame_shape)

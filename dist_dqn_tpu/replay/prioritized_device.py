@@ -68,14 +68,19 @@ def prioritized_ring_add(state: PrioritizedRingState, obs: PyTree,
                                 max_priority=state.max_priority)
 
 
-def _valid_start_mask(state: ring.TimeRingState, n_step: int) -> Array:
+def _valid_start_mask(state: ring.TimeRingState, n_step: int,
+                      frame_stack: int = 0) -> Array:
     """[T] bool — slots that are valid n-step window starts (same region the
-    uniform sampler draws from: the oldest size - n_step slots)."""
+    uniform sampler draws from: the oldest size - n_step slots; frame-dedup
+    rings also exclude the oldest frame_stack - 1, whose stack-rebuild
+    context is not stored)."""
     num_slots = state.action.shape[0]
+    extra = max(frame_stack - 1, 0)
     t = jnp.arange(num_slots, dtype=jnp.int32)
     oldest = (state.pos - state.size) % num_slots
     offset = (t - oldest) % num_slots
-    return offset < (state.size - n_step)
+    return jnp.logical_and(offset >= extra,
+                           offset < (state.size - n_step))
 
 
 def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
@@ -83,8 +88,9 @@ def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
                             alpha: float, beta: Array,
                             use_pallas: bool = False,
                             pallas_interpret: bool = False,
-                            merge_obs_rows: bool = False
-                            ) -> PrioritizedSample:
+                            merge_obs_rows: bool = False,
+                            frame_stack: int = 0,
+                            frame_shape=None) -> PrioritizedSample:
     """Stratified sample ~ P(i) = p_i^alpha / sum p^alpha over valid slots.
 
     ``use_pallas`` routes the cumsum+search through the Pallas TPU kernel
@@ -95,7 +101,7 @@ def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
                                                  stratified_sample)
 
     num_slots, num_envs = state.priorities.shape
-    mask = _valid_start_mask(state.ring, n_step)                  # [T]
+    mask = _valid_start_mask(state.ring, n_step, frame_stack)     # [T]
     w = jnp.where(mask[:, None], state.priorities ** alpha, 0.0)  # [T, B]
     n_valid = (jnp.sum(mask.astype(jnp.float32)) * num_envs)
     t_idx, b_idx, mass_sel, total = stratified_sample(
@@ -104,7 +110,9 @@ def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,
     weights = importance_weights(mass_sel, total, n_valid, beta)
 
     batch = ring.gather_transitions(state.ring, t_idx, b_idx, n_step, gamma,
-                                    merge_obs_rows=merge_obs_rows)
+                                    merge_obs_rows=merge_obs_rows,
+                                    frame_stack=frame_stack,
+                                    frame_shape=frame_shape)
     return PrioritizedSample(batch=batch, weights=weights, t_idx=t_idx,
                              b_idx=b_idx)
 

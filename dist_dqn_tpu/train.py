@@ -419,7 +419,10 @@ def main():
             num_chunks=n_chunks, ring=cfg.replay.capacity,
             num_evals=n_evals, eval_iters=3_000 * cfg.eval_episodes,
             pixel_obs=len(menv.observation_shape) == 3,
-            num_actions=menv.num_actions)
+            num_actions=menv.num_actions,
+            frame_dedup_stack=(getattr(menv, "frame_stack", 0)
+                               if cfg.replay.frame_dedup
+                               and not cfg.network.lstm_size else 0))
         print(json.dumps({"sizing_predicted_s": round(verdict.predicted_s, 1),
                           "wall_budget_s": args.wall_budget_s}))
         if not verdict.ok:

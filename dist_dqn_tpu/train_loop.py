@@ -77,17 +77,45 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     use_pallas, pallas_interpret = loop_common.pallas_routing(
         prioritized and cfg.replay.pallas_sampler)
 
+    # Frame-dedup (replay.frame_dedup): store each step's NEWEST frame
+    # only and rebuild stacks at sample time — a 4x HBM saving that
+    # lifts the v5e pixel window cap from ~200k to ~1M transitions.
+    # Exactness relies on the env's declared rolling-stack contract.
+    _obs_shape = tuple(env.observation_shape)
+    stack = cfg.replay.frame_dedup and getattr(env, "frame_stack", 0) or 0
+    if cfg.replay.frame_dedup:
+        if stack < 2:
+            raise ValueError(
+                "replay.frame_dedup=True but this env does not declare a "
+                "rolling frame stack (JaxEnv.frame_stack is "
+                f"{getattr(env, 'frame_stack', 0)}); dedup storage cannot "
+                "rebuild its observations")
+        if stack != _obs_shape[-1]:
+            raise ValueError(
+                f"env.frame_stack={stack} does not match the obs last "
+                f"axis {_obs_shape[-1]}")
+        if store_final:
+            raise ValueError(
+                "replay.frame_dedup needs store_final_obs off (the "
+                "final-obs buffer is not a rolling frame stream)")
+    # Shape as STORED in the ring (single frame under dedup).
+    _stored_shape = _obs_shape[:-1] + (1,) if stack else _obs_shape
+    _frame_shape = _stored_shape if stack else None
+    _slice_newest = (lambda o: o[..., -1:]) if stack else (lambda o: o)
+
     # Multi-dim obs can be STORED FLAT in the ring — [slots*B, 28224]
     # for 84x84x4, via replay/device.py merge_obs_rows — with reshapes
     # at the insert/sample boundary (rationale + measured padding
     # factors: loop_common.resolve_flat_storage).
-    _obs_shape = tuple(env.observation_shape)
     flat_storage = loop_common.resolve_flat_storage(
-        cfg.replay, _obs_shape, env.observation_dtype, num_slots, B,
+        cfg.replay, _stored_shape, env.observation_dtype, num_slots, B,
         store_final=store_final)
 
     _flatten_batched, _unflatten_batched = loop_common.flat_obs_codecs(
-        flat_storage, _obs_shape)
+        flat_storage, _stored_shape)
+    # Dedup gathers return UNFLATTENED rebuilt stacks (gather owns the
+    # reshape via frame_shape); without dedup the flat codec decodes.
+    _decode_batch_obs = (lambda x: x) if stack else _unflatten_batched
 
     def _ring_of(replay) -> ring.TimeRingState:
         return replay.ring if prioritized else replay
@@ -97,7 +125,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         filled = r.size * B >= min_fill
         return jnp.logical_and(
             jnp.logical_and(filled,
-                            ring.time_ring_can_sample(r, cfg.learner.n_step)),
+                            ring.time_ring_can_sample(r, cfg.learner.n_step,
+                                                      frame_stack=stack)),
             iteration % cfg.train_every == 0)
 
     def init(rng: Array) -> TrainCarry:
@@ -115,7 +144,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         # phys vector); the carry is donated, so every leaf must be distinct.
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
-        ring_example = loop_common.ring_obs_example(obs_example,
+        # The ring stores single frames under dedup; the learner (below)
+        # still inits on the full stacked obs.
+        stored_example = jax.tree.map(lambda x: _slice_newest(x)[0], obs)
+        ring_example = loop_common.ring_obs_example(stored_example,
                                                     flat_storage)
         if prioritized:
             replay = pring.prioritized_ring_init(
@@ -142,8 +174,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         env_state, out = env.v_step(carry.env_state, actions)
         add = (pring.prioritized_ring_add if prioritized
                else ring.time_ring_add)
-        replay = add(carry.replay, _flatten_batched(carry.obs), actions,
-                     out.reward, out.terminated, out.truncated,
+        replay = add(carry.replay,
+                     _flatten_batched(jax.tree.map(_slice_newest,
+                                                   carry.obs)),
+                     actions, out.reward, out.terminated, out.truncated,
                      final_obs=_flatten_batched(out.next_obs)
                      if store_final else None,
                      merge_obs_rows=flat_storage)
@@ -160,10 +194,11 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                         cfg.learner.gamma, cfg.replay.priority_exponent,
                         beta, use_pallas=use_pallas,
                         pallas_interpret=pallas_interpret,
-                        merge_obs_rows=flat_storage)
+                        merge_obs_rows=flat_storage,
+                        frame_stack=stack, frame_shape=_frame_shape)
                     batch = s.batch._replace(
-                        obs=_unflatten_batched(s.batch.obs),
-                        next_obs=_unflatten_batched(s.batch.next_obs))
+                        obs=_decode_batch_obs(s.batch.obs),
+                        next_obs=_decode_batch_obs(s.batch.next_obs))
                     l, metrics = train_step(l, batch, s.weights)
                     rep = pring.prioritized_ring_update(
                         rep, s.t_idx, s.b_idx, metrics["priorities"],
@@ -172,10 +207,12 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                     batch = ring.time_ring_sample(rep, key, batch_size,
                                                   cfg.learner.n_step,
                                                   cfg.learner.gamma,
-                                                  merge_obs_rows=flat_storage)
+                                                  merge_obs_rows=flat_storage,
+                                                  frame_stack=stack,
+                                                  frame_shape=_frame_shape)
                     batch = batch._replace(
-                        obs=_unflatten_batched(batch.obs),
-                        next_obs=_unflatten_batched(batch.next_obs))
+                        obs=_decode_batch_obs(batch.obs),
+                        next_obs=_decode_batch_obs(batch.next_obs))
                     l, metrics = train_step(l, batch)
                 return (l, rep), metrics["loss"]
 

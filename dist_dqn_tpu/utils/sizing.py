@@ -82,16 +82,21 @@ def predict_fused_hbm_bytes(*, ring: int, pixel_obs: bool = True,
                             obs_elems: int = 84 * 84 * 4,
                             obs_itemsize: int = 1,
                             store_final_obs: bool = False,
-                            flat_storage: Optional[bool] = None) -> float:
+                            flat_storage: Optional[bool] = None,
+                            frame_dedup_stack: int = 0) -> float:
     """Conservative HBM footprint of a fused-loop device program.
 
     ``ring`` is the TOTAL capacity in transitions (the config knob, not
     per-lane slots). The flat/tiled padding factor mirrors
     train_loop.py's ``replay.flat_storage`` auto rule so the prediction
     matches what the program will actually allocate.
+    ``frame_dedup_stack`` > 0 models ``replay.frame_dedup``: each stored
+    transition holds one frame instead of the whole stack.
     """
     if not pixel_obs:
         return PROGRAM_RESIDUE_BYTES
+    if frame_dedup_stack:
+        obs_elems //= frame_dedup_stack
     logical = float(ring) * obs_elems * obs_itemsize
     if store_final_obs:
         logical *= 2
@@ -154,7 +159,8 @@ def predict_fused_seconds(*, num_envs: int, batch_size: int,
 
 def check_envelope(*, num_envs: int, batch_size: int,
                    ring: Optional[int] = None,
-                   pixel_obs: bool = True) -> Optional[str]:
+                   pixel_obs: bool = True,
+                   frame_dedup_stack: int = 0) -> Optional[str]:
     """Hard size rules from measured incidents; None when inside the
     envelope, else the refusal reason. Override: BENCH_ALLOW_UNPROVEN=1.
 
@@ -171,7 +177,12 @@ def check_envelope(*, num_envs: int, batch_size: int,
                 "a driver capture is owed)")
     sized = {"num_envs": num_envs, "batch_size": batch_size}
     if ring is not None:
-        sized["ring"] = ring
+        # The proven-safe ring number was measured with full-stack
+        # storage; what the incidents actually bound is BYTES, so a
+        # frame-dedup ring counts at its stacked-equivalent size
+        # (1/stack of the transitions — replay.frame_dedup).
+        sized["ring"] = (ring // frame_dedup_stack if frame_dedup_stack
+                         else ring)
     for key, value in sized.items():
         if value > 2 * PROVEN_SAFE[key]:
             return (f"{key}={value} is more than 2x the proven-safe "
@@ -186,7 +197,8 @@ def gate_fused(*, budget_s: float, num_envs: int, batch_size: int,
                ring: Optional[int] = None, num_evals: int = 0,
                eval_iters: int = 0, pixel_obs: bool = True,
                num_actions: int = 6,
-               compile_s: float = COMPILE_BUDGET_S) -> SizingVerdict:
+               compile_s: float = COMPILE_BUDGET_S,
+               frame_dedup_stack: int = 0) -> SizingVerdict:
     """Combined envelope + time-prediction gate for a fused device run.
 
     ``budget_s`` is whatever will kill the process (internal watchdog,
@@ -199,11 +211,13 @@ def gate_fused(*, budget_s: float, num_envs: int, batch_size: int,
         eval_iters=eval_iters, pixel_obs=pixel_obs, num_actions=num_actions,
         compile_s=compile_s)
     envelope = check_envelope(num_envs=num_envs, batch_size=batch_size,
-                              ring=ring, pixel_obs=pixel_obs)
+                              ring=ring, pixel_obs=pixel_obs,
+                              frame_dedup_stack=frame_dedup_stack)
     if envelope is not None:
         return SizingVerdict(False, predicted, budget_s, envelope)
     if ring is not None and not _override_active():
-        hbm = predict_fused_hbm_bytes(ring=ring, pixel_obs=pixel_obs)
+        hbm = predict_fused_hbm_bytes(ring=ring, pixel_obs=pixel_obs,
+                                      frame_dedup_stack=frame_dedup_stack)
         if hbm > HBM_REFUSE_BYTES:
             return SizingVerdict(
                 False, predicted, budget_s,

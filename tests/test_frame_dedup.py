@@ -1,0 +1,187 @@
+"""Frame-dedup ring storage (replay.frame_dedup): single stored frames +
+sample-time stack rebuild must be EXACTLY equal to storing full stacks —
+including reset-boundary re-tiling, ring wrap-around, both storage
+layouts, and the prioritized plane (VERDICT round-4 next #2: the 4x HBM
+saving that lifts the v5e pixel window toward 1M transitions)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.replay import device as ring
+
+H, W, S = 6, 5, 4
+
+
+def _rolling_stream(rng, steps, lanes):
+    """Synthesize (obs[t], action, reward, term, trunc) honoring the
+    rolling-stack contract the pixel envs declare (envs/base.py):
+    obs shifts one frame per step; a done at t re-tiles obs_{t+1}."""
+    frames = rng.integers(0, 255, (steps + 1, lanes, H, W), np.uint8)
+    done = rng.random((steps, lanes)) < 0.25
+    term = np.logical_and(done, rng.random((steps, lanes)) < 0.5)
+    trunc = np.logical_and(done, ~term)
+    obs = np.zeros((steps, lanes, H, W, S), np.uint8)
+    cur = np.repeat(frames[0][..., None], S, axis=-1)  # reset: tiled
+    for t in range(steps):
+        obs[t] = cur
+        nxt = np.concatenate([cur[..., 1:], frames[t + 1][..., None]],
+                             axis=-1)
+        tiled = np.repeat(frames[t + 1][..., None], S, axis=-1)
+        cur = np.where(done[t][:, None, None, None], tiled, nxt)
+    action = rng.integers(0, 6, (steps, lanes)).astype(np.int32)
+    reward = rng.normal(size=(steps, lanes)).astype(np.float32)
+    return obs, action, reward, term, trunc
+
+
+def _fill(state, obs, action, reward, term, trunc, dedup, merge):
+    for t in range(obs.shape[0]):
+        o = obs[t][..., -1:] if dedup else obs[t]
+        if merge:
+            o = o.reshape(o.shape[0], -1)
+        state = ring.time_ring_add(
+            state, jnp.asarray(o), jnp.asarray(action[t]),
+            jnp.asarray(reward[t]), jnp.asarray(term[t]),
+            jnp.asarray(trunc[t]), merge_obs_rows=merge)
+    return state
+
+
+@pytest.mark.parametrize("merge", [False, True])
+@pytest.mark.parametrize("steps,slots", [(40, 64), (200, 64)])
+def test_dedup_gather_exactly_matches_stacked(merge, steps, slots):
+    """Every field of gathered transitions is bitwise identical between
+    full-stack storage and dedup storage, at identical (t, b) indices —
+    covering unwrapped (40 < 64) and wrapped (200 > 64) rings."""
+    rng = np.random.default_rng(0)
+    lanes, n_step = 3, 3
+    obs, action, reward, term, trunc = _rolling_stream(rng, steps, lanes)
+
+    full = ring.time_ring_init(
+        slots, lanes,
+        jnp.zeros((H * W * S,) if merge else (H, W, S), jnp.uint8),
+        merge_obs_rows=merge)
+    dd = ring.time_ring_init(
+        slots, lanes,
+        jnp.zeros((H * W,) if merge else (H, W, 1), jnp.uint8),
+        merge_obs_rows=merge)
+    full = _fill(full, obs, action, reward, term, trunc, False, merge)
+    dd = _fill(dd, obs, action, reward, term, trunc, True, merge)
+
+    size = min(steps, slots)
+    # Valid dedup starts: skip the oldest S-1 (no rebuild context).
+    offsets = np.arange(S - 1, size - n_step)
+    oldest = (steps - size) % slots
+    t_idx = jnp.asarray((oldest + offsets) % slots, jnp.int32)
+    reps = (len(offsets) + lanes - 1) // lanes
+    b_idx = jnp.asarray(np.tile(np.arange(lanes), reps)[:len(offsets)],
+                        jnp.int32)
+
+    a = ring.gather_transitions(full, t_idx, b_idx, n_step, 0.97,
+                                merge_obs_rows=merge)
+    b = ring.gather_transitions(dd, t_idx, b_idx, n_step, 0.97,
+                                merge_obs_rows=merge, frame_stack=S,
+                                frame_shape=(H, W, 1))
+    a_obs = np.asarray(a.obs).reshape(len(offsets), H, W, S)
+    a_next = np.asarray(a.next_obs).reshape(len(offsets), H, W, S)
+    np.testing.assert_array_equal(a_obs, np.asarray(b.obs))
+    # next_obs only matters where the bootstrap is live; the stacked
+    # ring's post-reset next_obs at done boundaries is itself a reset
+    # stack, which dedup rebuilds identically — so compare everywhere.
+    np.testing.assert_array_equal(a_next, np.asarray(b.next_obs))
+    np.testing.assert_array_equal(np.asarray(a.action), np.asarray(b.action))
+    np.testing.assert_array_equal(np.asarray(a.reward), np.asarray(b.reward))
+    np.testing.assert_array_equal(np.asarray(a.discount),
+                                  np.asarray(b.discount))
+
+
+def test_dedup_uniform_sample_range_excludes_contextless_slots():
+    """time_ring_sample with frame_stack must never draw a start whose
+    rebuild context is unstored (the oldest S-1 slots)."""
+    rng = np.random.default_rng(1)
+    lanes, slots, steps, n_step = 2, 32, 20, 2
+    obs, action, reward, term, trunc = _rolling_stream(rng, steps, lanes)
+    dd = ring.time_ring_init(slots, lanes, jnp.zeros((H, W, 1), jnp.uint8))
+    dd = _fill(dd, obs, action, reward, term, trunc, True, False)
+    # 20 steps stored at slots 0..19; dedup-valid starts are 3..15.
+    for seed in range(5):
+        batch = ring.time_ring_sample(dd, jax.random.PRNGKey(seed), 64,
+                                      n_step, 0.97, frame_stack=S,
+                                      frame_shape=(H, W, 1))
+        assert batch.obs.shape == (64, H, W, S)
+    assert bool(ring.time_ring_can_sample(dd, n_step, frame_stack=S))
+
+
+def test_dedup_prioritized_mask_and_gather():
+    """The PER plane's valid-start mask excludes the contextless oldest
+    slots and the prioritized gather returns rebuilt stacks."""
+    from dist_dqn_tpu.replay import prioritized_device as pring
+
+    rng = np.random.default_rng(2)
+    lanes, slots, steps, n_step = 2, 32, 20, 2
+    obs, action, reward, term, trunc = _rolling_stream(rng, steps, lanes)
+    st = pring.prioritized_ring_init(slots, lanes,
+                                     jnp.zeros((H, W, 1), jnp.uint8))
+    for t in range(steps):
+        st = pring.prioritized_ring_add(
+            st, jnp.asarray(obs[t][..., -1:]), jnp.asarray(action[t]),
+            jnp.asarray(reward[t]), jnp.asarray(term[t]),
+            jnp.asarray(trunc[t]))
+    mask = np.asarray(pring._valid_start_mask(st.ring, n_step,
+                                              frame_stack=S))
+    assert not mask[:S - 1].any()          # contextless slots excluded
+    assert mask[S - 1:steps - n_step].all()
+    s = pring.prioritized_ring_sample(st, jax.random.PRNGKey(0), 32,
+                                      n_step, 0.97, alpha=0.6,
+                                      beta=jnp.float32(0.4),
+                                      frame_stack=S, frame_shape=(H, W, 1))
+    assert s.batch.obs.shape == (32, H, W, S)
+    assert bool((np.asarray(s.t_idx) >= S - 1).all())
+
+
+def test_dedup_fused_loop_trains_and_validates():
+    """make_fused_train with frame_dedup: trains on a real rolling-stack
+    env (PixelCatch), and the contract violations raise named errors."""
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.train_loop import make_fused_train
+
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="pixel_catch",
+        network=dataclasses.replace(cfg.network, torso="small", hidden=32,
+                                    compute_dtype="float32"),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64,
+                                   frame_dedup=True),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        train_every=2,
+    )
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, run = make_fused_train(cfg, env, net)
+    carry = init(jax.random.PRNGKey(0))
+    carry, metrics = run(carry, 60)
+    assert float(metrics["grad_steps_in_chunk"]) > 0
+    assert np.isfinite(float(metrics["loss"]))
+    # Stored obs is single-frame: the ring obs leaf's last axis is 1
+    # (or flat rows of H*W); either way 4x smaller than the stack.
+    ring_obs = jax.tree.leaves(carry.replay)[0]
+    assert ring_obs.size == 512 * 84 * 84  # slots*B lanes * one frame
+
+    with pytest.raises(ValueError, match="rolling frame stack"):
+        vec_cfg = dataclasses.replace(cfg, env_name="cartpole")
+        venv = make_jax_env("cartpole")
+        make_fused_train(vec_cfg, venv, build_network(
+            dataclasses.replace(cfg.network, torso="mlp",
+                                mlp_features=(8,), hidden=0),
+            venv.num_actions))
+
+    with pytest.raises(ValueError, match="store_final_obs"):
+        sf_cfg = dataclasses.replace(
+            cfg, replay=dataclasses.replace(cfg.replay,
+                                            store_final_obs=True))
+        make_fused_train(sf_cfg, env, net)
