@@ -198,7 +198,8 @@ def _base_cfg(args):
         actor=dataclasses.replace(cfg.actor, **actor_kw),
         replay=dataclasses.replace(
             cfg.replay, capacity=args.ring, min_fill=args.min_fill,
-            frame_dedup=args.frame_dedup),
+            frame_dedup=args.frame_dedup,
+            flat_storage=args.flat_storage),
         learner=dataclasses.replace(
             cfg.learner, batch_size=args.batch_size,
             learning_rate=args.lr,
@@ -231,6 +232,9 @@ def main() -> int:
                         "fires first")
     p.add_argument("--lanes", type=int, default=1024)
     p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--flat-storage", action="store_true", default=None,
+                   help="force replay.flat_storage=True (default: the "
+                        "auto rule — flat above 2GB logical)")
     p.add_argument("--frame-dedup", action="store_true",
                    help="replay.frame_dedup: store single frames, "
                         "rebuild stacks at sample time — 4x the "

@@ -109,7 +109,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     # factors: loop_common.resolve_flat_storage).
     flat_storage = loop_common.resolve_flat_storage(
         cfg.replay, _stored_shape, env.observation_dtype, num_slots, B,
-        store_final=store_final)
+        store_final=store_final, prefer_flat=bool(stack))
 
     _flatten_batched, _unflatten_batched = loop_common.flat_obs_codecs(
         flat_storage, _stored_shape)

@@ -100,8 +100,8 @@ def predict_fused_hbm_bytes(*, ring: int, pixel_obs: bool = True,
     logical = float(ring) * obs_elems * obs_itemsize
     if store_final_obs:
         logical *= 2
-    flat = (logical > FLAT_AUTO_BYTES if flat_storage is None
-            else flat_storage)
+    flat = (flat_storage if flat_storage is not None
+            else bool(frame_dedup_stack) or logical > FLAT_AUTO_BYTES)
     padded = logical * (RING_PAD_FLAT if flat else RING_PAD_TILED)
     return padded * 2 + PROGRAM_RESIDUE_BYTES
 
