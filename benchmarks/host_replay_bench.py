@@ -1,0 +1,103 @@
+"""Host-DRAM replay hybrid loop on chip: throughput + byte-stream costs.
+
+Measures ``host_replay_loop.run_host_replay`` — device env chunks,
+host-DRAM window, device learner — at bounded sizes and reports
+env-steps/s beside the per-chunk D2H/H2D byte streams, so the cost of
+moving the replay window off-chip is attributable. On this dev box the
+axon tunnel (~25 MB/s effective, measured round 5) is the honest bound;
+the module docstring of host_replay_loop.py carries the TPU-VM link
+model (~10 GB/s => ~1.4M deduped env-steps/s admissible), and the
+byte columns this bench emits are what make that model checkable.
+
+Usage: python benchmarks/host_replay_bench.py [--allow-cpu]
+           [--lanes 64] [--chunks 10] [--chunk-iters 100]
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpu_battery import gate_backend  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--allow-cpu", action="store_true")
+    p.add_argument("--lanes", type=int, default=64)
+    p.add_argument("--chunks", type=int, default=10)
+    p.add_argument("--chunk-iters", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--train-every", type=int, default=8)
+    p.add_argument("--window", type=int, default=1_048_576,
+                   help="host-DRAM window in transitions (DRAM-priced: "
+                        "1M deduped pixel transitions ~ 0.45 GB/lane-KB)")
+    args = p.parse_args()
+
+    if args.allow_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platforms = "cpu"
+        args.lanes, args.chunks = min(args.lanes, 8), min(args.chunks, 3)
+        args.chunk_iters = min(args.chunk_iters, 30)
+        args.batch_size = min(args.batch_size, 16)
+        args.window = min(args.window, 8_192)
+    else:
+        platforms, gate_rc = gate_backend(allow_cpu=False,
+                                          tool="host_replay")
+        if gate_rc is not None:
+            return gate_rc
+
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="pixel_pong",
+        network=dataclasses.replace(
+            cfg.network,
+            **({"torso": "small", "hidden": 32,
+                "compute_dtype": "float32"} if args.allow_cpu else {})),
+        actor=dataclasses.replace(cfg.actor, num_envs=args.lanes),
+        replay=dataclasses.replace(cfg.replay, capacity=args.window,
+                                   min_fill=args.batch_size * 4,
+                                   frame_dedup=True),
+        learner=dataclasses.replace(cfg.learner,
+                                    batch_size=args.batch_size),
+        train_every=args.train_every,
+    )
+    total = args.chunks * args.chunk_iters * args.lanes
+    t0 = time.perf_counter()
+    out = run_host_replay(cfg, total_env_steps=total,
+                          chunk_iters=args.chunk_iters,
+                          log_fn=lambda s: print(s, flush=True))
+    wall = time.perf_counter() - t0
+    hist = out.pop("history")
+    steady = hist[-1] if hist else {}
+    row = {
+        **out,  # run summary first: bench-side fields below override
+        "bench": "host_replay", "platforms": platforms,
+        "lanes": args.lanes, "chunk_iters": args.chunk_iters,
+        "batch_size": args.batch_size, "train_every": args.train_every,
+        "frame_dedup": True,
+        "window_transitions": out["window_transitions_max"],
+        "wall_s_incl_setup": round(wall, 1),
+        "steady_env_steps_per_sec": steady.get("env_steps_per_sec"),
+        "steady_d2h_bytes_per_chunk": steady.get("d2h_bytes"),
+        "steady_collect_fetch_s": steady.get("chunk_collect_fetch_s"),
+        "steady_train_s": steady.get("chunk_train_s"),
+    }
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""Hybrid fused loop with HOST-DRAM replay (BASELINE.json:5's north-star
+phrase — "replay buffer shards across TPU-VM host DRAM" — applied to the
+single-chip fused path, VERDICT round-4 next #2).
+
+The all-on-device loop (train_loop.py) is the throughput king, but its
+replay window lives in HBM: ~200k stacked / ~1M deduped pixel
+transitions on a 16 GB v5e. This loop splits the program at the replay
+boundary instead:
+
+  device: [act -> env.step] x chunk_iters   (one jitted scan, no replay)
+     |  one D2H stream of the chunk's new transitions (frames stored
+     |  once; with frame_dedup a step costs 7 KB, not 28 KB)
+  host:  HostTimeRing in DRAM — the window is DRAM-sized (hundreds of
+     |  GB => hundreds of millions of pixel transitions)
+     |  sampled batches, H2D, double-buffered against the device
+  device: train_step (donated state), exactly the learner the fused
+          loop runs
+
+Throughput model: the link, not HBM, prices the window. Per env step
+the D2H cost is one stored frame; per grad step the H2D cost is one
+batch (2 x batch x obs bytes). On a TPU-VM host link (~10 GB/s) that
+admits ~1.4M deduped env-steps/s of collection — above the fused
+loop's own rate; on this dev box the axon tunnel (~25 MB/s measured)
+is the honest bound and the bench reports the byte streams so the
+attribution is visible. Chunk collection and training are dispatched
+back-to-back, so device idle per chunk is bounded by the host-side
+ring ops, not the transfers' latency sum.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_dqn_tpu import loop_common
+from dist_dqn_tpu.agents.dqn import make_actor_step, make_learner
+from dist_dqn_tpu.config import ExperimentConfig
+from dist_dqn_tpu.envs.base import JaxEnv
+from dist_dqn_tpu.replay.host_ring import HostTimeRing
+from dist_dqn_tpu.types import PyTree, Transition
+
+Array = jnp.ndarray
+
+
+class CollectCarry(NamedTuple):
+    env_state: PyTree
+    obs: PyTree
+    rng: Array
+    iteration: Array
+    ep_return: Array
+    completed_return: Array
+    completed_count: Array
+
+
+def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
+                       frame_stack: int):
+    """(init, collect): a device chunk of act -> step that RETURNS its
+    transitions (time-major [C, B, ...]) instead of writing a ring."""
+    B = cfg.actor.num_envs
+    act = make_actor_step(net)
+    epsilon, _ = loop_common.make_schedules(cfg, B, 1)
+    slice_newest = ((lambda o: o[..., -1:]) if frame_stack
+                    else (lambda o: o))
+
+    def init(rng: Array) -> CollectCarry:
+        k_env, k_run = jax.random.split(rng)
+        env_state, obs = env.v_reset(k_env, B)
+        obs = jax.tree.map(jnp.copy, obs)
+        zero = jnp.float32(0.0)
+        return CollectCarry(env_state=env_state, obs=obs, rng=k_run,
+                            iteration=jnp.int32(0),
+                            ep_return=jnp.zeros((B,), jnp.float32),
+                            completed_return=zero, completed_count=zero)
+
+    def collect(carry: CollectCarry, params, num_iters: int):
+        def one_iteration(carry: CollectCarry, _):
+            rng, k_act = jax.random.split(carry.rng)
+            eps = epsilon(carry.iteration)
+            actions = act(params, carry.obs, k_act, eps)
+            env_state, out = env.v_step(carry.env_state, actions)
+            record = dict(obs=slice_newest(carry.obs), action=actions,
+                          reward=out.reward, terminated=out.terminated,
+                          truncated=out.truncated)
+            done = jnp.logical_or(out.terminated, out.truncated)
+            ep_return, completed_return, completed_count = \
+                loop_common.episode_stats_update(carry, out.reward, done)
+            return CollectCarry(env_state=env_state, obs=out.obs, rng=rng,
+                                iteration=carry.iteration + 1,
+                                ep_return=ep_return,
+                                completed_return=completed_return,
+                                completed_count=completed_count), record
+
+        carry = carry._replace(completed_return=jnp.float32(0.0),
+                               completed_count=jnp.float32(0.0))
+        carry, records = jax.lax.scan(one_iteration, carry, None,
+                                      length=num_iters)
+        return carry, records
+
+    return init, collect
+
+
+def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
+                    chunk_iters: int = 200, log_fn=print,
+                    env: Optional[JaxEnv] = None):
+    """Run the hybrid loop; returns a summary dict.
+
+    Cadence matches the fused loop: one train event every
+    ``cfg.train_every`` env iterations, ``cfg.updates_per_train`` grad
+    steps each, batches sampled uniformly from the host ring.
+    """
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+
+    if env is None:
+        env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    B = cfg.actor.num_envs
+    obs_shape = tuple(env.observation_shape)
+    stack = (cfg.replay.frame_dedup
+             and getattr(env, "frame_stack", 0)) or 0
+    if cfg.replay.frame_dedup and stack < 2:
+        raise ValueError(
+            "replay.frame_dedup=True but this env declares no rolling "
+            "frame stack (envs/base.py JaxEnv.frame_stack)")
+    stored_shape = obs_shape[:-1] + (1,) if stack else obs_shape
+
+    init_collect, collect = make_collect_chunk(cfg, env, net, stack)
+    collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
+    init_learner, train_step = make_learner(net, cfg.learner)
+    train_jit = jax.jit(train_step, donate_argnums=0)
+
+    # Floor covers the n-step window AND the dedup rebuild context —
+    # a smaller ring would be permanently unsampleable (can_sample
+    # needs size > n_step + stack - 1).
+    num_slots = max(cfg.replay.capacity // B,
+                    cfg.learner.n_step + max(stack - 1, 0) + 2)
+    ring = HostTimeRing(num_slots, B, stored_shape,
+                        np.dtype(env.observation_dtype), frame_stack=stack)
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    k_carry, k_learn = jax.random.split(rng)
+    carry = init_collect(k_carry)
+    obs_example = jax.tree.map(lambda x: x[0], carry.obs)
+    state = init_learner(k_learn, obs_example)
+    host_rng = np.random.default_rng(cfg.seed)
+
+    def put_batch(hb) -> Transition:
+        return Transition(
+            obs=jax.device_put(hb.obs), action=jax.device_put(hb.action),
+            reward=jax.device_put(hb.reward),
+            discount=jax.device_put(hb.discount),
+            next_obs=jax.device_put(hb.next_obs))
+
+    # Train-event cadence carries its remainder across chunks so the
+    # average exactly matches the fused loop's one-event-per-train_every
+    # iterations (chunk_iters need not divide train_every).
+    updates_per_train = max(cfg.updates_per_train, 1)
+    train_debt_iters = 0
+    weights = jnp.ones((cfg.learner.batch_size,), jnp.float32)
+
+    env_steps = 0
+    grad_steps = 0
+    history = []
+    t_start = time.perf_counter()
+    while env_steps < total_env_steps:
+        t0 = time.perf_counter()
+        carry, records = collect_jit(carry, state.params, chunk_iters)
+        # One D2H stream for the chunk (frames stored once).
+        host = {k: np.asarray(jax.device_get(v))
+                for k, v in records.items()}
+        t_fetch = time.perf_counter()
+        ring.add_chunk(host["obs"], host["action"], host["reward"],
+                       host["terminated"], host["truncated"])
+        env_steps += chunk_iters * B
+        t_ring = time.perf_counter()
+
+        did = 0
+        if (ring.can_sample(cfg.learner.n_step)
+                and ring.size * B >= cfg.replay.min_fill):
+            train_debt_iters += chunk_iters
+            events = train_debt_iters // max(cfg.train_every, 1)
+            train_debt_iters -= events * max(cfg.train_every, 1)
+            grads_this_chunk = events * updates_per_train
+            if grads_this_chunk:
+                # Double-buffered: sample+upload batch g+1 while step
+                # g runs on device.
+                batch = put_batch(
+                    ring.sample(host_rng, cfg.learner.batch_size,
+                                cfg.learner.n_step, cfg.learner.gamma))
+                for g in range(grads_this_chunk):
+                    state, metrics = train_jit(state, batch, weights)
+                    if g + 1 < grads_this_chunk:
+                        batch = put_batch(
+                            ring.sample(host_rng, cfg.learner.batch_size,
+                                        cfg.learner.n_step,
+                                        cfg.learner.gamma))
+                jax.block_until_ready(state.params)
+                did = grads_this_chunk
+                grad_steps += did
+        t_train = time.perf_counter()
+
+        ep = float(jax.device_get(carry.completed_return)) / max(
+            float(jax.device_get(carry.completed_count)), 1.0)
+        row = {
+            "env_frames": env_steps, "grad_steps": grad_steps,
+            "episode_return": round(ep, 3),
+            "env_steps_per_sec": round(
+                chunk_iters * B / max(t_train - t0, 1e-9), 1),
+            "chunk_collect_fetch_s": round(t_fetch - t0, 4),
+            "chunk_ring_s": round(t_ring - t_fetch, 4),
+            "chunk_train_s": round(t_train - t_ring, 4),
+            "d2h_bytes": int(sum(v.nbytes for v in host.values())),
+            "ring_transitions": ring.size * B,
+            "ring_gb": round(ring.nbytes / 1e9, 3),
+        }
+        if did:
+            row["loss"] = round(float(jax.device_get(metrics["loss"])), 4)
+        history.append(row)
+        log_fn(json.dumps(row))
+
+    wall = time.perf_counter() - t_start
+    return {
+        "env_steps": env_steps, "grad_steps": grad_steps,
+        "wall_s": round(wall, 1),
+        "env_steps_per_sec": round(env_steps / wall, 1),
+        "ring_transitions": ring.size * B,
+        "ring_gb": round(ring.nbytes / 1e9, 3),
+        "window_transitions_max": num_slots * B,
+        "history": history,
+    }

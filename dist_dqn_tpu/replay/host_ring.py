@@ -1,0 +1,152 @@
+"""Host-DRAM time-ring: the device ring's semantics, resident in host RAM.
+
+The fused loop's HBM ring caps the pixel replay window (~200k stacked /
+~1M deduped transitions on a 16 GB v5e). This numpy twin of
+``replay/device.py`` moves the window into TPU-VM host DRAM — hundreds
+of GB — for the hybrid collect/train loop (``host_replay_loop.py``):
+device env chunks stream their transitions down once, sampled batches
+stream up per train step. Same storage layout (time-major [T, B]
+slices, each frame once), same n-step fold, same frame-dedup stack
+rebuild; ``tests/test_host_ring.py`` pins numerical equality against
+the device implementation on identical streams and indices.
+
+Like the actor modules this file must not import jax — host DRAM
+residency is the point.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class HostBatch(NamedTuple):
+    obs: np.ndarray
+    action: np.ndarray
+    reward: np.ndarray
+    discount: np.ndarray
+    next_obs: np.ndarray
+
+
+def _np_n_step(reward_w, term_w, trunc_w, gamma: float):
+    """numpy twin of replay/device.py compute_n_step (same returns)."""
+    n = reward_w.shape[-1]
+    done_w = np.logical_or(term_w, trunc_w)
+    cont = 1.0 - done_w.astype(np.float32)
+    prefix = np.concatenate(
+        [np.ones_like(cont[:, :1]),
+         np.cumprod(cont[:, :-1], axis=-1)], axis=-1)
+    gammas = gamma ** np.arange(n, dtype=np.float32)
+    returns = np.sum(prefix * gammas[None, :] * reward_w, axis=-1)
+    any_done = done_w.any(axis=-1)
+    first_done = np.argmax(done_w, axis=-1).astype(np.int32)
+    kstar = np.where(any_done, first_done, n - 1)
+    term_at_k = np.take_along_axis(term_w, kstar[:, None], axis=-1)[:, 0]
+    discount = (gamma ** (kstar + 1).astype(np.float32)) * \
+        (1.0 - term_at_k.astype(np.float32))
+    return returns.astype(np.float32), discount.astype(np.float32), kstar
+
+
+class HostTimeRing:
+    """Time-major ring in host DRAM; every stored frame exactly once.
+
+    ``frame_stack=S > 0`` declares dedup storage: callers add each
+    step's NEWEST frame ([B, H, W, 1]) and ``gather``/``sample`` return
+    rebuilt [N, H, W, S] stacks — the same reset-boundary rule as
+    ``replay/device.py stack_rebuild_indices``. Truncation is treated
+    as terminal (the pixel rings' no-final-obs semantics).
+    """
+
+    def __init__(self, num_slots: int, num_envs: int,
+                 obs_shape: Tuple[int, ...], obs_dtype,
+                 frame_stack: int = 0):
+        self.num_slots = int(num_slots)
+        self.num_envs = int(num_envs)
+        self.frame_stack = int(frame_stack)
+        self.obs = np.zeros((num_slots, num_envs) + tuple(obs_shape),
+                            obs_dtype)
+        self.action = np.zeros((num_slots, num_envs), np.int32)
+        self.reward = np.zeros((num_slots, num_envs), np.float32)
+        self.terminated = np.zeros((num_slots, num_envs), bool)
+        self.truncated = np.zeros((num_slots, num_envs), bool)
+        self.pos = 0
+        self.size = 0
+
+    @property
+    def nbytes(self) -> int:
+        return (self.obs.nbytes + self.action.nbytes + self.reward.nbytes
+                + self.terminated.nbytes + self.truncated.nbytes)
+
+    def add_chunk(self, obs, action, reward, terminated, truncated) -> None:
+        """Append [C, B, ...] arrays (one device chunk) in time order."""
+        C = action.shape[0]
+        if C > self.num_slots:
+            raise ValueError(f"chunk of {C} slices exceeds the "
+                             f"{self.num_slots}-slot ring")
+        idx = (self.pos + np.arange(C)) % self.num_slots
+        self.obs[idx] = obs
+        self.action[idx] = action
+        self.reward[idx] = reward
+        self.terminated[idx] = terminated
+        self.truncated[idx] = truncated
+        self.pos = int((self.pos + C) % self.num_slots)
+        self.size = int(min(self.size + C, self.num_slots))
+
+    # -- sampling -----------------------------------------------------------
+    def _extra(self) -> int:
+        return max(self.frame_stack - 1, 0)
+
+    def can_sample(self, n_step: int) -> bool:
+        return self.size > n_step + self._extra()
+
+    def _take_stacked(self, t_idx: np.ndarray, b_idx: np.ndarray
+                      ) -> np.ndarray:
+        """Rebuild [N, ..., S] stacks at ``t_idx`` (dedup mode)."""
+        S = self.frame_stack
+        done = np.logical_or(self.terminated, self.truncated)
+        age = np.full(t_idx.shape, S - 1, np.int32)
+        for j in range(S - 1, 0, -1):  # descending: nearest done wins
+            age = np.where(done[(t_idx - j) % self.num_slots, b_idx],
+                           j - 1, age)
+        frames = [self.obs[(t_idx - np.minimum(d, age)) % self.num_slots,
+                           b_idx]
+                  for d in range(S - 1, -1, -1)]  # oldest -> newest
+        return np.concatenate(frames, axis=-1)
+
+    def gather(self, t_idx: np.ndarray, b_idx: np.ndarray, n_step: int,
+               gamma: float) -> HostBatch:
+        """Window-gather + n-step fold at explicit (t, b) pairs — the
+        numpy twin of device.py gather_transitions (no-final-obs path)."""
+        offs = np.arange(n_step, dtype=np.int32)
+        tt = (t_idx[:, None] + offs[None, :]) % self.num_slots
+        bb = b_idx[:, None]
+        returns, discount, kstar = _np_n_step(
+            self.reward[tt, bb], self.terminated[tt, bb],
+            self.truncated[tt, bb], gamma)
+        # No final-obs buffer: zero the bootstrap at truncation too.
+        trunc_at_k = np.take_along_axis(self.truncated[tt, bb],
+                                        kstar[:, None], axis=-1)[:, 0]
+        discount = discount * (1.0 - trunc_at_k.astype(np.float32))
+        boot_t = (t_idx + kstar + 1) % self.num_slots
+        if self.frame_stack:
+            obs = self._take_stacked(t_idx, b_idx)
+            next_obs = self._take_stacked(boot_t, b_idx)
+        else:
+            obs = self.obs[t_idx, b_idx]
+            next_obs = self.obs[boot_t, b_idx]
+        return HostBatch(obs=obs, action=self.action[t_idx, b_idx],
+                         reward=returns, discount=discount,
+                         next_obs=next_obs)
+
+    def sample(self, rng: np.random.Generator, batch_size: int, n_step: int,
+               gamma: float) -> HostBatch:
+        """Uniform over valid starts (same region as the device sampler:
+        the oldest size - n_step slots, minus the dedup context skip)."""
+        num_valid = self.size - n_step - self._extra()
+        if num_valid <= 0:
+            raise ValueError("ring not sampleable yet (gate on can_sample)")
+        u = rng.integers(0, num_valid, batch_size)
+        t_idx = (self.pos - self.size + self._extra() + u) % self.num_slots
+        b_idx = rng.integers(0, self.num_envs, batch_size)
+        return self.gather(t_idx.astype(np.int32), b_idx.astype(np.int32),
+                           n_step, gamma)

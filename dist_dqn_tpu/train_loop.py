@@ -98,6 +98,10 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
             raise ValueError(
                 "replay.frame_dedup needs store_final_obs off (the "
                 "final-obs buffer is not a rolling frame stream)")
+    # Dedup rebuild needs frame_stack-1 context slots beyond the n-step
+    # window; a ring under that floor would be permanently unsampleable.
+    num_slots = max(num_slots,
+                    cfg.learner.n_step + max(stack - 1, 0) + 2)
     # Shape as STORED in the ring (single frame under dedup).
     _stored_shape = _obs_shape[:-1] + (1,) if stack else _obs_shape
     _frame_shape = _stored_shape if stack else None

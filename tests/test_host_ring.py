@@ -1,0 +1,138 @@
+"""Host-DRAM time-ring + hybrid collect/train loop (host_replay_loop.py):
+the DRAM-resident twin of the device ring must produce numerically
+identical transitions, and the hybrid loop must run the full
+collect -> D2H -> ring -> sample -> H2D -> train cycle."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.replay import device as dring
+from dist_dqn_tpu.replay.host_ring import HostTimeRing
+
+from tests.test_frame_dedup import H, W, S, _rolling_stream
+
+
+@pytest.mark.parametrize("dedup", [False, True])
+@pytest.mark.parametrize("steps,slots", [(40, 64), (200, 64)])
+def test_host_ring_matches_device_ring(dedup, steps, slots):
+    """Identical streams + identical (t, b) indices -> identical
+    transitions from the host ring and the device ring, deduped or not,
+    wrapped (200 > 64) or not."""
+    rng = np.random.default_rng(0)
+    lanes, n_step = 3, 3
+    obs, action, reward, term, trunc = _rolling_stream(rng, steps, lanes)
+    stored = obs[..., -1:] if dedup else obs
+
+    host = HostTimeRing(slots, lanes, stored.shape[2:], np.uint8,
+                        frame_stack=S if dedup else 0)
+    for lo in range(0, steps, 40):  # chunked like the hybrid loop feeds it
+        hi = min(lo + 40, steps)
+        host.add_chunk(stored[lo:hi], action[lo:hi], reward[lo:hi],
+                       term[lo:hi], trunc[lo:hi])
+
+    dev = dring.time_ring_init(slots, lanes,
+                               jnp.zeros(stored.shape[2:], jnp.uint8))
+    for t in range(steps):
+        dev = dring.time_ring_add(dev, jnp.asarray(stored[t]),
+                                  jnp.asarray(action[t]),
+                                  jnp.asarray(reward[t]),
+                                  jnp.asarray(term[t]),
+                                  jnp.asarray(trunc[t]))
+
+    size = min(steps, slots)
+    extra = S - 1 if dedup else 0
+    offsets = np.arange(extra, size - n_step)
+    oldest = (steps - size) % slots
+    t_idx = ((oldest + offsets) % slots).astype(np.int32)
+    b_idx = np.tile(np.arange(lanes),
+                    (len(offsets) + lanes - 1) // lanes)[:len(offsets)] \
+        .astype(np.int32)
+
+    hb = host.gather(t_idx, b_idx, n_step, 0.97)
+    db = dring.gather_transitions(dev, jnp.asarray(t_idx),
+                                  jnp.asarray(b_idx), n_step, 0.97,
+                                  frame_stack=S if dedup else 0)
+    np.testing.assert_array_equal(hb.obs, np.asarray(db.obs))
+    np.testing.assert_array_equal(hb.next_obs, np.asarray(db.next_obs))
+    np.testing.assert_array_equal(hb.action, np.asarray(db.action))
+    # f32 accumulation order differs host (numpy) vs device (XLA) by
+    # ~1 ulp on the n-step reward sums; indices/frames stay exact.
+    np.testing.assert_allclose(hb.reward, np.asarray(db.reward), rtol=1e-6,
+                               atol=1e-6)
+    np.testing.assert_allclose(hb.discount, np.asarray(db.discount),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_host_ring_chunk_wrap_and_bytes():
+    ring = HostTimeRing(10, 2, (3,), np.float32)
+    for start in range(0, 24, 6):
+        chunk = np.arange(start, start + 6, dtype=np.float32)
+        obs = np.repeat(chunk[:, None, None], 2, axis=1)
+        obs = np.repeat(obs, 3, axis=2)
+        ring.add_chunk(obs, np.zeros((6, 2), np.int32),
+                       np.zeros((6, 2), np.float32),
+                       np.zeros((6, 2), bool), np.zeros((6, 2), bool))
+    assert ring.size == 10 and ring.pos == 24 % 10
+    # The newest slot holds the last written value.
+    assert ring.obs[(ring.pos - 1) % 10, 0, 0] == 23.0
+    assert ring.nbytes > 0
+    with pytest.raises(ValueError, match="exceeds"):
+        ring.add_chunk(np.zeros((11, 2, 3), np.float32),
+                       np.zeros((11, 2), np.int32),
+                       np.zeros((11, 2), np.float32),
+                       np.zeros((11, 2), bool), np.zeros((11, 2), bool))
+
+
+def test_hybrid_loop_vector_env_trains():
+    """run_host_replay on CartPole: the full cycle executes, the learner
+    steps at the fused cadence, metrics are finite."""
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, mlp_features=(16,)),
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        replay=dataclasses.replace(cfg.replay, capacity=2_048, min_fill=64),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        train_every=2,
+    )
+    out = run_host_replay(cfg, total_env_steps=4_000, chunk_iters=50,
+                          log_fn=lambda s: None)
+    assert out["env_steps"] >= 4_000
+    assert out["grad_steps"] >= 50
+    assert out["ring_transitions"] > 500
+    last = out["history"][-1]
+    assert np.isfinite(last["loss"])
+    assert last["d2h_bytes"] > 0
+
+
+def test_hybrid_loop_pixel_dedup():
+    """Pixel env + frame_dedup: D2H streams single frames (7 KB/step,
+    not 28), the host ring rebuilds stacks, the CNN learner trains."""
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="pixel_catch",
+        network=dataclasses.replace(cfg.network, torso="small", hidden=32,
+                                    compute_dtype="float32"),
+        actor=dataclasses.replace(cfg.actor, num_envs=4),
+        replay=dataclasses.replace(cfg.replay, capacity=1_024, min_fill=64,
+                                   frame_dedup=True),
+        learner=dataclasses.replace(cfg.learner, batch_size=8),
+        train_every=4,
+    )
+    out = run_host_replay(cfg, total_env_steps=1_200, chunk_iters=50,
+                          log_fn=lambda s: None)
+    assert out["grad_steps"] > 0
+    last = out["history"][-1]
+    # 50 iters x 4 lanes x 84x84x1 u8 + small fields: single frames.
+    assert last["d2h_bytes"] < 50 * 4 * 84 * 84 * 2
+    assert np.isfinite(last["loss"])
