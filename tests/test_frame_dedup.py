@@ -140,6 +140,43 @@ def test_dedup_prioritized_mask_and_gather():
     assert bool((np.asarray(s.t_idx) >= S - 1).all())
 
 
+def test_dedup_mesh_fused_train_runs():
+    """frame_dedup composes with the multi-chip SPMD wrapper: per-shard
+    rings store single frames, rebuilt stacks feed the pmean-allreduced
+    learner on the virtual 8-device mesh."""
+    import jax as _jax
+
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.parallel import make_mesh, make_mesh_fused_train
+
+    if len(_jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    cfg = CONFIGS["atari"]
+    cfg = dataclasses.replace(
+        cfg,
+        env_name="pixel_catch",
+        network=dataclasses.replace(cfg.network, torso="small", hidden=16,
+                                    compute_dtype="float32"),
+        actor=dataclasses.replace(cfg.actor, num_envs=16),
+        replay=dataclasses.replace(cfg.replay, capacity=1024, min_fill=64,
+                                   frame_dedup=True),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+        train_every=2,
+        total_env_steps=4000,
+    )
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    mesh = make_mesh()
+    init, run = make_mesh_fused_train(cfg, env, net, mesh)
+    carry = init(jax.random.PRNGKey(0))
+    carry, metrics = run(carry, 40)
+    assert int(metrics["env_frames"]) == 40 * 16
+    assert float(metrics["grad_steps_in_chunk"]) > 0
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_dedup_fused_loop_trains_and_validates():
     """make_fused_train with frame_dedup: trains on a real rolling-stack
     env (PixelCatch), and the contract violations raise named errors."""
