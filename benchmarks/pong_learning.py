@@ -112,9 +112,7 @@ def _r2d2_cfg(args):
                            lstm_size=64),
         actor=dc.replace(cfg.actor, num_envs=256,
                          epsilon_decay_steps=args.eps_decay_frames),
-        # frame_dedup propagates so --frame-dedup with --head r2d2 hits
-        # the sequence ring's named not-implemented error instead of
-        # silently ignoring the flag.
+        # frame_dedup propagates: the sequence ring supports dedup too.
         replay=dc.replace(cfg.replay, capacity=131_072, min_fill=16_384,
                           burn_in=5, unroll_length=20,
                           sequence_stride=10,

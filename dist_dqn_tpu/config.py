@@ -85,8 +85,9 @@ class ReplayConfig:
     # stack_rebuild_indices). A 4x HBM saving on Atari stacks: the v5e
     # pixel window cap lifts from ~200k to ~1M transitions. Requires the
     # env to declare the rolling-stack contract (JaxEnv.frame_stack > 0)
-    # and store_final_obs off; not implemented for the R2D2 sequence
-    # ring (its gather is windowed already).
+    # and store_final_obs off. Covers BOTH fused loops: the feedforward
+    # ring (replay/device.py) and the R2D2 sequence ring
+    # (replay/sequence_device.py _rebuild_seq_stacks).
     frame_dedup: bool = False
     # R2D2 sequence replay (>0 enables sequence mode):
     burn_in: int = 0

@@ -110,6 +110,39 @@ def ring_obs_example(obs_example, flat_storage: bool):
         lambda x: x.reshape(-1) if x.ndim >= 2 else x, obs_example)
 
 
+def resolve_frame_dedup(rcfg, env, obs_shape,
+                        store_final: bool = False):
+    """Validate + resolve ``replay.frame_dedup`` for a fused loop.
+
+    Returns (stack, stored_shape, frame_shape, slice_newest): the
+    declared rolling-stack depth (0 = dedup off), the per-step shape as
+    STORED in the ring (single frame under dedup), the static frame
+    shape the merge-rows gather reshapes to (None when off), and the
+    insert-side obs slicer. Shared by train_loop and r2d2_loop so the
+    contract checks cannot diverge."""
+    obs_shape = tuple(obs_shape)
+    stack = rcfg.frame_dedup and getattr(env, "frame_stack", 0) or 0
+    if rcfg.frame_dedup:
+        if stack < 2:
+            raise ValueError(
+                "replay.frame_dedup=True but this env does not declare a "
+                "rolling frame stack (JaxEnv.frame_stack is "
+                f"{getattr(env, 'frame_stack', 0)}); dedup storage "
+                "cannot rebuild its observations")
+        if stack != obs_shape[-1]:
+            raise ValueError(
+                f"env.frame_stack={stack} does not match the obs last "
+                f"axis {obs_shape[-1]}")
+        if store_final:
+            raise ValueError(
+                "replay.frame_dedup needs store_final_obs off (the "
+                "final-obs buffer is not a rolling frame stream)")
+    stored_shape = obs_shape[:-1] + (1,) if stack else obs_shape
+    frame_shape = stored_shape if stack else None
+    slice_newest = ((lambda o: o[..., -1:]) if stack else (lambda o: o))
+    return stack, stored_shape, frame_shape, slice_newest
+
+
 def make_schedules(cfg: ExperimentConfig, B: int, num_shards: int
                    ) -> Tuple[Callable, Callable]:
     """(epsilon(iteration), beta(iteration)): exploration decay and the PER

@@ -25,6 +25,7 @@ from dist_dqn_tpu.agents.r2d2 import make_r2d2_learner, \
     make_recurrent_actor_step
 from dist_dqn_tpu.config import ExperimentConfig
 from dist_dqn_tpu.envs.base import JaxEnv
+from dist_dqn_tpu.replay import device as ring
 from dist_dqn_tpu.replay import sequence_device as sring
 from dist_dqn_tpu.types import PyTree
 
@@ -51,11 +52,6 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
     """Returns (init, run_chunk) — same contract as train_loop.make_fused_train."""
     spmd = axis_name is not None
     rcfg = cfg.replay
-    if rcfg.frame_dedup:
-        raise ValueError(
-            "replay.frame_dedup is not implemented for the R2D2 sequence "
-            "ring (its windowed gather already amortizes storage "
-            "differently) — unset it for recurrent configs")
     seq_len = rcfg.burn_in + rcfg.unroll_length + cfg.learner.n_step
     stride = rcfg.sequence_stride or rcfg.unroll_length
     init_learner, train_step = make_r2d2_learner(net, cfg.learner, rcfg,
@@ -73,15 +69,30 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
             f"sequence ring too small: num_slots={num_slots} < "
             f"seq_len+stride={seq_len + stride}; raise replay.capacity")
 
+    # Frame-dedup (replay.frame_dedup): the sequence ring stores single
+    # frames and the sampler rebuilds [L, S, H, W, stack] stacks — same
+    # 4x HBM saving and exactness contract as the feedforward ring
+    # (replay/sequence_device.py _rebuild_seq_stacks).
+    _obs_shape = tuple(env.observation_shape)
+    stack, _stored_shape, _frame_shape, _slice_newest = \
+        loop_common.resolve_frame_dedup(rcfg, env, _obs_shape)
+    # Context slots for the oldest start's rebuild, and headroom so a
+    # seeded start is never ONLY transiently inside the masked oldest
+    # region between two stride seeds (the static side of the can_train
+    # guard below).
+    num_slots = max(num_slots, seq_len + stride + max(stack - 1, 0))
+
     # Pixel sequence rings take the same merged-row flat storage as the
     # feedforward ring (loop_common.resolve_flat_storage): obs rows are
     # flattened at insert and reshaped back after the window gather.
-    _obs_shape = tuple(env.observation_shape)
     flat_storage = loop_common.resolve_flat_storage(
-        rcfg, _obs_shape, env.observation_dtype, num_slots, B)
+        rcfg, _stored_shape, env.observation_dtype, num_slots, B,
+        prefer_flat=bool(stack))
 
-    _flatten_batched, _unflatten_seq = loop_common.flat_obs_codecs(
-        flat_storage, _obs_shape)
+    _flatten_batched, _unflatten_seq_codec = loop_common.flat_obs_codecs(
+        flat_storage, _stored_shape)
+    # Dedup sampling returns rebuilt (unflattened) stacks already.
+    _unflatten_seq = ((lambda x: x) if stack else _unflatten_seq_codec)
 
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
     _split_rng = loop_common.make_rng_splitter(spmd)
@@ -91,8 +102,17 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
     def can_train(replay: sring.SequenceRingState, iteration: Array) -> Array:
         filled = replay.ring.size * B >= min_fill
         # The dynamic any() guard backs up the static ring-size check above:
-        # never sample when no seeded window start is currently alive.
-        has_starts = jnp.any(replay.priorities > 0.0)
+        # never sample when no seeded window start is currently alive —
+        # counting only starts the dedup sampler would actually draw
+        # (the oldest stack-1 are masked: replay/device.py
+        # contextful_start_mask), so a transiently all-masked plane
+        # cannot produce zero-weight garbage batches.
+        alive = replay.priorities > 0.0
+        if stack:
+            alive = jnp.logical_and(
+                alive,
+                ring.contextful_start_mask(replay.ring, stack)[:, None])
+        has_starts = jnp.any(alive)
         return jnp.logical_and(
             jnp.logical_and(jnp.logical_and(filled, has_starts),
                             sring.sequence_ring_can_sample(replay, seq_len)),
@@ -108,7 +128,8 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
         env_state, obs = env.v_reset(k_env, B)
         obs = jax.tree.map(jnp.copy, obs)
         obs_example = jax.tree.map(lambda x: x[0], obs)
-        ring_example = loop_common.ring_obs_example(obs_example,
+        stored_example = jax.tree.map(lambda x: _slice_newest(x)[0], obs)
+        ring_example = loop_common.ring_obs_example(stored_example,
                                                     flat_storage)
         replay = sring.sequence_ring_init(num_slots, B, ring_example,
                                           net.lstm_size,
@@ -132,7 +153,9 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
         env_state, out = env.v_step(carry.env_state, actions)
         # Store the *pre-step* carry: the state the actor held entering obs.
         replay = sring.sequence_ring_add(
-            carry.replay, _flatten_batched(carry.obs), actions, out.reward,
+            carry.replay,
+            _flatten_batched(jax.tree.map(_slice_newest, carry.obs)),
+            actions, out.reward,
             out.terminated, out.truncated, carry.actor_carry, seq_len,
             stride, merge_obs_rows=flat_storage)
         # Zero the carry for envs that just finished an episode so the next
@@ -152,7 +175,8 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
                     rep, key, batch_size, seq_len,
                     rcfg.priority_exponent, beta, use_pallas=use_pallas,
                     pallas_interpret=pallas_interpret,
-                    merge_obs_rows=flat_storage)
+                    merge_obs_rows=flat_storage,
+                    frame_stack=stack, frame_shape=_frame_shape)
                 s = s._replace(obs=_unflatten_seq(s.obs))
                 l, metrics = train_step(l, s)
                 rep = sring.sequence_ring_update(

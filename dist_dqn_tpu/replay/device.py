@@ -161,6 +161,21 @@ def compute_n_step(reward_w: Array, term_w: Array, trunc_w: Array,
     return returns, discount, kstar
 
 
+def contextful_start_mask(state: TimeRingState, frame_stack: int) -> Array:
+    """[T] bool — slots whose frame-dedup rebuild context is stored: the
+    oldest ``frame_stack - 1`` stored slots are excluded (their context
+    holds the other lap's frames, or nothing on the first lap). All-true
+    when ``frame_stack`` is 0/1. Shared by the prioritized transition
+    sampler, the sequence sampler, and the loops' can_train gates so the
+    exclusion region cannot diverge."""
+    num_slots = state.action.shape[0]
+    extra = max(frame_stack - 1, 0)
+    t = jnp.arange(num_slots, dtype=jnp.int32)
+    oldest = (state.pos - state.size) % num_slots
+    offset = (t - oldest) % num_slots
+    return jnp.logical_and(offset >= extra, offset < state.size)
+
+
 def stack_rebuild_indices(done_at, t_idx: Array, frame_stack: int,
                           num_slots: int):
     """Per-channel ring slots that rebuild a frame stack stored deduped.

@@ -73,14 +73,14 @@ def _valid_start_mask(state: ring.TimeRingState, n_step: int,
     """[T] bool — slots that are valid n-step window starts (same region the
     uniform sampler draws from: the oldest size - n_step slots; frame-dedup
     rings also exclude the oldest frame_stack - 1, whose stack-rebuild
-    context is not stored)."""
+    context is not stored — ring.contextful_start_mask)."""
     num_slots = state.action.shape[0]
-    extra = max(frame_stack - 1, 0)
     t = jnp.arange(num_slots, dtype=jnp.int32)
     oldest = (state.pos - state.size) % num_slots
     offset = (t - oldest) % num_slots
-    return jnp.logical_and(offset >= extra,
-                           offset < (state.size - n_step))
+    return jnp.logical_and(
+        ring.contextful_start_mask(state, frame_stack),
+        offset < (state.size - n_step))
 
 
 def prioritized_ring_sample(state: PrioritizedRingState, rng: Array,

@@ -110,22 +110,83 @@ def _gather_seq(field: Array, t_idx: Array, b_idx: Array, L: int,
     return field[tt, b_idx[None, :]]
 
 
+def _rebuild_seq_stacks(r: ring.TimeRingState, t_idx: Array, b_idx: Array,
+                        seq_len: int, frame_stack: int,
+                        merge_obs_rows: bool, frame_shape) -> PyTree:
+    """[L, S, ..., frame_stack] stacks for every window position, from a
+    dedup ring (single stored frames — replay/device.py semantics).
+
+    One extended gather of ``seq_len + frame_stack - 1`` frames (offsets
+    -(S-1)..L-1) covers every position's context; each position's
+    channels then index into it with the same ``min(d, age)`` clamp as
+    ``device.stack_rebuild_indices`` (reset re-tiling). Callers mask out
+    window starts whose context predates the ring (sequence_ring_sample).
+    """
+    num_slots, num_envs = r.action.shape
+    S = frame_stack
+    L = seq_len
+    ext_offs = jnp.arange(-(S - 1), L, dtype=jnp.int32)        # [L+S-1]
+    tt = (t_idx[None, :] + ext_offs[:, None]) % num_slots      # [E, S_]
+
+    def gather_ext(x):
+        if merge_obs_rows:
+            out = x[tt * num_envs + b_idx[None, :]]
+            return out.reshape(out.shape[:2] + tuple(frame_shape))
+        return x[tt, b_idx[None, :]]
+
+    done_ext = jnp.logical_or(r.terminated, r.truncated)[
+        tt, b_idx[None, :]]                                    # [E, S_]
+    # age[i] = distance-1 to the nearest done among positions i-1..i-(S-1)
+    # (window position i lives at ext index i + S - 1).
+    batch = t_idx.shape[0]
+    age = jnp.full((L, batch), S - 1, jnp.int32)
+    for j in range(S - 1, 0, -1):   # descending: the nearest done wins
+        # done at position i-j = ext index i + S - 1 - j.
+        age = jnp.where(done_ext[S - 1 - j:S - 1 - j + L], j - 1, age)
+
+    def rebuild(x):
+        ext = gather_ext(x)                                    # [E, S_, ...]
+        pos = jnp.arange(L, dtype=jnp.int32)[:, None]          # [L, 1]
+        chans = []
+        for d in range(S - 1, -1, -1):                         # oldest first
+            idx = pos + (S - 1) - jnp.minimum(d, age)          # [L, S_]
+            idx = idx.reshape(idx.shape + (1,) * (ext.ndim - 2))
+            chans.append(jnp.take_along_axis(ext, idx, axis=0))
+        return jnp.concatenate(chans, axis=-1)
+
+    return jax.tree.map(rebuild, r.obs)
+
+
 def sequence_ring_sample(state: SequenceRingState, rng: Array,
                          batch_size: int, seq_len: int, alpha: float,
                          beta: Array, use_pallas: bool = False,
                          pallas_interpret: bool = False,
-                         merge_obs_rows: bool = False) -> SequenceSample:
+                         merge_obs_rows: bool = False,
+                         frame_stack: int = 0,
+                         frame_shape=None) -> SequenceSample:
     """Stratified-CDF sample of ``batch_size`` length-``seq_len`` sequences.
 
     Same inverse-CDF machinery as the transition sampler — the priority
     plane is already masked (zero = invalid start) — including the same
     Pallas kernel routing (ops/pallas_sampler.py) for large planes on TPU.
+
+    ``frame_stack=S > 0``: the ring stores single frames (dedup) and the
+    returned obs are rebuilt [L, S_, ..., S] stacks; starts whose
+    rebuild context predates the stored region (the oldest S-1 slots)
+    are masked out of the draw.
     """
     from dist_dqn_tpu.ops.pallas_sampler import (importance_weights,
                                                  stratified_sample)
 
     num_slots, num_envs = state.priorities.shape
     w = jnp.where(state.priorities > 0.0, state.priorities ** alpha, 0.0)
+    if frame_stack:
+        # Exclude the oldest frame_stack-1 starts: their context slots
+        # hold the other lap's frames (or nothing, first lap). Shared
+        # region logic: replay/device.py contextful_start_mask.
+        w = jnp.where(
+            ring.contextful_start_mask(state.ring, frame_stack)[:, None],
+            w, 0.0)
     t_idx, b_idx, mass_sel, total = stratified_sample(
         w, rng, batch_size, use_pallas=use_pallas,
         interpret=pallas_interpret)
@@ -133,7 +194,10 @@ def sequence_ring_sample(state: SequenceRingState, rng: Array,
     weights = importance_weights(mass_sel, total, n_valid, beta)
 
     r = state.ring
-    if merge_obs_rows:
+    if frame_stack:
+        obs = _rebuild_seq_stacks(r, t_idx, b_idx, seq_len, frame_stack,
+                                  merge_obs_rows, frame_shape)
+    elif merge_obs_rows:
         # Flat rows: slot t of env b lives at row t*B + b.
         offs = jnp.arange(seq_len, dtype=jnp.int32)
         tt = (t_idx[None, :] + offs[:, None]) % num_slots      # [L, S]

@@ -82,30 +82,13 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     # lifts the v5e pixel window cap from ~200k to ~1M transitions.
     # Exactness relies on the env's declared rolling-stack contract.
     _obs_shape = tuple(env.observation_shape)
-    stack = cfg.replay.frame_dedup and getattr(env, "frame_stack", 0) or 0
-    if cfg.replay.frame_dedup:
-        if stack < 2:
-            raise ValueError(
-                "replay.frame_dedup=True but this env does not declare a "
-                "rolling frame stack (JaxEnv.frame_stack is "
-                f"{getattr(env, 'frame_stack', 0)}); dedup storage cannot "
-                "rebuild its observations")
-        if stack != _obs_shape[-1]:
-            raise ValueError(
-                f"env.frame_stack={stack} does not match the obs last "
-                f"axis {_obs_shape[-1]}")
-        if store_final:
-            raise ValueError(
-                "replay.frame_dedup needs store_final_obs off (the "
-                "final-obs buffer is not a rolling frame stream)")
+    stack, _stored_shape, _frame_shape, _slice_newest = \
+        loop_common.resolve_frame_dedup(cfg.replay, env, _obs_shape,
+                                        store_final=store_final)
     # Dedup rebuild needs frame_stack-1 context slots beyond the n-step
     # window; a ring under that floor would be permanently unsampleable.
     num_slots = max(num_slots,
                     cfg.learner.n_step + max(stack - 1, 0) + 2)
-    # Shape as STORED in the ring (single frame under dedup).
-    _stored_shape = _obs_shape[:-1] + (1,) if stack else _obs_shape
-    _frame_shape = _stored_shape if stack else None
-    _slice_newest = (lambda o: o[..., -1:]) if stack else (lambda o: o)
 
     # Multi-dim obs can be STORED FLAT in the ring — [slots*B, 28224]
     # for 84x84x4, via replay/device.py merge_obs_rows — with reshapes
