@@ -127,11 +127,21 @@ def _sizes(smoke: bool) -> dict:
     cannot silently diverge from the config it claims to measure."""
     from dist_dqn_tpu.config import CONFIGS
 
+    # Frame-dedup storage is the round-5 default (BENCH_FRAME_DEDUP=0
+    # opts back to full-stack storage): single stored frames +
+    # sample-time stack rebuild measured FASTER than stacked at matched
+    # rings on v5e (637.0k vs 619.1k at 16k; 632.4k at 65k vs 572.5k
+    # stacked) because the 4x smaller ring keeps gathers/inserts hot.
+    # The default ring is sized per mode to the same HBM bytes: 65k
+    # deduped == 16k stacked (~0.5 GB) — so the default headline also
+    # carries a 4x bigger replay window than round 4's.
+    frame_dedup = os.environ.get("BENCH_FRAME_DEDUP", "1") == "1"
+    default_ring = 65_536 if frame_dedup else 16_384
     return {
         "num_envs": _env_int("BENCH_NUM_ENVS", 8 if smoke else 1024),
         "chunk": _env_int("BENCH_CHUNK", 20 if smoke else 200),
         "measure_chunks": _env_int("BENCH_MEASURE_CHUNKS", 2 if smoke else 25),
-        "ring": _env_int("BENCH_RING", 2_048 if smoke else 16_384),
+        "ring": _env_int("BENCH_RING", 2_048 if smoke else default_ring),
         "batch": _env_int("BENCH_BATCH", 32 if smoke else 512),
         "train_every": _env_int("BENCH_TRAIN_EVERY",
                                 CONFIGS["atari"].train_every),
@@ -143,6 +153,7 @@ def _sizes(smoke: bool) -> dict:
         # BENCH_PALLAS_SAMPLER=1 (what the apex preset's 1M shard uses).
         "prioritized": os.environ.get("BENCH_PRIORITIZED") == "1",
         "pallas_sampler": os.environ.get("BENCH_PALLAS_SAMPLER") == "1",
+        "frame_dedup": frame_dedup,
     }
 
 
@@ -172,14 +183,22 @@ def main() -> int:
         # budget, BEFORE touching the device — a run that hits the
         # watchdog dies mid-device-op and wedges the tunnel (incident
         # #3). CPU smoke runs are exempt (no tunnel to wedge).
+        from dist_dqn_tpu.config import CONFIGS
+        from dist_dqn_tpu.envs import make_jax_env
         from dist_dqn_tpu.utils.sizing import gate_fused
 
         s = _sizes(smoke)
+        # Stack depth from the env's own declaration (train.py does the
+        # same) so the gate's dedup divisor cannot drift from reality.
+        bench_env = make_jax_env(CONFIGS["atari"].env_name)
+        dedup_stack = (getattr(bench_env, "frame_stack", 0)
+                       if s["frame_dedup"] else 0)
         verdict = gate_fused(
             budget_s=total_budget, num_envs=s["num_envs"],
             batch_size=s["batch"], train_every=s["train_every"],
             chunk_iters=s["chunk"], num_chunks=2 + s["measure_chunks"],
-            ring=s["ring"])
+            ring=s["ring"],
+            frame_dedup_stack=dedup_stack)
         if not verdict.ok:
             _emit({"metric": METRIC, "value": None, "unit": UNIT,
                    "vs_baseline": None, **verdict.as_fields(),
@@ -269,20 +288,21 @@ def _measure(jax, device, smoke: bool):
     cfg = dataclasses.replace(
         cfg,
         actor=dataclasses.replace(cfg.actor, num_envs=num_envs),
-        # 16384 pixel slots ~= 0.5 GB of HBM for the obs ring. The
-        # 2026-08-01 ring-size axis on a 16 GB v5e: 627k/619k/605k/572k/
-        # 527k env-steps/s at 8k/16k/32k/65k/131k slots — smaller rings
-        # keep the frame-stack gather hot (the atari preset samples the
-        # ring UNIFORMLY; there is no PER tree in this program). 16k is
-        # the default: near the knee while still a credible replay
-        # window (16 iterations of history at 1024 lanes). Production
-        # configs size their rings for learning (e.g. atari: 200k), not
-        # for this contract metric.
+        # Round-5 default: a 65,536-transition FRAME-DEDUP ring — the
+        # same ~0.5 GB of HBM as round 4's 16k stacked default with 4x
+        # its replay window, and FASTER (632.4k vs 572.5k stacked at
+        # 65k; 637.0k vs 619.1k at 16k — the smaller footprint keeps
+        # gathers/inserts hot). Stacked ring-size axis for reference
+        # (2026-08-01 v5e): 627k/619k/605k/572k/527k env-steps/s at
+        # 8k/16k/32k/65k/131k slots (uniform sampling; no PER tree in
+        # this program). Production configs size their rings for
+        # learning (e.g. atari: 200k), not for this contract metric.
         replay=dataclasses.replace(
             cfg.replay,
             capacity=s["ring"],
             prioritized=s["prioritized"],
             pallas_sampler=s["pallas_sampler"],
+            frame_dedup=s["frame_dedup"],
             min_fill=128 if smoke else 4_096),
         learner=dataclasses.replace(
             cfg.learner,
@@ -313,8 +333,12 @@ def _measure(jax, device, smoke: bool):
     extras = {"platform": device.platform,
               "device_kind": getattr(device, "device_kind", "unknown")}
     if s["prioritized"]:
-        extras["prioritized"] = True  # default contract line unchanged
+        extras["prioritized"] = True  # opt-in: default line unchanged
         extras["sampler"] = "pallas" if s["pallas_sampler"] else "xla"
+    if s["frame_dedup"]:
+        # ON by default since round 5: the default contract line carries
+        # this field (value/unit/vs_baseline schema unchanged).
+        extras["frame_dedup"] = True
     # Conventional MFU: learner fwd+bwd+optimizer FLOPs only. Grad-step
     # count uses the last chunk's census — the cadence is deterministic in
     # steady state, so every measured chunk ran the same number (reading
