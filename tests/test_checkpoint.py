@@ -538,23 +538,42 @@ def test_host_all_steps_skips_only_missing_checkpoints(tmp_path, capsys):
         ev.main()
 
 
-def test_checkpoint_replay_resumes_bit_equal(tmp_path):
+@pytest.mark.parametrize("mode", ["vector", "pixel_dedup"])
+def test_checkpoint_replay_resumes_bit_equal(tmp_path, mode):
     """--checkpoint-replay saves the WHOLE fused carry, so an
     interrupted+resumed run must reproduce the uninterrupted run's
     parameters BIT-EXACTLY — the property learner-only checkpoints
     cannot give (replay refills with fresh experience there). VERDICT
-    round-3 next #7."""
+    round-3 next #7. The pixel_dedup variant pins the same property for
+    the frame-dedup ring carry (single-frame obs leaves)."""
     from dist_dqn_tpu.train import train
 
-    cfg = CONFIGS["cartpole"]
-    cfg = dataclasses.replace(
-        cfg,
-        network=dataclasses.replace(cfg.network, mlp_features=(16,)),
-        replay=dataclasses.replace(cfg.replay, capacity=512, min_fill=64),
-        learner=dataclasses.replace(cfg.learner, batch_size=16),
-        actor=dataclasses.replace(cfg.actor, num_envs=4),
-        eval_every_steps=0,
-    )
+    if mode == "vector":
+        cfg = CONFIGS["cartpole"]
+        cfg = dataclasses.replace(
+            cfg,
+            network=dataclasses.replace(cfg.network, mlp_features=(16,)),
+            replay=dataclasses.replace(cfg.replay, capacity=512,
+                                       min_fill=64),
+            learner=dataclasses.replace(cfg.learner, batch_size=16),
+            actor=dataclasses.replace(cfg.actor, num_envs=4),
+            eval_every_steps=0,
+        )
+    else:
+        cfg = CONFIGS["atari"]
+        cfg = dataclasses.replace(
+            cfg,
+            env_name="pixel_catch",
+            network=dataclasses.replace(cfg.network, torso="small",
+                                        hidden=16,
+                                        compute_dtype="float32"),
+            replay=dataclasses.replace(cfg.replay, capacity=512,
+                                       min_fill=64, frame_dedup=True),
+            learner=dataclasses.replace(cfg.learner, batch_size=8),
+            actor=dataclasses.replace(cfg.actor, num_envs=4),
+            train_every=2,
+            eval_every_steps=0,
+        )
     quiet = lambda s: None  # noqa: E731
 
     ref_carry, _ = train(cfg, total_env_steps=600, chunk_iters=75,
