@@ -55,6 +55,18 @@ VARIANTS = {
                           "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "32768"},
     "lanes1024_ring131k": {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
                            "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "131072"},
+    # Round-5 dedup axis: frame_dedup is bench.py's default since round
+    # 5 (65k ring); these pin the stacked-vs-dedup pair at matched
+    # rings and the dedup cost trend at bigger windows. Measured
+    # 2026-08-02: dedup 637.0k@16k / 632.4k@65k vs stacked 619.1k@16k /
+    # 572.5k@65k.
+    "stacked_ring16k":   {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                          "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "16384",
+                          "BENCH_FRAME_DEDUP": "0"},
+    "dedup_ring16k":     {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                          "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "16384"},
+    "dedup_ring262k":    {"BENCH_NUM_ENVS": "1024", "BENCH_BATCH": "512",
+                          "BENCH_TRAIN_EVERY": "4", "BENCH_RING": "262144"},
     # 1.5x the proven 1024 lanes — inside the <=2x-of-proven sizing rule
     # (verify skill incident #3), but still the riskiest of the defaults,
     # so DEFAULT_VARIANTS runs it after every proven size.
@@ -72,6 +84,7 @@ OVERSIZED = ("lanes2048_b1024",)
 # winning point), re-measurements of known points after, the one
 # unproven size last.
 DEFAULT_VARIANTS = [
+    "dedup_ring16k", "stacked_ring16k", "dedup_ring262k",
     "lanes1024_b512", "lanes1024_ring8k", "lanes1024_ring32k",
     "lanes1024_ring131k",
     "default_512x256", "lanes1024_b256te2", "lanes256_b128",
