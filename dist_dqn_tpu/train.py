@@ -296,7 +296,8 @@ def main():
                         help="fused runtime, single-process: stop early "
                              "once eval_return reaches this value (e.g. "
                              "475 = CartPole solved)")
-    parser.add_argument("--runtime", choices=("fused", "apex"),
+    parser.add_argument("--runtime", choices=("fused", "apex",
+                                              "host-replay"),
                         default="fused",
                         help="fused: on-device Anakin loop (JAX envs); "
                              "apex: CPU actor processes + learner service "
@@ -348,6 +349,48 @@ def main():
         # truthiness test here silently fell back to the config period.
         import dataclasses as _dc
         cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)
+    if args.runtime == "host-replay":
+        # Hybrid fused loop with the replay window in host DRAM
+        # (host_replay_loop.py): device env chunks stream transitions
+        # down once, sampled batches stream back double-buffered. The
+        # window is DRAM-priced — set replay.capacity accordingly
+        # (e.g. --set replay.capacity=8000000 with frame_dedup).
+        for val, name in ((args.checkpoint_dir, "--checkpoint-dir"),
+                          (args.profile_dir, "--profile-dir"),
+                          (args.stop_at_return, "--stop-at-return")):
+            if val is not None:
+                print(f"# {name} is not supported by --runtime "
+                      "host-replay (prototype surface); ignored")
+        for val, name in ((args.mesh_devices != 1, "--mesh-devices"),
+                          (args.save_every_frames, "--save-every-frames"),
+                          (args.checkpoint_replay, "--checkpoint-replay")):
+            if val:
+                print(f"# {name} is not supported by --runtime "
+                      "host-replay (prototype surface); ignored")
+        if args.eval_every_steps:
+            print("# periodic eval is not supported by --runtime "
+                  "host-replay; ignored")
+        if args.wall_budget_s is not None:
+            # No calibrated time model exists for this loop (it is
+            # link-bound, not chunk-count-bound), so the fused sizing
+            # gate cannot vet the budget — say so rather than silently
+            # dropping the flag (the wedge-prevention contract).
+            print("# --wall-budget-s is not modeled for --runtime "
+                  "host-replay: size the run manually (worst case = "
+                  "compiles + chunks x measured chunk wall; see "
+                  "benchmarks/host_replay_bench.py probe pattern) — "
+                  "a run SIGTERM'd mid-device-op can wedge the tunnel")
+        if args.seed is not None:
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, seed=args.seed)
+        from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+        out = run_host_replay(
+            cfg, total_env_steps=args.total_env_steps or cfg.total_env_steps,
+            chunk_iters=args.chunk_iters, log_fn=print)
+        out.pop("history", None)
+        print(json.dumps(out))
+        return
     if args.runtime == "apex":
         if args.profile_dir:
             print("# --profile-dir applies to the fused runtime only; "

@@ -111,6 +111,32 @@ def test_hybrid_loop_vector_env_trains():
     assert last["d2h_bytes"] > 0
 
 
+def test_train_cli_host_replay_runtime(capsys):
+    """--runtime host-replay is a first-class train-CLI surface: the
+    hybrid loop runs end to end and prints the summary JSON."""
+    import json
+    import sys
+    from unittest import mock
+
+    from dist_dqn_tpu import train as tr
+
+    argv = ["train", "--config", "cartpole", "--runtime", "host-replay",
+            "--platform", "cpu", "--total-env-steps", "2000",
+            "--chunk-iters", "50",
+            "--set", "network.mlp_features=(16,)",
+            "--set", "replay.capacity=1024",
+            "--set", "replay.min_fill=64",
+            "--set", "learner.batch_size=16",
+            "--set", "actor.num_envs=8"]
+    with mock.patch.object(sys, "argv", argv):
+        tr.main()
+    rows = [json.loads(line) for line in capsys.readouterr().out.splitlines()
+            if line.startswith("{")]
+    assert rows[-1]["env_steps"] >= 2000
+    assert rows[-1]["grad_steps"] > 0
+    assert rows[-1]["window_transitions_max"] == 1024
+
+
 def test_hybrid_loop_pixel_dedup():
     """Pixel env + frame_dedup: D2H streams single frames (7 KB/step,
     not 28), the host ring rebuilds stacks, the CNN learner trains."""
