@@ -332,6 +332,26 @@ def _measure(jax, device, smoke: bool):
     value = measure_chunks * chunk * num_envs / dt
     extras = {"platform": device.platform,
               "device_kind": getattr(device, "device_kind", "unknown")}
+    # Telemetry snapshot (ISSUE 1): a perf regression in this line should
+    # carry the pipeline internals, not just the headline number — record
+    # the measured state into the process registry and embed its JSON
+    # snapshot in the contract line's extras.
+    from dist_dqn_tpu import telemetry
+    from dist_dqn_tpu.telemetry import collectors as tmc
+
+    reg = telemetry.get_registry()
+    reg.gauge(tmc.ENV_RATE, "measured env-steps/sec").set(value)
+    reg.counter(tmc.ENV_STEPS, "env steps in the measured window") \
+        .inc(measure_chunks * chunk * num_envs)
+    chunk_hist = reg.histogram("dqn_chunk_seconds", "fused chunk wall")
+    chunk_hist.observe(dt / measure_chunks)
+    tmc.observe_device_ring(carry.replay)
+    gsteps = float(jax.device_get(metrics["grad_steps_in_chunk"]))
+    if gsteps:
+        reg.histogram(tmc.GRAD_LATENCY,
+                      "per-grad-step share of the chunk wall") \
+            .observe(dt / measure_chunks / gsteps)
+    extras["telemetry"] = telemetry.snapshot(reg)
     if s["prioritized"]:
         extras["prioritized"] = True  # opt-in: default line unchanged
         extras["sampler"] = "pallas" if s["pallas_sampler"] else "xla"

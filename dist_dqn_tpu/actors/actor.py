@@ -21,6 +21,24 @@ import numpy as np
 from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.envs.gym_adapter import make_host_env
+from dist_dqn_tpu.telemetry import (get_registry,
+                                    maybe_install_snapshot_from_env)
+
+
+def _actor_telemetry(actor_id: int, tag: str):
+    """Per-process liveness instruments (ISSUE 1): a wall-clock heartbeat
+    gauge + steps counter. Actors are separate processes, so the registry
+    is process-local; DQN_TELEMETRY_SNAPSHOT dumps it on exit (including
+    SIGTERM — the lifecycle hook), which is how a post-mortem can tell a
+    wedged actor (stale heartbeat) from a dead one (no snapshot update).
+    """
+    reg = get_registry()
+    maybe_install_snapshot_from_env(tag=f"{tag}{actor_id}")
+    labels = {"actor": str(actor_id)}
+    return (reg.gauge("dqn_actor_heartbeat_timestamp",
+                      "unix time of the last step-loop pass", labels),
+            reg.counter("dqn_actor_env_steps_total",
+                        "env steps taken by this actor process", labels))
 
 
 def _step_and_encode(env, actions, actor_id: int, t: int,
@@ -59,6 +77,7 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     while not ring.push(payload):
         time.sleep(0.001)
 
+    heartbeat, steps_total = _actor_telemetry(actor_id, "actor")
     steps = 0
     while steps < max_env_steps and not os.path.exists(stop_path):
         # Wait for the actions computed for our step-t observations.
@@ -70,6 +89,8 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
                                            t)
         steps += num_envs
+        steps_total.inc(num_envs)
+        heartbeat.set(time.time())
         while not ring.push(payload):
             if os.path.exists(stop_path):
                 return
@@ -107,6 +128,11 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             compress="auto"))
         return client
 
+    heartbeat, steps_total = _actor_telemetry(actor_id, "remote")
+    reconnects = get_registry().counter(
+        "dqn_actor_reconnects_total",
+        "remote-actor connection (re)establishments",
+        labels={"actor": str(actor_id)})
     obs = env.reset()
     t = 0
     failures = 0
@@ -119,6 +145,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             try:
                 client = connect_and_hello(obs, t)
                 failures = 0
+                reconnects.inc()
             except OSError:
                 failures += 1
                 time.sleep(reconnect_backoff_s)
@@ -132,6 +159,8 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
                                            t, compress="auto")
         steps += num_envs
+        steps_total.inc(num_envs)
+        heartbeat.set(time.time())
         if not client.push(payload):
             client.close()
             client = None

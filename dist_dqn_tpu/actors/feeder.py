@@ -33,6 +33,8 @@ import numpy as np
 
 from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
                                            encode_arrays)
+from dist_dqn_tpu.telemetry import (get_registry,
+                                    maybe_install_snapshot_from_env)
 
 #: records pre-encoded per feeder; cycled round-robin while pumping.
 POOL_RECORDS = 48
@@ -123,6 +125,20 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
     hello, pool = _build_pool(rng, actor_id, num_envs, obs_shape, obs_dtype)
     ring = ShmRing(req_ring)
     box = ShmMailbox(act_box)
+    # Telemetry (ISSUE 1): feeders are a separate process, so their
+    # registry is process-local — DQN_TELEMETRY_SNAPSHOT dumps it at
+    # exit. ring_full counts pushes the service's ring refused (the
+    # service-is-the-bottleneck signal this load generator exists to
+    # measure); the heartbeat gauge is wall-clock of the last loop.
+    reg = get_registry()
+    maybe_install_snapshot_from_env(tag=f"feeder{actor_id}")
+    labels = {"actor": str(actor_id)}
+    c_records = reg.counter("dqn_feeder_records_total",
+                            "records pushed into the shm ring", labels)
+    c_full = reg.counter("dqn_feeder_ring_full_total",
+                         "push attempts refused by a full ring", labels)
+    g_heartbeat = reg.gauge("dqn_actor_heartbeat_timestamp",
+                            "unix time of the last pump-loop pass", labels)
 
     while not ring.push(hello):
         if os.path.exists(stop_path):
@@ -149,11 +165,17 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
             # Stop checks cost a stat syscall each — off the per-push
             # hot path (this pump shares the core with the service under
             # measurement); the ring-full branch still checks every
-            # retry, so shutdown latency stays bounded either way.
+            # retry, so shutdown latency stays bounded either way. The
+            # records counter batches onto the same cadence to keep the
+            # pump a pure memcpy between checkpoints.
             if i % 256 == 0:
                 stop = os.path.exists(stop_path)
+                c_records.inc(256)
+                g_heartbeat.set(time.time())
         else:
             # Ring full: the service is the bottleneck (that is the
             # point of the measurement) — yield briefly and retry.
+            c_full.inc()
+            g_heartbeat.set(time.time())
             time.sleep(0.0005)
             stop = os.path.exists(stop_path)

@@ -16,6 +16,7 @@ costs at most one assembly window of experience.
 from __future__ import annotations
 
 import argparse
+import json
 
 from dist_dqn_tpu.actors.actor import run_remote_actor
 
@@ -34,7 +35,16 @@ def main():
     parser.add_argument("--max-reconnect-failures", type=int, default=60,
                         help="exit after this many consecutive failed "
                              "reconnects (the learner is gone)")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        help="serve this worker's /metrics (Prometheus "
+                             "text) on this port; 0 = ephemeral. Worker "
+                             "hosts are scraped independently of the "
+                             "learner (docs/observability.md)")
     args = parser.parse_args()
+    if args.telemetry_port is not None:
+        from dist_dqn_tpu import telemetry
+        server = telemetry.start_server(args.telemetry_port)
+        print(json.dumps({"telemetry_port": server.port}))
     host, port = args.address.rsplit(":", 1)
     seed = args.seed if args.seed is not None else 1000 + 7 * args.actor_id
     run_remote_actor(args.actor_id, args.env, args.num_envs, seed,

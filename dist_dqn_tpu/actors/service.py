@@ -36,6 +36,7 @@ from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing, shm_dir,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.config import ExperimentConfig
 from dist_dqn_tpu.replay.host import pad_pow2
+from dist_dqn_tpu.telemetry import collectors as tmc, get_registry
 from dist_dqn_tpu.utils.metrics import MetricLogger
 
 _PRIO_CHUNK = 256
@@ -129,6 +130,11 @@ class ApexRuntimeConfig:
     # device round-trip LATENCY (not compute) dominates, e.g. remote-
     # tunneled accelerators.
     pipeline_depth: int = 2
+    # Prometheus scrape endpoint (telemetry/server.py): serve the process
+    # registry's /metrics on this port (0 = ephemeral, logged as
+    # telemetry_port). None disables. Same surface as the fused
+    # runtime's --telemetry-port.
+    telemetry_port: Optional[int] = None
 
 
 class ApexLearnerService:
@@ -305,7 +311,8 @@ class ApexLearnerService:
             [None] * self.total_actors
         self._pending: List[Dict[str, np.ndarray]] = []
         self._pending_count = 0
-        self._in_flight = deque()  # (idx, gen, metrics) per dispatched step
+        # (idx, gen, metrics, t_dispatch) per dispatched train step.
+        self._in_flight = deque()
         self._act_queue: List = []  # (actor, obs, t) awaiting batched act
         self._obs_spec = None       # (per-env obs shape, dtype), first hello
         self._last_record = time.perf_counter()
@@ -341,6 +348,13 @@ class ApexLearnerService:
         self._boot_inflight: deque = deque()
         from dist_dqn_tpu.utils.trace import make_tracer
         self.tracer = make_tracer(rt.trace_path, process_name="apex-learner")
+        self._init_telemetry()
+        self.telemetry_server = None
+        if rt.telemetry_port is not None:
+            from dist_dqn_tpu.telemetry import start_server
+            self.telemetry_server = start_server(rt.telemetry_port)
+            self.log.log_fn(json.dumps(
+                {"telemetry_port": self.telemetry_server.port}))
         self.global_env_steps = 0
         self._resume_global = 0
         self._next_sync = 0.0
@@ -350,6 +364,69 @@ class ApexLearnerService:
             # checkpoint restore when configured) happens HERE — the first
             # actor hello lands at different times on different hosts.
             self._ensure_learner(obs_example)
+
+    def _init_telemetry(self):
+        """Registry instruments for the service loop (ISSUE 1): pipeline
+        queue depths, throughput counters, and the two latency
+        histograms — grad-step dispatch->materialize and host-param-
+        mirror staleness — that localize a learner-utilization drop
+        (docs/observability.md has the triage order)."""
+        reg = get_registry()
+        self._tm_env_steps = reg.counter(
+            tmc.ENV_STEPS, "env transitions ingested from actors")
+        self._tm_grad_steps = reg.counter(
+            tmc.GRAD_STEPS, "learner train steps dispatched")
+        self._tm_grad_latency = reg.histogram(
+            tmc.GRAD_LATENCY,
+            "train-step dispatch -> priority materialization")
+        self._tm_param_staleness = reg.histogram(
+            tmc.PARAM_STALENESS,
+            "age of the host param mirror at each refresh")
+        self._tm_act_queue = reg.gauge(
+            "dqn_service_act_queue_requests",
+            "actor act requests awaiting the batched device call")
+        self._tm_pending = reg.gauge(
+            "dqn_service_pending_transitions",
+            "assembled transitions awaiting priority bootstrap dispatch")
+        self._tm_boot_inflight = reg.gauge(
+            "dqn_service_bootstrap_inflight",
+            "priority-bootstrap chunks dispatched, not yet inserted")
+        self._tm_train_inflight = reg.gauge(
+            "dqn_service_train_inflight",
+            "pipelined train steps awaiting priority write-back")
+        self._tm_bad_records = reg.counter(
+            "dqn_service_bad_records_total",
+            "malformed/misrouted records rejected at the TCP boundary")
+        self._tm_ring_dropped = reg.gauge(
+            "dqn_transport_ring_dropped",
+            "records the shm ring dropped (producer overrun)")
+        self._tm_ring_pending = reg.gauge(
+            "dqn_transport_ring_pending_bytes",
+            "bytes queued in the shm ring awaiting drain")
+        self._tm_record_age = reg.gauge(
+            "dqn_ingest_last_record_age_seconds",
+            "seconds since the last valid actor record")
+        self._tm_stalls = reg.counter(
+            "dqn_ingest_stalls_total", "watchdog-detected ingest stalls")
+        self._tm_actor_restarts = reg.counter(
+            "dqn_actor_restarts_total",
+            "dead actor processes restarted by supervision")
+        self._tm_actor_alive: Dict[int, object] = {}
+        self._tm_episodes = reg.counter(
+            "dqn_episodes_completed_total", "training episodes finished")
+        # None until the FIRST mirror exists: construction->first-refresh
+        # spans the jit compile and is not mirror staleness — observing
+        # it would park a false 60s+ outlier in the triage histogram.
+        self._last_param_refresh = None
+
+    def _actor_alive_gauge(self, actor_id: int):
+        g = self._tm_actor_alive.get(actor_id)
+        if g is None:
+            g = get_registry().gauge(
+                "dqn_actor_alive", "1 while the actor process is alive",
+                labels={"actor": str(actor_id)})
+            self._tm_actor_alive[actor_id] = g
+        return g
 
     def _step_specs(self, axis: str):
         """(data_specs, metric_specs) PartitionSpecs for the train step:
@@ -451,8 +528,11 @@ class ApexLearnerService:
         fresh hello resets the assembly lanes and recurrent carry, and the
         learner never notices beyond a briefly idle lane."""
         for actor_id, p in list(self.procs.items()):
-            if not p.is_alive():
+            alive = p.is_alive()
+            self._actor_alive_gauge(actor_id).set(float(alive))
+            if not alive:
                 self.actor_restarts += 1
+                self._tm_actor_restarts.inc()
                 self.procs[actor_id] = self._spawn_one(actor_id)
 
     def shutdown(self):
@@ -464,6 +544,8 @@ class ApexLearnerService:
                 p.terminate()
         if self.tcp_server is not None:
             self.tcp_server.close()
+        if self.telemetry_server is not None:
+            self.telemetry_server.close()
         self.req_ring.unlink()
         for b in self.act_boxes:
             b.unlink()
@@ -519,6 +601,14 @@ class ApexLearnerService:
                       if self._prio_fn is not None else None)
             self._host_params = (self._mh.host_copy(self.state.params),
                                  target)
+            # Param-broadcast staleness: how old the previous mirror got
+            # before this refresh replaced it — the act/eval/bootstrap
+            # programs ran on params at most this stale.
+            now = time.perf_counter()
+            if self._last_param_refresh is not None:
+                self._tm_param_staleness.observe(
+                    now - self._last_param_refresh)
+            self._last_param_refresh = now
 
     @property
     def _policy_params(self):
@@ -623,6 +713,7 @@ class ApexLearnerService:
         silent = now - self._last_record
         if silent >= self.rt.stall_warn_s and not self._stall_warned:
             self._stall_warned = True
+            self._tm_stalls.inc()
             self.log.log_fn(f'{{"ingest_stalled_s": {silent:.1f}, '
                             f'"env_steps": {self.env_steps}}}')
             self.tracer.instant("ingest_stalled", silent_s=round(silent, 1))
@@ -698,6 +789,7 @@ class ApexLearnerService:
                 self._prev_obs[actor], self._prev_actions[actor],
                 arrays["reward"], terminated, truncated, arrays["next_obs"])
         self.env_steps += arrays["reward"].shape[0]
+        self._tm_env_steps.inc(arrays["reward"].shape[0])
         emitted = self.assemblers[actor].drain()
         if emitted is not None:
             if self.recurrent:
@@ -894,7 +986,9 @@ class ApexLearnerService:
                     self.state, metrics = self._train_step(
                         self.state, batch, jnp.asarray(weights))
             self.grad_steps += 1
-            self._in_flight.append((idx, gen, metrics))
+            self._tm_grad_steps.inc()
+            self._in_flight.append((idx, gen, metrics,
+                                    time.perf_counter()))
             # Retire completed steps beyond the pipeline window; the oldest
             # has had the longest to finish, so this rarely blocks.
             while len(self._in_flight) > self.rt.pipeline_depth:
@@ -905,12 +999,17 @@ class ApexLearnerService:
         them back (blocks on the device only if that step still runs)."""
         if not self._in_flight:
             return
-        idx, gen, metrics = self._in_flight.popleft()
+        idx, gen, metrics, t_dispatch = self._in_flight.popleft()
         with self.tracer.span("replay.update_priorities"):
             # expected_gen drops updates for slots overwritten while this
             # step was in flight (priority misattribution guard).
             self.replay.update_priorities(
                 idx, np.asarray(metrics["priorities"]), expected_gen=gen)
+        # Dispatch -> materialized: the np.asarray above blocked until the
+        # device finished this step, so this IS the grad-step round-trip
+        # (pipelining means it includes up to pipeline_depth-1 queued
+        # steps — the operationally honest number for the host loop).
+        self._tm_grad_latency.observe(time.perf_counter() - t_dispatch)
         self._last_loss = float(metrics["loss"])
 
     def _finalize_all_train(self):
@@ -1051,6 +1150,7 @@ class ApexLearnerService:
             finished = acc[done]
             self._ep_returns.extend(finished.tolist())
             self.episodes_completed += int(done.sum())
+            self._tm_episodes.inc(int(done.sum()))
             acc = np.where(done, 0.0, acc)
         self._ep_accum[actor] = acc
 
@@ -1085,6 +1185,7 @@ class ApexLearnerService:
                     # limited) so a genuine service bug surfacing here is
                     # visible, not silently counted away.
                     self.bad_records += 1
+                    self._tm_bad_records.inc()
                     if self.bad_records <= 5:
                         self.log.log_fn(
                             f"# bad TCP record ({self.bad_records})"
@@ -1134,6 +1235,15 @@ class ApexLearnerService:
                 if now - last_log > self.rt.log_every_s:
                     self.supervise_actors()
                     self._watchdog(now)
+                    # Queue-depth sweep (off the per-record hot path; one
+                    # gauge write each per log period).
+                    self._tm_act_queue.set(len(self._act_queue))
+                    self._tm_pending.set(self._pending_count)
+                    self._tm_boot_inflight.set(len(self._boot_inflight))
+                    self._tm_train_inflight.set(len(self._in_flight))
+                    self._tm_ring_dropped.set(self.req_ring.dropped)
+                    self._tm_ring_pending.set(self.req_ring.pending_bytes)
+                    self._tm_record_age.set(now - self._last_record)
                     self.tracer.counter("replay_size", len(self.replay))
                     self.tracer.counter("env_steps", self.env_steps)
                     self.tracer.flush()

@@ -25,6 +25,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dist_dqn_tpu.telemetry import get_registry
+
 _NATIVE_DIR = Path(__file__).parent / "_native"
 _LIB_PATH = _NATIVE_DIR / "libdqntransport.so"
 _lib = None
@@ -308,6 +310,19 @@ class TcpRecordServer:
         self._max_backlog = max_backlog
         self.dropped = 0              # always 0: full backlog backpressures
         self.backpressure_events = 0  # records that had to wait for space
+        # Telemetry (ISSUE 1): the DCN ingress queue. Backlog depth is
+        # THE learner-behind signal on this path (full backlog = TCP
+        # flow control throttling every remote actor).
+        reg = get_registry()
+        self._c_records = reg.counter("dqn_transport_tcp_records_total",
+                                      "records accepted from remote actors")
+        self._g_backlog = reg.gauge("dqn_transport_tcp_backlog",
+                                    "records queued awaiting service drain")
+        self._c_backpressure = reg.counter(
+            "dqn_transport_tcp_backpressure_total",
+            "records that had to wait for backlog space")
+        self._g_conns = reg.gauge("dqn_transport_tcp_connections",
+                                  "live remote-actor connections")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
@@ -326,6 +341,7 @@ class TcpRecordServer:
                 conn_id = self._next_conn
                 self._next_conn += 1
                 self._conns[conn_id] = conn
+                self._g_conns.set(len(self._conns))
             threading.Thread(target=self._serve, args=(conn_id, conn),
                              daemon=True).start()
 
@@ -349,14 +365,18 @@ class TcpRecordServer:
                     with self._lock:
                         if len(self._records) < self._max_backlog:
                             self._records.append((conn_id, payload))
+                            self._g_backlog.set(len(self._records))
+                            self._c_records.inc()
                             break
                         if not waited:
                             waited = True
                             self.backpressure_events += 1
+                            self._c_backpressure.inc()
                     time.sleep(0.001)
         finally:
             with self._lock:
                 self._conns.pop(conn_id, None)
+                self._g_conns.set(len(self._conns))
             conn.close()
 
     @staticmethod
@@ -375,7 +395,11 @@ class TcpRecordServer:
 
     def pop(self) -> Optional[Tuple[int, bytes]]:
         with self._lock:
-            return self._records.pop(0) if self._records else None
+            if not self._records:
+                return None
+            rec = self._records.pop(0)
+            self._g_backlog.set(len(self._records))
+            return rec
 
     def send(self, conn_id: int, payload: bytes) -> bool:
         """Reply down a connection (False if it is gone — actor churn)."""

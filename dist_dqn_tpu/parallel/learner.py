@@ -18,11 +18,14 @@ of SPMD.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_dqn_tpu.telemetry import get_registry
 
 from dist_dqn_tpu.agents.dqn import LearnerState
 from dist_dqn_tpu.config import ExperimentConfig
@@ -102,7 +105,25 @@ def _mesh_wrap(mesh: Mesh, specs, init_local, run_local):
             in_specs=(specs,), out_specs=(specs, P()), check_vma=False)
         return body(carry)
 
-    return init, run
+    # Mesh-chunk telemetry (ISSUE 1): dispatch count + host-side dispatch
+    # latency. JAX dispatch is async, so this times the enqueue, not the
+    # execution — a GROWING dispatch latency means the device queue is
+    # full and the host is now rate-limited by the mesh program (the
+    # chunk wall itself is measured by the caller, train.py).
+    reg = get_registry()
+    c_chunks = reg.counter("dqn_mesh_chunks_total",
+                           "fused mesh chunks dispatched")
+    h_dispatch = reg.histogram("dqn_mesh_chunk_dispatch_seconds",
+                               "host-side mesh chunk enqueue latency")
+
+    def run_instrumented(carry, num_iters: int):
+        t0 = time.perf_counter()
+        out = run(carry, num_iters)
+        h_dispatch.observe(time.perf_counter() - t0)
+        c_chunks.inc()
+        return out
+
+    return init, run_instrumented
 
 
 def make_mesh_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
@@ -131,6 +152,11 @@ def make_mesh_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
 
 
 def global_metrics(metrics: Dict) -> Dict:
-    """Device-get + float-cast a metrics dict for logging."""
+    """Device-get + float-cast a metrics dict for logging; mirrors each
+    value into a ``dqn_mesh_<name>`` registry gauge on the way."""
     got = jax.device_get(metrics)
-    return {k: float(v) for k, v in got.items()}
+    out = {k: float(v) for k, v in got.items()}
+    reg = get_registry()
+    for k, v in out.items():
+        reg.gauge(f"dqn_mesh_{k}", f"mesh chunk metric {k!r}").set(v)
+    return out

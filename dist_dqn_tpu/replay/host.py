@@ -29,6 +29,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dist_dqn_tpu.telemetry import collectors as tm
+
 _NATIVE_DIR = Path(__file__).parent / "_native"
 _tree_lib = None
 _tree_lib_lock = threading.Lock()
@@ -325,6 +327,31 @@ class PrioritizedHostReplay:
         # Cumulative counters for metrics (BASELINE.json:2 throughput).
         self.added = 0
         self.sampled = 0
+        # Telemetry (ISSUE 1): occupancy/eviction/priority-distribution
+        # for the host shard. Instruments are cached here — the add/
+        # sample hot paths pay one attribute op + one locked float add.
+        from dist_dqn_tpu.telemetry import get_registry
+        reg = get_registry()
+        # Every series in a shared family carries the store label, so
+        # per-store aggregation (sum by (store)) never drops a shard.
+        labels = {"store": "host"}
+        self._g_size, self._g_cap, self._g_occ = tm.replay_gauges("host",
+                                                                  reg)
+        self._g_cap.set(capacity)
+        self._c_added = reg.counter(tm.REPLAY_ADDED,
+                                    "items written to the host shard",
+                                    labels)
+        self._c_sampled = reg.counter(tm.REPLAY_SAMPLED,
+                                      "items drawn from the host shard",
+                                      labels)
+        self._c_evicted = reg.counter(
+            tm.REPLAY_EVICTED, "ring overwrites of still-live items",
+            labels)
+        self._g_max_prio = reg.gauge(
+            tm.REPLAY_MAX_PRIORITY, "running max |TD| priority", labels)
+        self._g_mass = reg.gauge(
+            tm.REPLAY_PRIORITY_MASS,
+            "total p^alpha mass in the shard's sum-tree", labels)
         # Per-slot write generation: lets async learners (pipelined train
         # steps, actors/service.py) detect that a sampled slot was
         # overwritten before its priority write-back and drop the stale
@@ -362,8 +389,15 @@ class PrioritizedHostReplay:
             self.tree.set(idx, mass)
         self.added += batch
         self._slot_gen[idx] = self.added
+        evicted = max(self._size + batch - self.capacity, 0)
         self._pos = int((self._pos + batch) % self.capacity)
         self._size = int(min(self._size + batch, self.capacity))
+        self._c_added.inc(batch)
+        if evicted:
+            self._c_evicted.inc(evicted)
+        self._g_size.set(self._size)
+        self._g_occ.set(self._size / self.capacity)
+        self._g_max_prio.set(self._max_priority)
 
     def sample(self, batch_size: int, beta: float
                ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
@@ -384,6 +418,9 @@ class PrioritizedHostReplay:
             weights = (weights / weights.max()).astype(np.float32)
         items = {k: v[idx] for k, v in self._data.items()}
         self.sampled += batch_size
+        self._c_sampled.inc(batch_size)
+        if self.tree is not None:
+            self._g_mass.set(self.tree.total)
         return items, idx, weights
 
     def state_dict(self) -> Dict[str, np.ndarray]:
@@ -458,6 +495,7 @@ class PrioritizedHostReplay:
             if idx.size == 0:
                 return
         self._max_priority = max(self._max_priority, float(p.max()))
+        self._g_max_prio.set(self._max_priority)
         mass = p ** self.alpha
         if self.device_sampler is not None:
             self.device_sampler.set(idx, mass)
@@ -474,6 +512,11 @@ class UniformHostReplay:
         self._pos = 0
         self._size = 0
         self._rng = np.random.default_rng(seed)
+        # Distinct store label: a process holding both a PER shard and a
+        # uniform buffer must not have them clobber one gauge series.
+        self._g_size, self._g_cap, self._g_occ = \
+            tm.replay_gauges("host_uniform")
+        self._g_cap.set(capacity)
 
     def __len__(self) -> int:
         return self._size
@@ -490,6 +533,8 @@ class UniformHostReplay:
             self._data[k][idx] = v
         self._pos = int((self._pos + batch) % self.capacity)
         self._size = int(min(self._size + batch, self.capacity))
+        self._g_size.set(self._size)
+        self._g_occ.set(self._size / self.capacity)
 
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         idx = self._rng.integers(0, self._size, size=batch_size)

@@ -19,6 +19,8 @@ from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from dist_dqn_tpu.telemetry import collectors as tm, get_registry
+
 
 class HostBatch(NamedTuple):
     obs: np.ndarray
@@ -71,6 +73,18 @@ class HostTimeRing:
         self.truncated = np.zeros((num_slots, num_envs), bool)
         self.pos = 0
         self.size = 0
+        # Telemetry (ISSUE 1): the host-DRAM window's occupancy and
+        # add/sample volume, labeled apart from the PER host shard.
+        reg = get_registry()
+        self._g_size, self._g_cap, self._g_occ = tm.replay_gauges(
+            "host_ring", reg)
+        self._g_cap.set(self.num_slots * self.num_envs)
+        self._c_added = reg.counter(tm.REPLAY_ADDED,
+                                    "transitions written to the host ring",
+                                    labels={"store": "host_ring"})
+        self._c_sampled = reg.counter(tm.REPLAY_SAMPLED,
+                                      "transitions drawn from the host "
+                                      "ring", labels={"store": "host_ring"})
 
     @property
     def nbytes(self) -> int:
@@ -91,6 +105,9 @@ class HostTimeRing:
         self.truncated[idx] = truncated
         self.pos = int((self.pos + C) % self.num_slots)
         self.size = int(min(self.size + C, self.num_slots))
+        self._c_added.inc(C * self.num_envs)
+        self._g_size.set(self.size * self.num_envs)
+        self._g_occ.set(self.size / self.num_slots)
 
     # -- sampling -----------------------------------------------------------
     def _extra(self) -> int:
@@ -148,5 +165,6 @@ class HostTimeRing:
         u = rng.integers(0, num_valid, batch_size)
         t_idx = (self.pos - self.size + self._extra() + u) % self.num_slots
         b_idx = rng.integers(0, self.num_envs, batch_size)
+        self._c_sampled.inc(batch_size)
         return self.gather(t_idx.astype(np.int32), b_idx.astype(np.int32),
                            n_step, gamma)

@@ -1,0 +1,30 @@
+"""Unified telemetry: registry, Prometheus exposition, flush lifecycle.
+
+Quick start (what every layer does)::
+
+    from dist_dqn_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    steps = reg.counter("dqn_env_steps_total", "env steps processed")
+    depth = reg.gauge("dqn_transport_tcp_backlog", "records queued")
+    lat = reg.histogram("dqn_grad_step_latency_seconds",
+                        "dispatch->materialize latency")
+
+Serve ``/metrics`` with ``telemetry.start_server(port)``; dump a JSON
+snapshot at exit with ``telemetry.install_snapshot_dump(path)``. The
+package is stdlib-only (importable from jax-free actor processes) and
+hands out Null-object twins via ``NullRegistry`` for zero-overhead
+disabled paths. Naming scheme + the dashboards each gauge feeds:
+docs/observability.md.
+"""
+from dist_dqn_tpu.telemetry.exposition import (CONTENT_TYPE,  # noqa: F401
+                                               render_prometheus, snapshot,
+                                               write_snapshot)
+from dist_dqn_tpu.telemetry.lifecycle import (  # noqa: F401
+    install_snapshot_dump, maybe_install_snapshot_from_env, on_exit)
+from dist_dqn_tpu.telemetry.registry import (DEFAULT_BUCKETS,  # noqa: F401
+                                             Counter, Gauge, Histogram,
+                                             NullRegistry, Registry,
+                                             get_registry)
+from dist_dqn_tpu.telemetry.server import (TelemetryServer,  # noqa: F401
+                                           start_server)

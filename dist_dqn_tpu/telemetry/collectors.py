@@ -1,0 +1,76 @@
+"""Cross-layer collector helpers: shared metric names + wiring glue.
+
+The per-layer collectors live next to the code they observe (replay/
+host.py owns its occupancy gauges, transport.py its queue counters); what
+lives HERE is the glue that must be shared so names cannot drift between
+layers, plus helpers for state the owning module cannot observe itself —
+the jit-resident device rings, whose occupancy only exists host-side
+between chunks.
+
+No jax import: device scalars are read via ``int(...)`` duck-typing
+(works on jax Arrays and numpy alike), keeping the telemetry package
+importable from jax-free actor processes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from dist_dqn_tpu.telemetry.registry import Registry, get_registry
+
+# Canonical family names (docs/observability.md). Every layer records
+# through these constants so a rename is one edit, not a grep.
+REPLAY_SIZE = "dqn_replay_size"
+REPLAY_CAPACITY = "dqn_replay_capacity"
+REPLAY_OCCUPANCY = "dqn_replay_occupancy_ratio"
+REPLAY_ADDED = "dqn_replay_added_total"
+REPLAY_SAMPLED = "dqn_replay_sampled_total"
+REPLAY_EVICTED = "dqn_replay_evicted_total"
+REPLAY_MAX_PRIORITY = "dqn_replay_max_priority"
+REPLAY_PRIORITY_MASS = "dqn_replay_priority_mass"
+
+ENV_STEPS = "dqn_env_steps_total"
+ENV_RATE = "dqn_env_steps_per_sec"
+GRAD_STEPS = "dqn_grad_steps_total"
+GRAD_LATENCY = "dqn_grad_step_latency_seconds"
+PARAM_STALENESS = "dqn_param_broadcast_staleness_seconds"
+
+
+def replay_gauges(store: str, registry: Optional[Registry] = None):
+    """(size, capacity, ratio) gauges for one replay store. ``store``
+    labels which buffer implementation is reporting (host / host_ring /
+    device) — several can coexist in one process."""
+    reg = registry if registry is not None else get_registry()
+    labels = {"store": store}
+    return (reg.gauge(REPLAY_SIZE, "replay items currently held", labels),
+            reg.gauge(REPLAY_CAPACITY, "replay item capacity", labels),
+            reg.gauge(REPLAY_OCCUPANCY, "replay fill fraction [0, 1]",
+                      labels))
+
+
+def observe_device_ring(replay_state,
+                        registry: Optional[Registry] = None
+                        ) -> Tuple[int, int]:
+    """Record occupancy of a jit-resident device ring between chunks.
+
+    Accepts any of the device replay states (TimeRingState, or the
+    prioritized/sequence wrappers that carry one as ``.ring``) — the ring
+    itself cannot emit from inside the compiled chunk, so host loops call
+    this at their chunk boundary. Returns (filled_slots, total_slots).
+    Reading ``size`` materializes one scalar — negligible next to the
+    chunk metrics fetch every caller already performs.
+    """
+    ring = getattr(replay_state, "ring", replay_state)
+    slots, lanes = (int(ring.action.shape[0]), int(ring.action.shape[1]))
+    size = int(ring.size)
+    g_size, g_cap, g_ratio = replay_gauges("device", registry)
+    g_size.set(size * lanes)
+    g_cap.set(slots * lanes)
+    g_ratio.set(size / slots if slots else 0.0)
+    # Prioritized/sequence device rings also carry their priority-seed
+    # scalar — the device twin of the host shard's max-priority gauge.
+    max_prio = getattr(replay_state, "max_priority", None)
+    if max_prio is not None:
+        reg = registry if registry is not None else get_registry()
+        reg.gauge(REPLAY_MAX_PRIORITY, "running max |TD| priority",
+                  {"store": "device"}).set(float(max_prio))
+    return size, slots

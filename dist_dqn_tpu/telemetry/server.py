@@ -1,0 +1,78 @@
+"""Prometheus scrape endpoint: a stdlib http.server on a daemon thread.
+
+``TelemetryServer(port=0)`` binds an ephemeral port (the bound port is on
+``.port``) and serves
+
+  * ``/metrics``      — Prometheus text exposition (scrape this)
+  * ``/metrics.json`` — the JSON snapshot (same data, offline tooling)
+  * ``/healthz``      — liveness probe (always ``ok``)
+
+The handler renders under the registry's own locks, so a scrape never
+blocks the training hot path for more than an instrument read. Loopback
+by default — the metric surface is unauthenticated, same posture as the
+TCP record listener (actors/service.py).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dist_dqn_tpu.telemetry.exposition import (CONTENT_TYPE,
+                                               render_prometheus, snapshot)
+from dist_dqn_tpu.telemetry.registry import Registry, get_registry
+
+
+class TelemetryServer:
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[Registry] = None):
+        registry = registry if registry is not None else get_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = render_prometheus(registry).encode()
+                    ctype = CONTENT_TYPE
+                elif path == "/metrics.json":
+                    body = (json.dumps(snapshot(registry), sort_keys=True)
+                            + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes must not spam the JSON-line log stream
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="telemetry-http", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+def start_server(port: int, host: str = "127.0.0.1",
+                 registry: Optional[Registry] = None) -> TelemetryServer:
+    """Convenience: build + start (port 0 = ephemeral, see ``.port``)."""
+    return TelemetryServer(port=port, host=host, registry=registry)

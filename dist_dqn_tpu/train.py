@@ -45,7 +45,7 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
           checkpoint_dir: str = None, save_every_frames: int = 0,
           profile_dir: str = None, num_devices: int = 1, stop_fn=None,
-          checkpoint_replay: bool = False):
+          checkpoint_replay: bool = False, telemetry_port: int = None):
     """Run training; returns (final_carry, history list of metric dicts).
 
     With ``checkpoint_replay`` the checkpoint holds the WHOLE fused
@@ -77,6 +77,38 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     if multiprocess:
         from dist_dqn_tpu.parallel.distributed import main_process_log
         log_fn = main_process_log(log_fn)
+    # Telemetry (ISSUE 1): registry instruments for the fused loop, plus
+    # the optional /metrics scrape endpoint (--telemetry-port; 0 binds an
+    # ephemeral port, reported as a telemetry_port log line). Instruments
+    # exist from the first scrape even before the first chunk lands.
+    from dist_dqn_tpu import telemetry
+    from dist_dqn_tpu.telemetry import collectors as tmc
+    _reg = telemetry.get_registry()
+    _tm = {
+        "env_steps": _reg.counter(tmc.ENV_STEPS, "env frames processed"),
+        "env_rate": _reg.gauge(tmc.ENV_RATE, "env-steps/sec (last chunk)"),
+        "grad_steps": _reg.counter(tmc.GRAD_STEPS,
+                                   "learner grad steps taken"),
+        "grad_latency": _reg.histogram(
+            tmc.GRAD_LATENCY,
+            "per-grad-step share of the fused chunk wall"),
+        "staleness": _reg.histogram(
+            tmc.PARAM_STALENESS,
+            "age of the host-visible params at each chunk boundary "
+            "(the fused loop refreshes them once per chunk)"),
+        "chunk": _reg.histogram("dqn_chunk_seconds",
+                                "fused chunk wall time"),
+        "loss": _reg.gauge("dqn_loss", "chunk-mean TD loss"),
+        "episodes": _reg.counter("dqn_episodes_completed_total",
+                                 "training episodes finished"),
+        "ep_return": _reg.gauge("dqn_episode_return",
+                                "chunk-mean finished-episode return"),
+    }
+    telemetry_server = None
+    if telemetry_port is not None and (not multiprocess
+                                       or jax.process_index() == 0):
+        telemetry_server = telemetry.start_server(telemetry_port)
+        log_fn(json.dumps({"telemetry_port": telemetry_server.port}))
     seed = cfg.seed if seed is None else seed
     total = total_env_steps or cfg.total_env_steps
     env = make_jax_env(cfg.env_name)
@@ -192,7 +224,29 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             jax.profiler.stop_trace()
             log_fn(json.dumps({"profile_trace": profile_dir}))
         chunk_index += 1
+        prev_frames = frames
         frames = frame_offset + int(metrics["env_frames"])
+        grad_steps_chunk = float(metrics["grad_steps_in_chunk"])
+        frames_delta = max(frames - prev_frames, 0)
+        _tm["env_steps"].inc(frames_delta)
+        # Global frames over wall time — under a mesh the chunk covers
+        # num_shards * chunk_iters * B frames, so chunk_iters * B / dt
+        # (the per-process log row) would under-report by the shard count.
+        _tm["env_rate"].set(frames_delta / dt)
+        _tm["grad_steps"].inc(grad_steps_chunk)
+        _tm["chunk"].observe(dt)
+        # Host-visible params refresh once per chunk boundary, so the
+        # chunk wall bounds their staleness; grad-step latency is the
+        # per-step share of the fused chunk (the steps run inside one
+        # XLA program — there is no finer host-observable boundary).
+        _tm["staleness"].observe(dt)
+        if grad_steps_chunk:
+            _tm["grad_latency"].observe(dt / grad_steps_chunk)
+        _tm["loss"].set(float(metrics["loss"]))
+        _tm["episodes"].inc(max(float(metrics["episodes"]), 0.0))
+        if float(metrics["episodes"]):
+            _tm["ep_return"].set(float(metrics["episode_return"]))
+        tmc.observe_device_ring(carry.replay)
         row = {
             "env_frames": frames,
             "episode_return": float(metrics["episode_return"]),
@@ -226,6 +280,8 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     if ckpt is not None:
         ckpt.save(frames, carry if checkpoint_replay else carry.learner)
         ckpt.close()
+    if telemetry_server is not None:
+        telemetry_server.close()
     return carry, history
 
 
@@ -269,6 +325,17 @@ def main():
                         help="apex runtime: write a Chrome trace-event "
                              "file of the host loop (ingest/sample/train "
                              "spans; open in Perfetto) to this path")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        help="serve the process telemetry registry's "
+                             "/metrics endpoint (Prometheus text format) "
+                             "on this port; 0 binds an ephemeral port "
+                             "(reported as a telemetry_port log line). "
+                             "Works on both runtimes; see "
+                             "docs/observability.md")
+    parser.add_argument("--telemetry-snapshot", default=None,
+                        help="dump a JSON snapshot of the telemetry "
+                             "registry to this path at exit (offline "
+                             "runs; same data as /metrics.json)")
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
@@ -333,6 +400,9 @@ def main():
     # grant (the round-1 tunnel wedge, utils/device_cleanup.py).
     from dist_dqn_tpu.utils.device_cleanup import install as _install_cleanup
     _install_cleanup()
+    if args.telemetry_snapshot:
+        from dist_dqn_tpu.telemetry import install_snapshot_dump
+        install_snapshot_dump(args.telemetry_snapshot)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     if args.coordinator:
@@ -385,6 +455,12 @@ def main():
             cfg = _dc.replace(cfg, seed=args.seed)
         from dist_dqn_tpu.host_replay_loop import run_host_replay
 
+        if args.telemetry_port is not None:
+            # The host ring and chunk loops record into the process
+            # registry regardless; this just exposes the scrape surface.
+            from dist_dqn_tpu import telemetry as _telemetry
+            _srv = _telemetry.start_server(args.telemetry_port)
+            print(json.dumps({"telemetry_port": _srv.port}))
         out = run_host_replay(
             cfg, total_env_steps=args.total_env_steps or cfg.total_env_steps,
             chunk_iters=args.chunk_iters, log_fn=print)
@@ -428,7 +504,8 @@ def main():
             spawn_remote_actors=args.remote_actor_mode == "local",
             learner_devices=args.learner_devices,
             trace_path=args.trace_path,
-            device_sampling=args.device_sampling)
+            device_sampling=args.device_sampling,
+            telemetry_port=args.telemetry_port)
         print(json.dumps(run_apex(cfg, rt)))
         return
     stop_fn = None
@@ -483,7 +560,8 @@ def main():
           chunk_iters=args.chunk_iters, checkpoint_dir=args.checkpoint_dir,
           save_every_frames=args.save_every_frames,
           profile_dir=args.profile_dir, num_devices=args.mesh_devices,
-          stop_fn=stop_fn, checkpoint_replay=args.checkpoint_replay)
+          stop_fn=stop_fn, checkpoint_replay=args.checkpoint_replay,
+          telemetry_port=args.telemetry_port)
 
 
 if __name__ == "__main__":

@@ -12,6 +12,15 @@ the xprof device timeline.
 
 A ``NullTracer`` with the same surface is the disabled path — call sites
 never branch.
+
+Registry integration (ISSUE 1): a live ``SpanTracer`` mirrors its events
+into the process telemetry registry — span durations feed the
+``dqn_host_span_seconds`` histogram family (one labeled series per span
+name), trace counters the ``dqn_trace_counter`` gauge family — so the
+Chrome trace and the /metrics endpoint tell one consistent story. Flush
+is registered on the shared exit lifecycle (telemetry/lifecycle.py):
+traces from atexit'd or SIGTERM'd processes keep every flushed-plus-
+buffered event instead of silently losing the tail.
 """
 from __future__ import annotations
 
@@ -20,7 +29,15 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from dist_dqn_tpu import telemetry
+from dist_dqn_tpu.telemetry import lifecycle
+
+#: Span-duration histogram buckets: host-loop spans run ~10µs (ring pop)
+#: to whole seconds (first jit compile under a span, checkpoint writes).
+SPAN_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0)
 
 
 class NullTracer:
@@ -56,18 +73,38 @@ class SpanTracer(NullTracer):
 
     enabled = True
 
-    def __init__(self, path: str, process_name: str = "dist_dqn_tpu"):
+    def __init__(self, path: str, process_name: str = "dist_dqn_tpu",
+                 registry=None):
         self.path = path
         self.process_name = process_name
         self._events: List[Tuple] = []
-        self._lock = threading.Lock()
+        # Reentrant: the SIGTERM exit flush runs on the main thread and
+        # can land while an interrupted frame holds this lock mid-append
+        # (telemetry/lifecycle.py) — a plain Lock would deadlock there.
+        self._lock = threading.RLock()
         self._pid = os.getpid()
         self._t0 = time.perf_counter_ns()
         self._started = False
         self._closed = False
+        self.registry = (registry if registry is not None
+                         else telemetry.get_registry())
+        self._span_hists: Dict[str, object] = {}
+        self._counter_gauges: Dict[str, object] = {}
+        # Shared flush lifecycle: a SIGTERM'd/atexit'd process keeps its
+        # buffered events (the format tolerates a missing terminator).
+        lifecycle.on_exit(self.flush)
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def _span_hist(self, name: str):
+        h = self._span_hists.get(name)
+        if h is None:
+            h = self.registry.histogram(
+                "dqn_host_span_seconds", "host-loop span durations",
+                labels={"span": name}, buckets=SPAN_BUCKETS)
+            self._span_hists[name] = h
+        return h
 
     @contextmanager
     def span(self, name: str, **args):
@@ -80,6 +117,7 @@ class SpanTracer(NullTracer):
                 self._events.append(
                     ("X", name, start, end - start,
                      threading.get_ident(), args or None))
+            self._span_hist(name).observe((end - start) / 1e6)
 
     def instant(self, name: str, **args) -> None:
         with self._lock:
@@ -90,6 +128,13 @@ class SpanTracer(NullTracer):
         with self._lock:
             self._events.append(("C", name, self._now_us(), float(value),
                                  threading.get_ident(), None))
+        g = self._counter_gauges.get(name)
+        if g is None:
+            g = self.registry.gauge("dqn_trace_counter",
+                                    "trace counter-track values",
+                                    labels={"counter": name})
+            self._counter_gauges[name] = g
+        g.set(value)
 
     def flush(self) -> None:
         """Append buffered events to ``path`` and clear the buffer.
@@ -133,6 +178,10 @@ class SpanTracer(NullTracer):
 
     def close(self) -> None:
         self.flush()
+        # A closed tracer no longer needs the exit-flush hook; dropping
+        # it releases this tracer for GC in long-lived processes that
+        # construct many tracers (sweeps, test suites).
+        lifecycle.off_exit(self.flush)
         with self._lock:
             if self._closed or not self._started:
                 self._closed = True

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Lint: no NEW JSON-line metric emission bypassing the telemetry registry.
+
+ISSUE 1 unified metrics behind ``dist_dqn_tpu/telemetry`` — new code
+should record through the registry (and let MetricLogger / the /metrics
+endpoint do the emitting), not grow more ad-hoc ``print(json.dumps(...))``
+/ ``log_fn(json.dumps(...))`` call sites that scrapers can't see.
+
+The legacy sites that existed when the registry landed are grandfathered
+in the allowlist below (several are load-bearing CLI output contracts —
+bench.py's single contract line, train.py's log rows). The lint fails
+when a file GROWS new call sites or a new file starts emitting directly;
+shrinking is always allowed (update the allowlist in the same PR).
+
+Run from the repo root: ``python scripts/check_metrics.py``. Wired into
+tier-1 via tests/test_metrics_lint.py.
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+PATTERN = re.compile(r"(?:print|log_fn)\(json\.dumps")
+
+#: file (repo-relative, posix) -> call sites grandfathered at ISSUE 1.
+ALLOWLIST = {
+    "bench.py": 1,
+    "benchmarks/ale_learning.py": 2,
+    "benchmarks/apex_feeder_bench.py": 2,
+    "benchmarks/apex_split_bench.py": 2,
+    "benchmarks/bench_sweep.py": 4,
+    "benchmarks/cli_e2e.py": 3,
+    "benchmarks/host_replay_bench.py": 1,
+    "benchmarks/learner_bench.py": 3,
+    "benchmarks/pong_learning.py": 4,
+    "benchmarks/r2d2_pixel_learning.py": 1,
+    "benchmarks/roofline_inscan.py": 1,
+    "benchmarks/sampler_bench.py": 2,
+    "benchmarks/tpu_battery.py": 5,
+    "dist_dqn_tpu/actors/remote.py": 1,
+    "dist_dqn_tpu/actors/service.py": 3,
+    "dist_dqn_tpu/atari57.py": 7,
+    "dist_dqn_tpu/evaluate.py": 1,
+    "dist_dqn_tpu/host_replay_loop.py": 1,
+    "dist_dqn_tpu/train.py": 10,
+    "dist_dqn_tpu/utils/metrics.py": 1,  # MetricLogger.flush itself
+}
+
+SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py", "__graft_entry__.py")
+
+
+def scan(repo_root: Path):
+    counts = {}
+    for root in SCAN_ROOTS:
+        path = repo_root / root
+        files = ([path] if path.is_file()
+                 else sorted(path.rglob("*.py")) if path.is_dir() else [])
+        for f in files:
+            rel = f.relative_to(repo_root).as_posix()
+            if rel.startswith("dist_dqn_tpu/telemetry/"):
+                continue  # the registry itself is the sanctioned emitter
+            n = len(PATTERN.findall(f.read_text()))
+            if n:
+                counts[rel] = n
+    return counts
+
+
+def main() -> int:
+    repo_root = Path(__file__).resolve().parent.parent
+    counts = scan(repo_root)
+    failures = []
+    for rel, n in sorted(counts.items()):
+        allowed = ALLOWLIST.get(rel, 0)
+        if n > allowed:
+            failures.append(
+                f"{rel}: {n} direct JSON-metric emission call sites "
+                f"(allowlist: {allowed}). New metrics must go through "
+                f"dist_dqn_tpu/telemetry (registry counters/gauges/"
+                f"histograms); see docs/observability.md.")
+    if failures:
+        print("check_metrics: FAIL", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print(f"check_metrics: OK ({sum(counts.values())} grandfathered "
+          f"call sites in {len(counts)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Tier-1 wiring for the metric-emission lint (scripts/check_metrics.py):
+new code must record through the telemetry registry, not grow ad-hoc
+``print(json.dumps(...))`` metric call sites."""
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_no_new_direct_metric_emission():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_metrics.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+
+
+def test_lint_catches_a_new_call_site(tmp_path):
+    """The lint must actually bite: a synthetic tree with an unlisted
+    emission site fails."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics", REPO / "scripts" / "check_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    pkg = tmp_path / "dist_dqn_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text("print(json.dumps({'m': 1}))\n")
+    counts = mod.scan(tmp_path)
+    assert counts == {"dist_dqn_tpu/rogue.py": 1}
+    assert counts["dist_dqn_tpu/rogue.py"] > mod.ALLOWLIST.get(
+        "dist_dqn_tpu/rogue.py", 0)

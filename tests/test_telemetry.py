@@ -1,0 +1,264 @@
+"""Telemetry subsystem (telemetry/): primitive semantics, Prometheus
+exposition validity, the /metrics endpoint, the exit-flush lifecycle,
+RateTracker decay, and the train-path smoke emitting the core metric set.
+"""
+import json
+import re
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from dist_dqn_tpu import telemetry
+from dist_dqn_tpu.telemetry.registry import NULL_INSTRUMENT
+
+
+# -- primitives -------------------------------------------------------------
+
+def test_counter_is_monotonic():
+    reg = telemetry.Registry()
+    c = reg.counter("dqn_x_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_moves_both_ways():
+    reg = telemetry.Registry()
+    g = reg.gauge("dqn_g")
+    g.set(4.0)
+    g.inc()
+    g.dec(2.0)
+    assert g.value == 3.0
+
+
+def test_histogram_cumulative_buckets():
+    reg = telemetry.Registry()
+    h = reg.histogram("dqn_h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(56.05)
+    buckets = dict(h.cumulative_buckets())
+    assert buckets[0.1] == 1
+    assert buckets[1.0] == 3      # cumulative: includes the 0.1 bucket
+    assert buckets[10.0] == 4
+    assert buckets[float("inf")] == 5
+    # Boundary: an observation AT an upper bound counts in that bucket
+    # (Prometheus le semantics).
+    h.observe(0.1)
+    assert dict(h.cumulative_buckets())[0.1] == 2
+
+
+def test_histogram_rejects_unsorted_buckets():
+    reg = telemetry.Registry()
+    with pytest.raises(ValueError):
+        reg.histogram("dqn_bad_seconds", buckets=(1.0, 0.1))
+
+
+def test_registry_get_or_create_identity_and_type_conflict():
+    reg = telemetry.Registry()
+    a = reg.counter("dqn_same_total")
+    b = reg.counter("dqn_same_total")
+    assert a is b
+    # Same name, different labels -> distinct series of one family.
+    c = reg.counter("dqn_same_total", labels={"actor": "1"})
+    assert c is not a
+    with pytest.raises(ValueError):
+        reg.gauge("dqn_same_total")
+
+
+def test_null_registry_is_inert():
+    reg = telemetry.NullRegistry()
+    c = reg.counter("x")
+    g = reg.gauge("y")
+    h = reg.histogram("z")
+    assert c is NULL_INSTRUMENT and g is NULL_INSTRUMENT
+    c.inc()
+    g.set(3)
+    h.observe(1.0)
+    assert reg.snapshot() == {}
+    assert telemetry.render_prometheus(reg) == "\n"
+
+
+# -- exposition -------------------------------------------------------------
+
+# Strict Prometheus text-format line shapes (format 0.0.4): comments,
+# and samples with optional labels and a float/Inf/NaN value.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")*\})?'
+    r' [-+]?(\d+(\.\d+)?([eE][-+]?\d+)?|\+?Inf|NaN)$')
+_COMMENT_RE = re.compile(
+    r'^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$')
+
+
+def _assert_valid_exposition(body: str):
+    assert body.endswith("\n")
+    for line in body.strip().splitlines():
+        assert _COMMENT_RE.match(line) or _SAMPLE_RE.match(line), \
+            f"invalid exposition line: {line!r}"
+
+
+def test_prometheus_exposition_format():
+    reg = telemetry.Registry()
+    reg.counter("dqn_a_total", "things counted").inc(3)
+    reg.gauge("dqn_b", "a gauge", labels={"store": "host"}).set(0.5)
+    h = reg.histogram("dqn_c_seconds", "latency", buckets=(0.01, 0.1))
+    h.observe(0.05)
+    body = telemetry.render_prometheus(reg)
+    _assert_valid_exposition(body)
+    assert "# TYPE dqn_a_total counter" in body
+    assert 'dqn_b{store="host"} 0.5' in body
+    assert 'dqn_c_seconds_bucket{le="0.01"} 0' in body
+    assert 'dqn_c_seconds_bucket{le="+Inf"} 1' in body
+    assert "dqn_c_seconds_count 1" in body
+    # Snapshot carries the same data, JSON-able.
+    snap = json.loads(json.dumps(telemetry.snapshot(reg)))
+    assert snap["dqn_a_total"]["value"] == 3
+
+
+def test_metrics_endpoint_serves_and_parses():
+    reg = telemetry.Registry()
+    reg.gauge("dqn_live").set(1)
+    server = telemetry.start_server(0, registry=reg)
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(url + "/metrics").read().decode()
+        _assert_valid_exposition(body)
+        assert "dqn_live 1" in body
+        snap = json.loads(
+            urllib.request.urlopen(url + "/metrics.json").read())
+        assert snap["dqn_live"]["value"] == 1
+        assert urllib.request.urlopen(url + "/healthz").read() == b"ok\n"
+    finally:
+        server.close()
+
+
+# -- RateTracker decay (ISSUE 1 satellite) ----------------------------------
+
+def test_rate_tracker_decays_to_zero_when_updates_stop():
+    from dist_dqn_tpu.utils.metrics import RateTracker
+    rt = RateTracker(window_s=30.0)
+    rt.update(0, now=0.0)
+    rt.update(300, now=10.0)
+    assert rt.rate(now=10.0) == pytest.approx(30.0)
+    assert rt.rate(now=39.0) == pytest.approx(30.0)  # window still live
+    # Updates stopped: past the window the honest rate is 0, not the
+    # last computed value held forever.
+    assert rt.rate(now=40.0) == 0.0
+    assert rt.rate(now=1e9) == 0.0
+    # And a new event revives it.
+    rt.update(330, now=41.0)
+    assert rt.rate(now=41.0) > 0.0
+
+
+def test_metric_logger_mirrors_into_registry():
+    from dist_dqn_tpu.utils.metrics import MetricLogger
+    reg = telemetry.Registry()
+    ml = MetricLogger(log_fn=lambda s: None, registry=reg)
+    ml.record(env_steps=0, grad_steps=0)
+    ml.record(env_steps=1000, grad_steps=10, eval_return=42.0)
+    ml.flush()
+    snap = reg.snapshot()
+    assert snap["dqn_env_steps_per_sec"]["value"] > 0
+    assert snap["dqn_eval_return"]["value"] == 42.0
+
+
+# -- exit-flush lifecycle (ISSUE 1 satellite) -------------------------------
+
+def test_span_tracer_flushes_at_exit_without_close(tmp_path):
+    """A process that never calls close()/flush() still gets its trace
+    (atexit leg of the shared lifecycle); same for the registry snapshot
+    dump via DQN_TELEMETRY_SNAPSHOT."""
+    trace = tmp_path / "t.json"
+    snap = tmp_path / "snap.json"
+    code = (
+        "import os\n"
+        "os.environ['DQN_TELEMETRY_SNAPSHOT'] = %r\n"
+        "from dist_dqn_tpu import telemetry\n"
+        "from dist_dqn_tpu.utils.trace import SpanTracer\n"
+        "telemetry.maybe_install_snapshot_from_env()\n"
+        "telemetry.get_registry().counter('dqn_exit_total').inc(7)\n"
+        "tr = SpanTracer(%r)\n"
+        "with tr.span('work'):\n"
+        "    pass\n"
+        "# no flush, no close: exit must do it\n" % (str(snap), str(trace)))
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=60)
+    # Unterminated trace array is spec-legal; recover like Perfetto does.
+    events = json.loads(trace.read_text() + "]")
+    assert any(e["name"] == "work" for e in events)
+    dumped = json.loads(snap.read_text())
+    assert dumped["dqn_exit_total"]["value"] == 7
+
+
+def test_sigterm_flushes_trace_and_snapshot(tmp_path):
+    """SIGTERM'd actor/learner processes must not silently lose their
+    telemetry (the pre-ISSUE-1 behavior)."""
+    import os
+    import signal
+    import time
+
+    trace = tmp_path / "t.json"
+    snap = tmp_path / "snap.json"
+    ready = tmp_path / "ready"
+    code = (
+        "import os, time\n"
+        "os.environ['DQN_TELEMETRY_SNAPSHOT'] = %r\n"
+        "from dist_dqn_tpu import telemetry\n"
+        "from dist_dqn_tpu.utils.trace import SpanTracer\n"
+        "telemetry.maybe_install_snapshot_from_env()\n"
+        "telemetry.get_registry().counter('dqn_exit_total').inc(3)\n"
+        "tr = SpanTracer(%r)\n"
+        "with tr.span('work'):\n"
+        "    pass\n"
+        "open(%r, 'w').write('1')\n"
+        "time.sleep(60)\n" % (str(snap), str(trace), str(ready)))
+    proc = subprocess.Popen([sys.executable, "-c", code])
+    try:
+        deadline = time.time() + 30
+        while not ready.exists():
+            assert time.time() < deadline, "child never became ready"
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    events = json.loads(trace.read_text() + "]")
+    assert any(e["name"] == "work" for e in events)
+    assert json.loads(snap.read_text())["dqn_exit_total"]["value"] == 3
+
+
+# -- train-path smoke --------------------------------------------------------
+
+def test_cartpole_train_emits_core_metric_set():
+    """The fused CartPole path populates the core set the acceptance
+    criteria name: replay occupancy, env-steps/sec, and the grad-step
+    latency histogram — in valid exposition format."""
+    import dataclasses
+
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.train import train
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=128),
+        eval_every_steps=0)
+    train(cfg, total_env_steps=2_000, chunk_iters=50,
+          log_fn=lambda s: None)
+    body = telemetry.render_prometheus()  # default (process) registry
+    _assert_valid_exposition(body)
+    for needle in ("dqn_replay_size", "dqn_replay_occupancy_ratio",
+                   "dqn_env_steps_per_sec", "dqn_env_steps_total",
+                   "dqn_grad_step_latency_seconds_bucket",
+                   "dqn_param_broadcast_staleness_seconds_bucket",
+                   "dqn_chunk_seconds_count"):
+        assert needle in body, f"core metric {needle} missing"
+    snap = telemetry.snapshot()
+    assert snap["dqn_env_steps_total"]["value"] >= 2_000
