@@ -90,7 +90,7 @@ def _configs(variant: str, smoke: bool):
     return cfg, rt_kwargs, probe_total
 
 
-def _run(cfg, rt_kwargs, total: int, trace_path=None):
+def _run(cfg, rt_kwargs, total: int, trace_path=None, **rt_extra):
     """One service run; returns (summary, wall_s, steady_rates)."""
     from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
 
@@ -103,7 +103,7 @@ def _run(cfg, rt_kwargs, total: int, trace_path=None):
             pass
 
     rt = ApexRuntimeConfig(total_env_steps=total, log_every_s=5.0,
-                           trace_path=trace_path, **rt_kwargs)
+                           trace_path=trace_path, **rt_extra, **rt_kwargs)
     t0 = time.perf_counter()
     summary = run_apex(cfg, rt, log_fn=capture)
     wall = time.perf_counter() - t0
@@ -111,6 +111,23 @@ def _run(cfg, rt_kwargs, total: int, trace_path=None):
                  if r.get("env_steps_per_sec_per_chip", 0) > 0]
     steady = rate_rows[-1] if rate_rows else {}
     return summary, wall, steady
+
+
+def _roundtrip_fields(summary) -> dict:
+    """Device round-trip accounting (ISSUE 2): the service counts every
+    dispatched program by kind; per-ingest-pass ratios are the number a
+    remote-tunnel deployment plans around (~70 ms per round-trip)."""
+    return {
+        "device_calls": summary["device_calls"],
+        "ingest_passes": summary["ingest_passes"],
+        "ingest_device_calls_per_pass":
+            summary["ingest_device_calls_per_pass"],
+    }
+
+
+def _emit(row: dict) -> None:
+    """Single bench-contract emission point (scripts/check_metrics.py)."""
+    print(json.dumps(row), flush=True)
 
 
 def main() -> int:
@@ -125,7 +142,11 @@ def main() -> int:
                         "Chrome trace (utils/trace.py): writes "
                         "<prefix>.<variant>.json per variant — "
                         "attributes the per-pass cost: ingest vs act vs "
-                        "train dispatch vs priority write-back")
+                        "train dispatch vs priority write-back. Also "
+                        "runs a probe-sized SPLIT-DISPATCH (fused_ingest "
+                        "=False) reference and emits a trace_ab row with "
+                        "device round-trips per ingest pass, fused vs "
+                        "split — the ISSUE 2 before/after")
     args = p.parse_args()
 
     if args.allow_cpu:
@@ -148,12 +169,14 @@ def main() -> int:
         # saturated ingest rate on this host.
         summary, wall, steady = _run(cfg, rt_kwargs, probe_total)
         probe_rate = summary["env_steps"] / max(wall, 1e-9)
-        print(json.dumps({"bench": "apex_feeder", "variant": variant,
-                          "phase": "probe", "wall_s": round(wall, 1),
-                          "avg_env_steps_per_sec": round(probe_rate, 1),
-                          **{k: summary[k] for k in
-                             ("env_steps", "grad_steps", "ring_dropped",
-                              "bad_records")}}), flush=True)
+        probe_summary = summary
+        _emit({"bench": "apex_feeder", "variant": variant,
+               "phase": "probe", "wall_s": round(wall, 1),
+               "avg_env_steps_per_sec": round(probe_rate, 1),
+               **_roundtrip_fields(summary),
+               **{k: summary[k] for k in
+                  ("env_steps", "grad_steps", "ring_dropped",
+                   "bad_records")}})
 
         # Phase 2 — measure run sized FROM the probe rate (compiles
         # cached in-process): ~measure-seconds of steady state.
@@ -195,11 +218,38 @@ def main() -> int:
                     "lower bound on a dedicated-host service; no "
                     "emulator/preprocessing in the loop (see module "
                     "docstring)",
+            **_roundtrip_fields(summary),
             **{k: summary[k] for k in
                ("env_steps", "grad_steps", "replay_size", "ring_dropped",
                 "tcp_backpressure", "bad_records", "actor_restarts")},
         }
-        print(json.dumps(row), flush=True)
+        _emit(row)
+        if args.trace:
+            # Split-dispatch reference (probe-sized; compiles are sunk):
+            # the pre-ISSUE-2 ingest path exactly — split act/bootstrap
+            # dispatches, per-256 bootstrap chunks, per-step priority
+            # write-backs, serial H2D — vs the fast path's fused
+            # power-of-two-batched dispatches above.
+            ab_summary, ab_wall, _ = _run(
+                cfg, rt_kwargs, probe_total,
+                trace_path=(f"{args.trace}.{variant}.split.json"),
+                fused_ingest=False, prio_writeback_batch=1,
+                stage_depth=0)
+            # Compare at the SAME run size: the fused PROBE (phase 1,
+            # also probe_total) vs the split reference — identical work,
+            # so the per-pass ratio isolates the dispatch fusion.
+            fused_rt = probe_summary["ingest_device_calls_per_pass"]
+            split_rt = ab_summary["ingest_device_calls_per_pass"]
+            _emit({"bench": "apex_feeder", "variant": variant,
+                   "phase": "trace_ab", "total_env_steps": probe_total,
+                   "fused_ingest_device_calls_per_pass": fused_rt,
+                   "split_ingest_device_calls_per_pass": split_rt,
+                   "roundtrip_reduction":
+                       round(split_rt / max(fused_rt, 1e-9), 3),
+                   "split_device_calls": ab_summary["device_calls"],
+                   "fused_device_calls": probe_summary["device_calls"],
+                   "split_wall_s": round(ab_wall, 1),
+                   "split_env_steps": ab_summary["env_steps"]})
         # ring_dropped counts ring-FULL push rejections: for feeders that
         # is the normal backpressure signal (the payload is retried, not
         # lost — actors/feeder.py pump loop), so unlike the split bench

@@ -80,7 +80,10 @@ class FeederSpecEnv:
         nxt = self._obs()
         reward = float(self._rng.normal())
         terminated = bool(self._rng.random() < P_TERMINATED)
-        truncated = bool(self._rng.random() < P_TRUNCATED)
+        # Mutually exclusive flags, as every real env adapter produces
+        # (a terminated step is never also truncated — ADVICE r5).
+        truncated = (not terminated
+                     and bool(self._rng.random() < P_TRUNCATED))
         return nxt, reward, terminated, truncated, {}
 
 
@@ -98,13 +101,17 @@ def _build_pool(rng: np.random.Generator, actor_id: int, lanes: int,
                           {"kind": "hello", "actor": actor_id, "t": 0})
     steps = []
     for t in range(POOL_RECORDS):
+        terminated = rng.random((lanes,)) < P_TERMINATED
+        # Real actors never report both flags on one step (the env
+        # adapters resolve terminated first); the synthetic stream must
+        # honor the same contract or the assembler/bootstrap measure
+        # inputs no production run produces (ADVICE r5).
+        truncated = (rng.random((lanes,)) < P_TRUNCATED) & ~terminated
         steps.append(encode_arrays(
             {"obs": obs_batch(),
              "reward": rng.normal(size=(lanes,)).astype(np.float32),
-             "terminated": (rng.random((lanes,)) < P_TERMINATED
-                            ).astype(np.uint8),
-             "truncated": (rng.random((lanes,)) < P_TRUNCATED
-                           ).astype(np.uint8),
+             "terminated": terminated.astype(np.uint8),
+             "truncated": truncated.astype(np.uint8),
              "next_obs": obs_batch()},
             {"kind": "step", "actor": actor_id, "t": t + 1}))
     return hello, steps

@@ -9,11 +9,14 @@ One process owns the accelerator and runs four roles in one loop
   * assembler — folds per-lane step streams into n-step transitions
     (actors/assembler.py);
   * priority bootstrapper — computes initial |TD| for new transitions in
-    fixed-size padded chunks on the device (Ape-X inserts with real
-    priorities, not max-seeding);
-  * learner — samples the host PER shard, one jitted train step per
-    ``grad_batch_per_env_step`` inserted transitions, writes priorities
-    back.
+    power-of-two-bucketed batches on the device (Ape-X inserts with real
+    priorities, not max-seeding). On the ingest fast path (ISSUE 2,
+    docs/ingest_pipeline.md) the bootstrap rides the SAME dispatched
+    program as the batched act — one device round-trip per ingest pass;
+  * learner — samples the host PER shard (batch g+1 staged through the
+    double-buffered H2D path while step g trains), one jitted train step
+    per ``grad_batch_per_env_step`` inserted transitions, writes
+    priorities back in batched sum-tree updates.
 
 Throughput counters (env-steps/sec/chip, grad-steps/sec) are the
 north-star metrics (BASELINE.json:2) and are reported every flush.
@@ -40,6 +43,20 @@ from dist_dqn_tpu.telemetry import collectors as tmc, get_registry
 from dist_dqn_tpu.utils.metrics import MetricLogger
 
 _PRIO_CHUNK = 256
+# Ingest fast path (ISSUE 2): the fused/batched bootstrap dispatch takes
+# up to this many pending transitions in ONE device program, padded to
+# one of exactly TWO row buckets — _PRIO_CHUNK (the lockstep regime:
+# a few rows per pass) or _PRIO_MAX_ROWS (the saturated regime: a full
+# batch, zero padding). Two buckets, not the full power-of-two ladder,
+# because the FUSED program's compile variants are the cross-product
+# with the act-row buckets — 2 x O(log actors) stays cheap where
+# 4 x O(log actors) doubles the remote-tunnel warmup. The in-between
+# case (257..2047 pending) pads to the large bucket: ~8x bytes worst
+# case, ~11 ms on a TPU-VM host link — still far under the dispatch
+# constant it saves; the staging byte counters keep it visible. The
+# legacy split path (fused_ingest=False) keeps the per-256 loop: that
+# IS the measured baseline.
+_PRIO_MAX_ROWS = 2048
 
 
 @dataclasses.dataclass
@@ -130,6 +147,26 @@ class ApexRuntimeConfig:
     # device round-trip LATENCY (not compute) dominates, e.g. remote-
     # tunneled accelerators.
     pipeline_depth: int = 2
+    # Ingest fast path (ISSUE 2): fuse the batched-act and priority-
+    # bootstrap programs into ONE jitted dispatch per ingest pass
+    # (feed-forward configs; the R2D2 path has no device bootstrap).
+    # On remote-tunnel links each dispatch costs the ~70ms round-trip
+    # constant, so halving calls per pass raises the feeder ceiling
+    # directly. False restores the split dispatches (the A/B baseline
+    # benchmarks/apex_feeder_bench.py measures against).
+    fused_ingest: bool = True
+    # Batched priority write-backs: accumulate this many train steps'
+    # |TD| write-backs in a fixed-size pending buffer and apply them as
+    # ONE sum-tree update (vectorized propagation over all rows) instead
+    # of one per step. Priorities lag the learner by at most this many
+    # steps on top of pipeline_depth — the expected_gen guard still
+    # drops updates for overwritten slots. 1 = legacy per-step flush.
+    prio_writeback_batch: int = 8
+    # Double-buffered H2D staging (replay/staging.py): sample + upload
+    # batch g+1 into reusable pinned-host staging buffers while step g
+    # trains. Single-device learners only (the multi-host/multi-learner
+    # paths shard batches themselves); 0 = legacy serial sample->upload.
+    stage_depth: int = 2
     # Prometheus scrape endpoint (telemetry/server.py): serve the process
     # registry's /metrics on this port (0 = ephemeral, logged as
     # telemetry_port). None disables. Same surface as the fused
@@ -238,10 +275,12 @@ class ApexLearnerService:
             self._prev_carry: List = [None] * self.total_actors
             self._prev_q: List = [None] * self.total_actors
             self._prio_fn = None
+            self._fused = None
         else:
             init, train_step = make_learner(net, cfg.learner,
                                             axis_name=axis)
-            self._act = jax.jit(make_actor_step(net))
+            act_fn = make_actor_step(net)
+            self._act = jax.jit(act_fn)
             asm_cls = NStepAssembler
             if rt.native_assembly:
                 try:
@@ -275,6 +314,20 @@ class ApexLearnerService:
                 return jnp.abs(qa - (reward + discount * boot))
 
             self._prio_fn = jax.jit(prio_fn)
+
+            def fused_fn(params, target_params, obs, rng, eps,
+                         b_obs, b_action, b_reward, b_discount, b_next_obs):
+                # One dispatched program serves BOTH per-pass device jobs:
+                # the batched epsilon-greedy act for this burst's actors
+                # AND the |TD| priority bootstrap for one pending chunk.
+                # On a remote-tunneled device that halves the per-pass
+                # round-trip count — the ingest path's binding cost.
+                actions = act_fn(params, obs, rng, eps)
+                prios = prio_fn(params, target_params, b_obs, b_action,
+                                b_reward, b_discount, b_next_obs)
+                return actions, prios
+
+            self._fused = jax.jit(fused_fn) if rt.fused_ingest else None
         self.state = None
         self._init_learner = init
         self._mh = None
@@ -346,6 +399,24 @@ class ApexLearnerService:
         # Pipelined priority bootstraps: (device prios, items, count)
         # awaiting materialization+insert (see _flush_pending).
         self._boot_inflight: deque = deque()
+        # Batched priority write-backs (ISSUE 2): materialized train-step
+        # priorities pending the next batched sum-tree update, as
+        # (idx, priorities, gen) triples; bounded by prio_writeback_batch.
+        self._prio_pending: List = []
+        # Device round-trip accounting (ISSUE 2): every dispatched
+        # program increments its kind here; the feeder bench divides by
+        # ingest passes to report round-trips per pass.
+        self.device_calls: Dict[str, int] = {}
+        self.ingest_passes = 0
+        # H2D staging for the learner (replay/staging.py): single-device
+        # only — multi-host/multi-learner batches are sharded by their
+        # own wrappers from host numpy.
+        self._stager = None
+        if (rt.stage_depth > 0 and not self.distributed
+                and self.n_learners == 1):
+            from dist_dqn_tpu.replay.staging import DoubleBufferedStager
+            self._stager = DoubleBufferedStager(depth=rt.stage_depth,
+                                                name="apex_service")
         from dist_dqn_tpu.utils.trace import make_tracer
         self.tracer = make_tracer(rt.trace_path, process_name="apex-learner")
         self._init_telemetry()
@@ -394,6 +465,20 @@ class ApexLearnerService:
         self._tm_train_inflight = reg.gauge(
             "dqn_service_train_inflight",
             "pipelined train steps awaiting priority write-back")
+        # Ingest fast path (ISSUE 2): dispatch accounting. One counter
+        # series per dispatched-program kind, cached on first use.
+        self._tm_device_calls: Dict[str, object] = {}
+        self._tm_fanin = reg.histogram(
+            tmc.DISPATCH_FANIN,
+            "obs rows per batched act/fused dispatch",
+            buckets=tmc.FANIN_BUCKETS)
+        self._tm_ingest_passes = reg.counter(
+            tmc.INGEST_PASSES,
+            "drain bursts that ingested at least one actor record")
+        self._tm_prio_pending = reg.gauge(
+            tmc.PRIO_WRITEBACK_PENDING,
+            "train steps accumulated toward the next batched priority "
+            "write-back")
         self._tm_bad_records = reg.counter(
             "dqn_service_bad_records_total",
             "malformed/misrouted records rejected at the TCP boundary")
@@ -427,6 +512,23 @@ class ApexLearnerService:
                 labels={"actor": str(actor_id)})
             self._tm_actor_alive[actor_id] = g
         return g
+
+    def _count_device_call(self, kind: str,
+                           rows: Optional[int] = None) -> None:
+        """One dispatched device program of ``kind`` (act / fused /
+        bootstrap / train). ``rows`` feeds the fan-in histogram for the
+        act-path dispatches."""
+        self.device_calls[kind] = self.device_calls.get(kind, 0) + 1
+        c = self._tm_device_calls.get(kind)
+        if c is None:
+            c = get_registry().counter(
+                tmc.SERVICE_DEVICE_CALLS,
+                "device programs dispatched by the service loop",
+                labels={"call": kind})
+            self._tm_device_calls[kind] = c
+        c.inc()
+        if rows is not None:
+            self._tm_fanin.observe(float(rows))
 
     def _step_specs(self, axis: str):
         """(data_specs, metric_specs) PartitionSpecs for the train step:
@@ -654,7 +756,14 @@ class ApexLearnerService:
             eps[off:off + r] = self.actor_eps[actor]
             off += r
         self._rng, k = jax.random.split(self._rng)
-        with self.tracer.span("act.batched", actors=len(burst), rows=total):
+        # Fused fast path (ISSUE 2): when a bootstrap batch is pending,
+        # ride it along with this burst's act in ONE dispatched program
+        # instead of two back-to-back device calls.
+        boot = (self._pop_boot_batch()
+                if (self._fused is not None and not self.recurrent)
+                else None)
+        with self.tracer.span("act.batched", actors=len(burst), rows=total,
+                              fused_bootstrap=boot is not None):
             if self.recurrent:
                 cs, hs = [], []
                 for (actor, obs, _), r in zip(burst, rows):
@@ -676,9 +785,25 @@ class ApexLearnerService:
                 h_np = np.asarray(carry_new[1], np.float32)
                 qs_np = np.asarray(q_sel, np.float32)
                 qm_np = np.asarray(q_max, np.float32)
+                self._count_device_call("act", rows=total)
+            elif boot is not None:
+                b_batch, b_items, b_count = boot
+                actions, prios = self._fused(
+                    self._policy_params, self._target_policy_params,
+                    jnp.asarray(obs_cat), k, jnp.asarray(eps),
+                    jnp.asarray(b_batch["obs"]),
+                    jnp.asarray(b_batch["action"]),
+                    jnp.asarray(b_batch["reward"]),
+                    jnp.asarray(b_batch["discount"]),
+                    jnp.asarray(b_batch["next_obs"]))
+                # Same pipelined-insert path as the standalone bootstrap:
+                # the batch's priorities materialize on a later pass.
+                self._boot_inflight.append((prios, b_items, b_count))
+                self._count_device_call("fused_act_bootstrap", rows=total)
             else:
                 actions = self._act(self._policy_params, jnp.asarray(obs_cat),
                                     k, jnp.asarray(eps))
+                self._count_device_call("act", rows=total)
             acts_np = np.asarray(actions, np.int32)
         off = 0
         for (actor, obs, t), r in zip(burst, rows):
@@ -811,6 +936,43 @@ class ApexLearnerService:
                 self._pending_count += emitted["action"].shape[0]
         self._reply_actions(actor, arrays["obs"], t)
 
+    def _pop_boot_batch(self, force: bool = False):
+        """Take up to ``_PRIO_MAX_ROWS`` pending transitions for one
+        batched bootstrap dispatch -> (padded batch, true items, count),
+        or None below the ``_PRIO_CHUNK`` threshold (sub-chunk
+        remainders keep accumulating unless forced). The batch pads to
+        one of two row buckets (``_PRIO_CHUNK`` / ``_PRIO_MAX_ROWS`` —
+        see the constant's comment) by repeating the last row (its
+        priority is computed then discarded at insert)."""
+        if self._pending_count == 0:
+            return None
+        if not force and self._pending_count < _PRIO_CHUNK:
+            return None
+        # One concatenation per backlog: a stored single-dict remainder
+        # is reused as-is and sliced into VIEWS, so draining a B-row
+        # backlog copies O(B) bytes total, not O(B^2/_PRIO_MAX_ROWS).
+        if len(self._pending) == 1:
+            cat = self._pending[0]
+        else:
+            cat = {k: np.concatenate([p[k] for p in self._pending])
+                   for k in self._pending[0]}
+        n = cat["action"].shape[0]
+        take = min(n, _PRIO_MAX_ROWS)
+        if n > take:
+            self._pending = [{k: v[take:] for k, v in cat.items()}]
+            self._pending_count = n - take
+        else:
+            self._pending, self._pending_count = [], 0
+        items = {k: v[:take] for k, v in cat.items()}
+        padded = _PRIO_CHUNK if take <= _PRIO_CHUNK else _PRIO_MAX_ROWS
+        if padded != take:
+            pad = padded - take
+            batch = {k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                     for k, v in items.items()}
+        else:
+            batch = items
+        return batch, items, take
+
     def _flush_pending(self, force: bool = False):
         """Compute initial priorities on-device and insert into the shard.
 
@@ -828,14 +990,34 @@ class ApexLearnerService:
         self._drain_bootstraps(force)
         if self._pending_count == 0:
             return
-        if not force and self._pending_count < _PRIO_CHUNK:
-            return
-        cat = {k: np.concatenate([p[k] for p in self._pending])
-               for k in self._pending[0]}
-        self._pending, self._pending_count = [], 0
-        n = cat["action"].shape[0]
-        with self.tracer.span("priority.bootstrap.dispatch", count=n):
-            self._dispatch_bootstraps(cat, n)
+        if self.rt.fused_ingest and self._prio_fn is not None:
+            # Fast path: whatever the fused act dispatch did not take
+            # this pass goes out in power-of-two-bucketed batches of up
+            # to _PRIO_MAX_ROWS — one device call per ~8 legacy chunks.
+            while True:
+                popped = self._pop_boot_batch(force)
+                if popped is None:
+                    break
+                batch, items, count = popped
+                with self.tracer.span("priority.bootstrap.dispatch",
+                                      count=count,
+                                      rows=batch["action"].shape[0]):
+                    prios = self._prio_fn(
+                        self._policy_params, self._target_policy_params,
+                        *(self.jnp.asarray(batch[k])
+                          for k in ("obs", "action", "reward",
+                                    "discount", "next_obs")))
+                    self._count_device_call("bootstrap")
+                self._boot_inflight.append((prios, items, count))
+        else:
+            if not force and self._pending_count < _PRIO_CHUNK:
+                return
+            cat = {k: np.concatenate([p[k] for p in self._pending])
+                   for k in self._pending[0]}
+            self._pending, self._pending_count = [], 0
+            n = cat["action"].shape[0]
+            with self.tracer.span("priority.bootstrap.dispatch", count=n):
+                self._dispatch_bootstraps(cat, n)
         if force:
             self._drain_bootstraps(True)
 
@@ -857,6 +1039,7 @@ class ApexLearnerService:
                 jnp.asarray(pad_to(cat["reward"])),
                 jnp.asarray(pad_to(cat["discount"])),
                 jnp.asarray(pad_to(cat["next_obs"])))
+            self._count_device_call("bootstrap")
             self._boot_inflight.append(
                 (prios, {k: v[lo:hi] for k, v in cat.items()}, hi - lo))
 
@@ -880,24 +1063,52 @@ class ApexLearnerService:
                 self.replay.add(items,
                                 priorities=np.asarray(prios)[:count])
 
-    def _sequence_sample(self, items, weights):
-        """Host [S, L, ...] arrays -> time-major SequenceSample."""
+    def _host_sequence_sample(self, items, weights):
+        """Host [S, L, ...] arrays -> time-major numpy SequenceSample
+        (the staging path uploads it as one pytree; the legacy path wraps
+        it in jnp right after)."""
         from dist_dqn_tpu.types import SequenceSample
-        jnp = self.jnp
 
         def tm(x):  # [S, L, ...] -> [L, S, ...]
-            return jnp.asarray(np.moveaxis(x, 0, 1))
+            return np.moveaxis(x, 0, 1)
 
         S = items["action"].shape[0]
         return SequenceSample(
             obs=tm(items["obs"]), action=tm(items["action"]),
             reward=tm(items["reward"]), done=tm(items["done"]),
             reset=tm(items["reset"]),
-            start_state=(jnp.asarray(items["state_c"]),
-                         jnp.asarray(items["state_h"])),
-            weights=jnp.asarray(weights),
-            t_idx=jnp.zeros((S,), jnp.int32),   # host shard tracks its own
-            b_idx=jnp.zeros((S,), jnp.int32))   # indices (idx from sample())
+            start_state=(np.asarray(items["state_c"]),
+                         np.asarray(items["state_h"])),
+            weights=np.asarray(weights, np.float32),
+            t_idx=np.zeros((S,), np.int32),     # host shard tracks its own
+            b_idx=np.zeros((S,), np.int32))     # indices (idx from sample())
+
+    def _sequence_sample(self, items, weights):
+        """Host [S, L, ...] arrays -> time-major device SequenceSample."""
+        return self.jax.tree.map(self.jnp.asarray,
+                                 self._host_sequence_sample(items, weights))
+
+    def _host_train_args(self, items, weights):
+        """The train step's batch args as HOST numpy pytrees — what the
+        double-buffered stager copies into its pinned buffers."""
+        from dist_dqn_tpu.types import Transition
+        if self.recurrent:
+            return (self._host_sequence_sample(items, weights),)
+        return (Transition(obs=items["obs"], action=items["action"],
+                           reward=items["reward"],
+                           discount=items["discount"],
+                           next_obs=items["next_obs"]),
+                np.asarray(weights, np.float32))
+
+    def _stage_batch(self, batch_size: int, beta: float) -> None:
+        """Sample one batch and begin its H2D upload (replay/staging.py):
+        the sample+copy+upload for step g+1 runs while step g trains."""
+        with self.tracer.span("replay.sample", batch=batch_size):
+            items, idx, weights = self.replay.sample(batch_size, beta)
+            gen = self.replay.generation(idx)
+        with self.tracer.span("h2d.stage", batch=batch_size):
+            self._stager.stage(self._host_train_args(items, weights),
+                               aux=(idx, gen))
 
     def _min_fill_items(self) -> int:
         """min_fill counts transitions; in sequence mode convert to
@@ -963,28 +1174,46 @@ class ApexLearnerService:
         target_grad_steps = min(
             target_grad_steps,
             self.grad_steps + max(self.rt.train_steps_per_pass, 1))
+        beta = min(1.0, cfg.replay.importance_exponent
+                   + (1 - cfg.replay.importance_exponent)
+                   * progress_steps / max(self.rt.total_env_steps, 1))
         while self.grad_steps < target_grad_steps:
-            beta = min(1.0, cfg.replay.importance_exponent
-                       + (1 - cfg.replay.importance_exponent)
-                       * progress_steps / max(self.rt.total_env_steps, 1))
-            with self.tracer.span("replay.sample", batch=batch_size):
-                items, idx, weights = self.replay.sample(batch_size, beta)
-                gen = self.replay.generation(idx)
-            with self.tracer.span("train_step.dispatch"):
-                if self.recurrent:
-                    sample = self._sequence_sample(items, weights)
+            if self._stager is not None:
+                # Double-buffered path: batch g comes off the stager
+                # (uploaded while step g-1 trained); batch g+1 is staged
+                # right after g's dispatch, so its sample+H2D overlaps
+                # g's device time. A burst never leaves stale batches
+                # staged: the last step stages no successor.
+                if len(self._stager) == 0:
+                    self._stage_batch(batch_size, beta)
+                args, (idx, gen) = self._stager.pop()
+                with self.tracer.span("train_step.dispatch"):
                     self.state, metrics = self._train_step(self.state,
-                                                           sample)
-                else:
-                    from dist_dqn_tpu.types import Transition
-                    batch = Transition(
-                        obs=jnp.asarray(items["obs"]),
-                        action=jnp.asarray(items["action"]),
-                        reward=jnp.asarray(items["reward"]),
-                        discount=jnp.asarray(items["discount"]),
-                        next_obs=jnp.asarray(items["next_obs"]))
-                    self.state, metrics = self._train_step(
-                        self.state, batch, jnp.asarray(weights))
+                                                           *args)
+                self._count_device_call("train")
+                if self.grad_steps + 1 < target_grad_steps:
+                    self._stage_batch(batch_size, beta)
+            else:
+                with self.tracer.span("replay.sample", batch=batch_size):
+                    items, idx, weights = self.replay.sample(batch_size,
+                                                             beta)
+                    gen = self.replay.generation(idx)
+                with self.tracer.span("train_step.dispatch"):
+                    if self.recurrent:
+                        sample = self._sequence_sample(items, weights)
+                        self.state, metrics = self._train_step(self.state,
+                                                               sample)
+                    else:
+                        from dist_dqn_tpu.types import Transition
+                        batch = Transition(
+                            obs=jnp.asarray(items["obs"]),
+                            action=jnp.asarray(items["action"]),
+                            reward=jnp.asarray(items["reward"]),
+                            discount=jnp.asarray(items["discount"]),
+                            next_obs=jnp.asarray(items["next_obs"]))
+                        self.state, metrics = self._train_step(
+                            self.state, batch, jnp.asarray(weights))
+                self._count_device_call("train")
             self.grad_steps += 1
             self._tm_grad_steps.inc()
             self._in_flight.append((idx, gen, metrics,
@@ -995,26 +1224,50 @@ class ApexLearnerService:
                 self._finalize_train()
 
     def _finalize_train(self):
-        """Materialize the oldest in-flight step's priorities and write
-        them back (blocks on the device only if that step still runs)."""
+        """Materialize the oldest in-flight step's priorities and queue
+        them for the next BATCHED write-back (blocks on the device only
+        if that step still runs)."""
         if not self._in_flight:
             return
         idx, gen, metrics, t_dispatch = self._in_flight.popleft()
-        with self.tracer.span("replay.update_priorities"):
-            # expected_gen drops updates for slots overwritten while this
-            # step was in flight (priority misattribution guard).
-            self.replay.update_priorities(
-                idx, np.asarray(metrics["priorities"]), expected_gen=gen)
+        prios = np.asarray(metrics["priorities"])
         # Dispatch -> materialized: the np.asarray above blocked until the
         # device finished this step, so this IS the grad-step round-trip
         # (pipelining means it includes up to pipeline_depth-1 queued
         # steps — the operationally honest number for the host loop).
         self._tm_grad_latency.observe(time.perf_counter() - t_dispatch)
         self._last_loss = float(metrics["loss"])
+        # Batched priority write-backs (ISSUE 2): accumulate completed
+        # steps' (idx, |TD|, gen) and apply them as ONE vectorized
+        # sum-tree update — K batch-sized set() calls collapse into one
+        # propagation pass. expected_gen still drops updates for slots
+        # overwritten in the meantime (priority misattribution guard),
+        # and chronological concat order keeps last-write-wins semantics
+        # for slots sampled by several of the batched steps.
+        self._prio_pending.append((idx, prios, gen))
+        self._flush_prio_writebacks()
+
+    def _flush_prio_writebacks(self, force: bool = False):
+        """Apply accumulated train-step priorities in one batched
+        sum-tree update once ``prio_writeback_batch`` steps are pending
+        (or immediately, when forced at barriers/shutdown)."""
+        limit = max(self.rt.prio_writeback_batch, 1)
+        if not self._prio_pending:
+            return
+        if not force and len(self._prio_pending) < limit:
+            return
+        pending, self._prio_pending = self._prio_pending, []
+        idx = np.concatenate([e[0] for e in pending])
+        prios = np.concatenate([e[1] for e in pending])
+        gen = np.concatenate([e[2] for e in pending])
+        with self.tracer.span("replay.update_priorities",
+                              steps=len(pending), rows=idx.shape[0]):
+            self.replay.update_priorities(idx, prios, expected_gen=gen)
 
     def _finalize_all_train(self):
         while self._in_flight:
             self._finalize_train()
+        self._flush_prio_writebacks(force=True)
 
     def _evaluate_impl(self, params) -> tuple:
         """Greedy episodes on a service-owned env; the recurrent policy
@@ -1109,6 +1362,9 @@ class ApexLearnerService:
         # the NEWEST experience) must land in the shard before it is
         # snapshotted, or a crash-resume permanently drops them.
         self._flush_pending(force=True)
+        # Same for accumulated-but-unapplied learner priorities: the
+        # snapshot must carry the freshest |TD| mass the learner computed.
+        self._flush_prio_writebacks(force=True)
         if not len(self.replay):
             return
         path = self._replay_snapshot_path()
@@ -1190,6 +1446,12 @@ class ApexLearnerService:
                         self.log.log_fn(
                             f"# bad TCP record ({self.bad_records})"
                             f": {type(e).__name__}: {e}")
+        if drained:
+            # One INGEST PASS = one drain burst that moved records. The
+            # bench divides device_calls by this to report round-trips
+            # per pass — the tunnel-latency figure of merit (ISSUE 2).
+            self.ingest_passes += 1
+            self._tm_ingest_passes.inc()
         return drained
 
     def run(self):
@@ -1241,6 +1503,7 @@ class ApexLearnerService:
                     self._tm_pending.set(self._pending_count)
                     self._tm_boot_inflight.set(len(self._boot_inflight))
                     self._tm_train_inflight.set(len(self._in_flight))
+                    self._tm_prio_pending.set(len(self._prio_pending))
                     self._tm_ring_dropped.set(self.req_ring.dropped)
                     self._tm_ring_pending.set(self.req_ring.pending_bytes)
                     self._tm_record_age.set(now - self._last_record)
@@ -1283,6 +1546,16 @@ class ApexLearnerService:
                      if self._ep_returns else None),
                 "replay_size": len(self.replay),
                 "ring_dropped": self.req_ring.dropped,
+                # Ingest fast path accounting (ISSUE 2): dispatched device
+                # programs by kind, drain bursts that carried records, and
+                # the ratio the feeder bench regresses on.
+                "device_calls": dict(self.device_calls),
+                "ingest_passes": self.ingest_passes,
+                "ingest_device_calls_per_pass": round(
+                    (self.device_calls.get("act", 0)
+                     + self.device_calls.get("fused_act_bootstrap", 0)
+                     + self.device_calls.get("bootstrap", 0))
+                    / max(self.ingest_passes, 1), 3),
                 # Full backlogs backpressure rather than drop; a nonzero
                 # count means the learner is not keeping up with actors.
                 "tcp_backpressure": (self.tcp_server.backpressure_events

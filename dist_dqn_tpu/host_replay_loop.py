@@ -105,15 +105,32 @@ def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
 
 def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     chunk_iters: int = 200, log_fn=print,
-                    env: Optional[JaxEnv] = None):
+                    env: Optional[JaxEnv] = None,
+                    double_buffer: bool = True):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
     ``cfg.train_every`` env iterations, ``cfg.updates_per_train`` grad
     steps each, batches sampled uniformly from the host ring.
+    ``double_buffer`` stages batch g+1's sample+H2D while step g trains
+    (replay/staging.py); False is the serial reference path —
+    numerically identical, tests/test_ingest_fastpath.py pins it.
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
+
+    # Honest-unsupported-surface gates (ADVICE r5): this loop builds the
+    # FEED-FORWARD actor/learner and samples the ring uniformly. A
+    # recurrent config would silently train the wrong program; a PER
+    # config silently loses its prioritization — say so.
+    if cfg.network.lstm_size > 0:
+        raise ValueError(
+            "host-replay runs the feed-forward collect/train split; "
+            "recurrent (R2D2, network.lstm_size>0) configs need the "
+            "sequence learner — use the apex runtime or the fused loop")
+    if cfg.replay.prioritized:
+        log_fn("# prioritized replay not supported by host-replay; "
+               "sampling uniformly (cfg.replay.prioritized ignored)")
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -138,6 +155,17 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # needs size > n_step + stack - 1).
     num_slots = max(cfg.replay.capacity // B,
                     cfg.learner.n_step + max(stack - 1, 0) + 2)
+    # Fail BEFORE the compile, naming the knobs: a chunk larger than the
+    # ring would only surface in HostTimeRing.add_chunk after the first
+    # device chunk (ADVICE r5 — wasted compile, error points nowhere).
+    if chunk_iters > num_slots:
+        raise ValueError(
+            f"--chunk-iters {chunk_iters} exceeds the host ring's "
+            f"{num_slots} slots (replay.capacity={cfg.replay.capacity} "
+            f"/ num_envs={B}); lower --chunk-iters or raise "
+            "replay.capacity (one chunk == the whole window would make "
+            "the ring a FIFO of the last chunk — keep chunk_iters well "
+            "below the slot count)")
     ring = HostTimeRing(num_slots, B, stored_shape,
                         np.dtype(env.observation_dtype), frame_stack=stack)
 
@@ -148,12 +176,22 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     state = init_learner(k_learn, obs_example)
     host_rng = np.random.default_rng(cfg.seed)
 
-    def put_batch(hb) -> Transition:
-        return Transition(
-            obs=jax.device_put(hb.obs), action=jax.device_put(hb.action),
-            reward=jax.device_put(hb.reward),
-            discount=jax.device_put(hb.discount),
-            next_obs=jax.device_put(hb.next_obs))
+    def sample_host() -> Transition:
+        hb = ring.sample(host_rng, cfg.learner.batch_size,
+                         cfg.learner.n_step, cfg.learner.gamma)
+        return Transition(obs=hb.obs, action=hb.action, reward=hb.reward,
+                          discount=hb.discount, next_obs=hb.next_obs)
+
+    def put_batch(hb: Transition) -> Transition:
+        return jax.tree.map(jax.device_put, hb)
+
+    # Double-buffered H2D (the module docstring's promise, made real in
+    # replay/staging.py): batch g+1 is gathered into reusable pinned-host
+    # staging buffers and its upload dispatched while step g trains.
+    stager = None
+    if double_buffer:
+        from dist_dqn_tpu.replay.staging import DoubleBufferedStager
+        stager = DoubleBufferedStager(depth=2, name="host_replay")
 
     # Train-event cadence carries its remainder across chunks so the
     # average exactly matches the fused loop's one-event-per-train_every
@@ -186,18 +224,24 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             train_debt_iters -= events * max(cfg.train_every, 1)
             grads_this_chunk = events * updates_per_train
             if grads_this_chunk:
-                # Double-buffered: sample+upload batch g+1 while step
-                # g runs on device.
-                batch = put_batch(
-                    ring.sample(host_rng, cfg.learner.batch_size,
-                                cfg.learner.n_step, cfg.learner.gamma))
-                for g in range(grads_this_chunk):
-                    state, metrics = train_jit(state, batch, weights)
-                    if g + 1 < grads_this_chunk:
-                        batch = put_batch(
-                            ring.sample(host_rng, cfg.learner.batch_size,
-                                        cfg.learner.n_step,
-                                        cfg.learner.gamma))
+                if stager is not None:
+                    # Double-buffered: batch g+1's gather + H2D upload
+                    # overlap step g's device time; the train dispatch
+                    # never waits on the link between steps.
+                    stager.stage(sample_host())
+                    for g in range(grads_this_chunk):
+                        batch, _ = stager.pop()
+                        state, metrics = train_jit(state, batch, weights)
+                        if g + 1 < grads_this_chunk:
+                            stager.stage(sample_host())
+                else:
+                    # Serial reference path (train.py --no-double-buffer,
+                    # tests): sample -> upload -> train, one at a time.
+                    batch = put_batch(sample_host())
+                    for g in range(grads_this_chunk):
+                        state, metrics = train_jit(state, batch, weights)
+                        if g + 1 < grads_this_chunk:
+                            batch = put_batch(sample_host())
                 jax.block_until_ready(state.params)
                 did = grads_this_chunk
                 grad_steps += did
@@ -217,6 +261,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             "ring_transitions": ring.size * B,
             "ring_gb": round(ring.nbytes / 1e9, 3),
         }
+        if stager is not None:
+            row["h2d_staged_bytes"] = stager.bytes_staged
         if did:
             row["loss"] = round(float(jax.device_get(metrics["loss"])), 4)
         history.append(row)
@@ -230,5 +276,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         "ring_transitions": ring.size * B,
         "ring_gb": round(ring.nbytes / 1e9, 3),
         "window_transitions_max": num_slots * B,
+        "double_buffer": stager is not None,
+        "h2d_staged_bytes": (stager.bytes_staged if stager is not None
+                             else 0),
         "history": history,
     }

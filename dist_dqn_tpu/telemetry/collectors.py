@@ -34,6 +34,25 @@ GRAD_STEPS = "dqn_grad_steps_total"
 GRAD_LATENCY = "dqn_grad_step_latency_seconds"
 PARAM_STALENESS = "dqn_param_broadcast_staleness_seconds"
 
+# Ingest fast path (ISSUE 2): device round-trip accounting for the actor
+# service, H2D staging for both learner paths. DEVICE_CALLS labels each
+# dispatch by {call="act"|"fused_act_bootstrap"|"bootstrap"|"train"};
+# DISPATCH_FANIN observes ROWS per batched act/fused dispatch (a count
+# histogram — the one deliberate exception to the _seconds rule, see
+# docs/observability.md).
+SERVICE_DEVICE_CALLS = "dqn_service_device_calls_total"
+DISPATCH_FANIN = "dqn_service_dispatch_fanin_rows"
+INGEST_PASSES = "dqn_service_ingest_passes_total"
+PRIO_WRITEBACK_PENDING = "dqn_service_prio_writeback_pending"
+STAGING_OCCUPANCY = "dqn_staging_buffer_occupancy"
+STAGING_STAGED = "dqn_staging_batches_total"
+STAGING_BYTES = "dqn_staging_bytes_total"
+
+#: Fan-in histogram buckets: powers of two from a single-lane record up
+#: to the largest plausible burst (hundreds of actors x lanes).
+FANIN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 2048.0, 4096.0, 8192.0)
+
 
 def replay_gauges(store: str, registry: Optional[Registry] = None):
     """(size, capacity, ratio) gauges for one replay store. ``store``

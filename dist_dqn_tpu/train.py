@@ -297,6 +297,13 @@ def main():
     parser.add_argument("--total-env-steps", type=int, default=0)
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--chunk-iters", type=int, default=2000)
+    parser.add_argument("--no-double-buffer", action="store_true",
+                        help="--runtime host-replay only: disable the "
+                             "double-buffered H2D staging path "
+                             "(replay/staging.py) and sample->upload->"
+                             "train serially — the numerically identical "
+                             "A/B reference for a suspected staging "
+                             "issue")
     parser.add_argument("--checkpoint-dir", default=None,
                         help="enable learner checkpoint/resume under this "
                              "directory (orbax; restores newest on start)")
@@ -463,7 +470,8 @@ def main():
             print(json.dumps({"telemetry_port": _srv.port}))
         out = run_host_replay(
             cfg, total_env_steps=args.total_env_steps or cfg.total_env_steps,
-            chunk_iters=args.chunk_iters, log_fn=print)
+            chunk_iters=args.chunk_iters, log_fn=print,
+            double_buffer=not args.no_double_buffer)
         out.pop("history", None)
         print(json.dumps(out))
         return
@@ -477,6 +485,10 @@ def main():
         if args.stop_at_return is not None:
             print("# --stop-at-return applies to the fused runtime only; "
                   "ignored under --runtime apex")
+        if args.no_double_buffer:
+            print("# --no-double-buffer applies to --runtime host-replay "
+                  "only; the apex service staging knob is "
+                  "ApexRuntimeConfig.stage_depth — ignored")
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
@@ -508,6 +520,10 @@ def main():
             telemetry_port=args.telemetry_port)
         print(json.dumps(run_apex(cfg, rt)))
         return
+    if args.no_double_buffer:
+        print("# --no-double-buffer applies to --runtime host-replay only; "
+              "ignored under the fused runtime (its replay never leaves "
+              "the device)")
     stop_fn = None
     if args.stop_at_return is not None:
         target = args.stop_at_return

@@ -57,7 +57,17 @@ BUDGET_FRACTION = 0.6       # predicted time must fit in this fraction of the ki
 # that completed a measured run on this box's chip; update when a larger
 # size completes cleanly. ring=200_000: the atari preset's full ring
 # trained clean under merged-row flat storage (2026-08-01, rc=0).
-PROVEN_SAFE = {"num_envs": 1024, "batch_size": 512, "ring": 200_000}
+# ring_dedup: frame-dedup rings carry 1/stack the bytes per slot, but
+# every slot-COUNT-scaled cost (PER priority plane, cumsum/stratified
+# sampler, gather index math) is unchanged — so dedup rings get their
+# OWN measured transition-count anchor (the 1M-slot dedup Breakout
+# window trained clean for 3000 s, docs/tpu_runs/20260801_2300_dedup/
+# breakout_c51_1M_window*.jsonl), not the stacked bound divided by the
+# stack (ADVICE r5: dividing admitted 4x more slots than proven).
+# Bytes stay separately gated by predict_fused_hbm_bytes, which already
+# models dedup storage.
+PROVEN_SAFE = {"num_envs": 1024, "batch_size": 512, "ring": 200_000,
+               "ring_dedup": 1_048_576}
 # Measured failures: configs at or beyond these sizes died mid-window.
 KNOWN_BAD = {"num_envs": 2048}
 
@@ -177,12 +187,14 @@ def check_envelope(*, num_envs: int, batch_size: int,
                 "a driver capture is owed)")
     sized = {"num_envs": num_envs, "batch_size": batch_size}
     if ring is not None:
-        # The proven-safe ring number was measured with full-stack
-        # storage; what the incidents actually bound is BYTES, so a
-        # frame-dedup ring counts at its stacked-equivalent size
-        # (1/stack of the transitions — replay.frame_dedup).
-        sized["ring"] = (ring // frame_dedup_stack if frame_dedup_stack
-                         else ring)
+        # Transition COUNTS are what slot-scaled device costs (priority
+        # plane, samplers, index math) follow, so the bound stays in
+        # counts — dedup rings against their own measured anchor
+        # (PROVEN_SAFE["ring_dedup"], the clean 1M-slot dedup window),
+        # never the stacked bound divided by the stack. The bytes side
+        # of dedup is a separate allowance: predict_fused_hbm_bytes
+        # models the 1/stack storage and gates it against HBM.
+        sized["ring_dedup" if frame_dedup_stack else "ring"] = ring
     for key, value in sized.items():
         if value > 2 * PROVEN_SAFE[key]:
             return (f"{key}={value} is more than 2x the proven-safe "

@@ -27,7 +27,7 @@ PATTERN = re.compile(r"(?:print|log_fn)\(json\.dumps")
 ALLOWLIST = {
     "bench.py": 1,
     "benchmarks/ale_learning.py": 2,
-    "benchmarks/apex_feeder_bench.py": 2,
+    "benchmarks/apex_feeder_bench.py": 1,
     "benchmarks/apex_split_bench.py": 2,
     "benchmarks/bench_sweep.py": 4,
     "benchmarks/cli_e2e.py": 3,

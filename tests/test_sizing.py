@@ -97,6 +97,30 @@ def test_prediction_is_conservative_vs_measured():
     assert 170.0 < v.predicted_s < 540.0
 
 
+def test_dedup_ring_bound_is_measured_not_divided():
+    """ADVICE r5: slot-count-scaled device costs (priority plane,
+    samplers, index math) do not shrink under frame dedup, so dedup
+    rings are bounded by their OWN measured anchor (the clean 1M-slot
+    dedup Breakout window, docs/tpu_runs/20260801_2300_dedup/) — never
+    by the stacked bound divided by the stack."""
+    # The measured 1M dedup window passes the count envelope.
+    assert sizing.check_envelope(num_envs=1024, batch_size=512,
+                                 ring=1_048_576,
+                                 frame_dedup_stack=4) is None
+    # >2x the dedup-proven count is refused, naming the dedup anchor.
+    reason = sizing.check_envelope(num_envs=1024, batch_size=512,
+                                   ring=2_500_000, frame_dedup_stack=4)
+    assert reason is not None and "ring_dedup" in reason and "2x" in reason
+    # The old //stack rule would have admitted this at 2.5M/4 = 625k;
+    # the count bound must hold regardless of stack depth.
+    assert sizing.check_envelope(num_envs=1024, batch_size=512,
+                                 ring=2_500_000,
+                                 frame_dedup_stack=8) is not None
+    # Non-dedup rings keep the stacked anchor untouched.
+    assert "ring=" in sizing.check_envelope(num_envs=1024, batch_size=512,
+                                            ring=420_000)
+
+
 def test_hbm_gate_refuses_oversized_ring():
     """A 390k-slot pixel ring (~11G logical, inside the <=2x-of-proven
     envelope now that 200k is proven) cannot fit v5e HBM even merged-row
