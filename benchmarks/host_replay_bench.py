@@ -9,8 +9,17 @@ the module docstring of host_replay_loop.py carries the TPU-VM link
 model (~10 GB/s => ~1.4M deduped env-steps/s admissible), and the
 byte columns this bench emits are what make that model checkable.
 
-Usage: python benchmarks/host_replay_bench.py [--allow-cpu]
+``--ab`` (ISSUE 3) runs the pipelined runtime against its
+``--no-pipeline`` serial reference at the SAME sizes in one process
+(compiles cached between the legs) and emits a ``trace_ab`` row —
+steady rates, speedup, D2H byte conservation, and the numerics pin
+(identical ``param_checksum``) — the same before/after discipline as
+``apex_feeder_bench --trace``. tests/test_host_replay_pipeline.py runs
+it as a tier-1 CPU smoke so the A/B harness cannot bit-rot.
+
+Usage: python benchmarks/host_replay_bench.py [--allow-cpu] [--ab]
            [--lanes 64] [--chunks 10] [--chunk-iters 100]
+           [--evac-slices 4] [--no-pipeline]
 """
 from __future__ import annotations
 
@@ -27,6 +36,26 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from tpu_battery import gate_backend  # noqa: E402
 
 
+def _emit(row) -> None:
+    print(json.dumps(row), flush=True)
+
+
+def _steady_fields(out) -> dict:
+    hist = out.get("history") or []
+    steady = hist[-1] if hist else {}
+    return {
+        "steady_env_steps_per_sec": steady.get("env_steps_per_sec"),
+        "steady_env_steps_per_sec_loop":
+            steady.get("env_steps_per_sec_loop"),
+        "steady_d2h_bytes_per_chunk": steady.get("d2h_bytes"),
+        "steady_evac_s": steady.get("evac_s"),
+        "steady_evac_fence_wait_s": steady.get("evac_fence_wait_s"),
+        "steady_evac_overlap_frac": steady.get("evac_overlap_frac"),
+        "steady_train_s": steady.get("chunk_train_s"),
+        "steady_collect_fetch_s": steady.get("chunk_collect_fetch_s"),
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--allow-cpu", action="store_true")
@@ -35,6 +64,14 @@ def main() -> int:
     p.add_argument("--chunk-iters", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--train-every", type=int, default=8)
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="measure the serial monolithic-evacuation "
+                        "reference instead of the pipelined runtime")
+    p.add_argument("--evac-slices", type=int, default=4)
+    p.add_argument("--ab", action="store_true",
+                   help="run serial AND pipelined at the same sizes and "
+                        "emit a trace_ab comparison row (rates, overlap, "
+                        "byte conservation, numerics pin)")
     p.add_argument("--window", type=int, default=1_048_576,
                    help="host-DRAM window in transitions (DRAM-priced: "
                         "1M deduped pixel transitions ~ 0.45 GB/lane-KB)")
@@ -75,27 +112,74 @@ def main() -> int:
         train_every=args.train_every,
     )
     total = args.chunks * args.chunk_iters * args.lanes
-    t0 = time.perf_counter()
-    out = run_host_replay(cfg, total_env_steps=total,
-                          chunk_iters=args.chunk_iters,
-                          log_fn=lambda s: print(s, flush=True))
-    wall = time.perf_counter() - t0
-    hist = out.pop("history")
-    steady = hist[-1] if hist else {}
-    row = {
-        **out,  # run summary first: bench-side fields below override
-        "bench": "host_replay", "platforms": platforms,
-        "lanes": args.lanes, "chunk_iters": args.chunk_iters,
-        "batch_size": args.batch_size, "train_every": args.train_every,
-        "frame_dedup": True,
-        "window_transitions": out["window_transitions_max"],
-        "wall_s_incl_setup": round(wall, 1),
-        "steady_env_steps_per_sec": steady.get("env_steps_per_sec"),
-        "steady_d2h_bytes_per_chunk": steady.get("d2h_bytes"),
-        "steady_collect_fetch_s": steady.get("chunk_collect_fetch_s"),
-        "steady_train_s": steady.get("chunk_train_s"),
-    }
-    print(json.dumps(row), flush=True)
+
+    def _measure(pipeline: bool):
+        t0 = time.perf_counter()
+        out = run_host_replay(cfg, total_env_steps=total,
+                              chunk_iters=args.chunk_iters,
+                              log_fn=lambda s: print(s, flush=True),
+                              pipeline=pipeline,
+                              evac_slices=args.evac_slices)
+        return out, time.perf_counter() - t0
+
+    def _row(out, wall, **extra):
+        steady = _steady_fields(out)
+        out = dict(out)
+        out.pop("history", None)
+        return {
+            **out,  # run summary first: bench-side fields below override
+            "bench": "host_replay", "platforms": platforms,
+            "lanes": args.lanes, "chunk_iters": args.chunk_iters,
+            "batch_size": args.batch_size, "train_every": args.train_every,
+            "frame_dedup": True,
+            "window_transitions": out["window_transitions_max"],
+            "wall_s_incl_setup": round(wall, 1),
+            **steady, **extra,
+        }
+
+    if args.ab:
+        # Each leg builds its own jit wrappers (run_host_replay creates
+        # fresh closures), so both pay compiles — the headline speedup
+        # therefore compares the STEADY last-chunk rates, which exclude
+        # compile wall by construction; the whole-run rates are emitted
+        # beside them for the compile-inclusive picture.
+        out_a, wall_a = _measure(pipeline=False)
+        _emit(_row(out_a, wall_a, phase="ab_serial"))
+        out_b, wall_b = _measure(pipeline=True)
+        _emit(_row(out_b, wall_b, phase="ab_pipelined"))
+        steady_a = out_a["history"][-1]["env_steps_per_sec"] \
+            if out_a["history"] else out_a["env_steps_per_sec"]
+        steady_b = out_b["history"][-1]["env_steps_per_sec"] \
+            if out_b["history"] else out_b["env_steps_per_sec"]
+        _emit({
+            "bench": "host_replay", "phase": "trace_ab",
+            "platforms": platforms, "total_env_steps": total,
+            "serial_env_steps_per_sec": steady_a,
+            "pipelined_env_steps_per_sec": steady_b,
+            "serial_env_steps_per_sec_avg": out_a["env_steps_per_sec"],
+            "pipelined_env_steps_per_sec_avg": out_b["env_steps_per_sec"],
+            "speedup_x": round(steady_b / max(steady_a, 1e-9), 3),
+            "d2h_bytes_serial": out_a["d2h_bytes_total"],
+            "d2h_bytes_pipelined": out_b["d2h_bytes_total"],
+            "d2h_bytes_conserved":
+                out_a["d2h_bytes_total"] == out_b["d2h_bytes_total"],
+            "pipelined_evac_overlap_frac_mean":
+                out_b["evac_overlap_frac_mean"],
+            "pipelined_fence_wait_s_total":
+                out_b["evac_fence_wait_s_total"],
+            "serial_evac_wall_share": round(
+                sum(r["evac_s"] for r in out_a["history"])
+                / max(out_a["wall_s"], 1e-9), 4),
+            "serial_param_checksum": out_a["param_checksum"],
+            "pipelined_param_checksum": out_b["param_checksum"],
+            "numerics_match":
+                out_a["param_checksum"] == out_b["param_checksum"]
+                and out_a["grad_steps"] == out_b["grad_steps"],
+        })
+        return 0
+
+    out, wall = _measure(pipeline=not args.no_pipeline)
+    _emit(_row(out, wall))
     return 0
 
 

@@ -5,30 +5,48 @@ single-chip fused path, VERDICT round-4 next #2).
 The all-on-device loop (train_loop.py) is the throughput king, but its
 replay window lives in HBM: ~200k stacked / ~1M deduped pixel
 transitions on a 16 GB v5e. This loop splits the program at the replay
-boundary instead:
+boundary instead, and since ISSUE 3 runs the split as a THREE-STAGE
+SOFTWARE PIPELINE rather than a serial chunk loop:
 
-  device: [act -> env.step] x chunk_iters   (one jitted scan, no replay)
-     |  one D2H stream of the chunk's new transitions (frames stored
-     |  once; with frame_dedup a step costs 7 KB, not 28 KB)
+  device: [act -> env.step] x chunk_iters  (one jitted scan, no replay)
+     |  chunk g+1 is dispatched BEFORE chunk g's train event, so its
+     |  device compute overlaps chunk g's evacuation and training
+     |  (collect therefore acts on params one train event stale — in
+     |  BOTH the pipelined and the serial reference path, so the two
+     |  stay bit-identical; Podracer-style off-policy staleness)
+  d2h:   chunk records leave as --evac-slices streamed time slices
+     |  (replay/staging.py StreamedEvacuator): one split dispatch, all
+     |  host copies started async, slice k's ring append overlapping
+     |  slice k+1's transfer — drained by a BACKGROUND EVACUATION
+     |  WORKER so the main thread keeps dispatching
   host:  HostTimeRing in DRAM — the window is DRAM-sized (hundreds of
-     |  GB => hundreds of millions of pixel transitions)
-     |  sampled batches, H2D, double-buffered against the device
+     |  GB => hundreds of millions of pixel transitions); slice appends
+     |  publish atomically under the ring's generation fence, and the
+     |  train event fences on the chunk's completion handle before
+     |  sampling, so a batch never sees a half-appended slice
   device: train_step (donated state), exactly the learner the fused
-          loop runs
+          loop runs; sampled batches H2D double-buffered as before
 
 Throughput model: the link, not HBM, prices the window. Per env step
 the D2H cost is one stored frame; per grad step the H2D cost is one
 batch (2 x batch x obs bytes). On a TPU-VM host link (~10 GB/s) that
 admits ~1.4M deduped env-steps/s of collection — above the fused
 loop's own rate; on this dev box the axon tunnel (~25 MB/s measured)
-is the honest bound and the bench reports the byte streams so the
-attribution is visible. Chunk collection and training are dispatched
-back-to-back, so device idle per chunk is bounded by the host-side
-ring ops, not the transfers' latency sum.
+is the honest bound. The round-5 chip measurement put the SERIAL chunk
+loop at 488 steps/s, 91% D2H-bound — the device idle for the whole
+evacuation, the host idle for the whole collect. The pipeline takes the
+serial sum collect + evac + train to ~max(evac, collect + train): the
+per-chunk rows carry the overlap accounting (``evac_s``,
+``evac_fence_wait_s``, ``evac_overlap_frac``, ``device_idle_est_s``)
+so the win is measured per run, not asserted. ``pipeline=False``
+(train.py ``--no-pipeline``) keeps the monolithic blocking evacuation
+as the numerically pinned A/B reference, same discipline as PR 2's
+``fused_ingest=False``.
 """
 from __future__ import annotations
 
 import json
+import math
 import time
 from typing import NamedTuple, Optional
 
@@ -52,6 +70,19 @@ class CollectCarry(NamedTuple):
     rng: Array
     iteration: Array
     ep_return: Array
+
+
+class _ScanCarry(NamedTuple):
+    """Chunk-internal scan carry: the persistent CollectCarry fields plus
+    the chunk-local episode accumulators. The accumulators are RETURNED
+    as separate chunk outputs rather than carried across chunks, so the
+    pipelined loop can hold and fetch them (one fused device_get) after
+    the carry itself has been donated into the next chunk's dispatch."""
+    env_state: PyTree
+    obs: PyTree
+    rng: Array
+    iteration: Array
+    ep_return: Array
     completed_return: Array
     completed_count: Array
 
@@ -59,7 +90,8 @@ class CollectCarry(NamedTuple):
 def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
                        frame_stack: int):
     """(init, collect): a device chunk of act -> step that RETURNS its
-    transitions (time-major [C, B, ...]) instead of writing a ring."""
+    transitions (time-major [C, B, ...]) plus the chunk's episode stats
+    instead of writing a ring."""
     B = cfg.actor.num_envs
     act = make_actor_step(net)
     epsilon, _ = loop_common.make_schedules(cfg, B, 1)
@@ -70,35 +102,38 @@ def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
         k_env, k_run = jax.random.split(rng)
         env_state, obs = env.v_reset(k_env, B)
         obs = jax.tree.map(jnp.copy, obs)
-        zero = jnp.float32(0.0)
         return CollectCarry(env_state=env_state, obs=obs, rng=k_run,
                             iteration=jnp.int32(0),
-                            ep_return=jnp.zeros((B,), jnp.float32),
-                            completed_return=zero, completed_count=zero)
+                            ep_return=jnp.zeros((B,), jnp.float32))
 
     def collect(carry: CollectCarry, params, num_iters: int):
-        def one_iteration(carry: CollectCarry, _):
-            rng, k_act = jax.random.split(carry.rng)
-            eps = epsilon(carry.iteration)
-            actions = act(params, carry.obs, k_act, eps)
-            env_state, out = env.v_step(carry.env_state, actions)
-            record = dict(obs=slice_newest(carry.obs), action=actions,
+        def one_iteration(sc: _ScanCarry, _):
+            rng, k_act = jax.random.split(sc.rng)
+            eps = epsilon(sc.iteration)
+            actions = act(params, sc.obs, k_act, eps)
+            env_state, out = env.v_step(sc.env_state, actions)
+            record = dict(obs=slice_newest(sc.obs), action=actions,
                           reward=out.reward, terminated=out.terminated,
                           truncated=out.truncated)
             done = jnp.logical_or(out.terminated, out.truncated)
             ep_return, completed_return, completed_count = \
-                loop_common.episode_stats_update(carry, out.reward, done)
-            return CollectCarry(env_state=env_state, obs=out.obs, rng=rng,
-                                iteration=carry.iteration + 1,
-                                ep_return=ep_return,
-                                completed_return=completed_return,
-                                completed_count=completed_count), record
+                loop_common.episode_stats_update(sc, out.reward, done)
+            return _ScanCarry(env_state=env_state, obs=out.obs, rng=rng,
+                              iteration=sc.iteration + 1,
+                              ep_return=ep_return,
+                              completed_return=completed_return,
+                              completed_count=completed_count), record
 
-        carry = carry._replace(completed_return=jnp.float32(0.0),
-                               completed_count=jnp.float32(0.0))
-        carry, records = jax.lax.scan(one_iteration, carry, None,
-                                      length=num_iters)
-        return carry, records
+        zero = jnp.float32(0.0)
+        sc = _ScanCarry(*carry, completed_return=zero,
+                        completed_count=zero)
+        sc, records = jax.lax.scan(one_iteration, sc, None,
+                                   length=num_iters)
+        carry = CollectCarry(env_state=sc.env_state, obs=sc.obs,
+                             rng=sc.rng, iteration=sc.iteration,
+                             ep_return=sc.ep_return)
+        stats = (sc.completed_return, sc.completed_count)
+        return carry, records, stats
 
     return init, collect
 
@@ -106,18 +141,31 @@ def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
 def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     chunk_iters: int = 200, log_fn=print,
                     env: Optional[JaxEnv] = None,
-                    double_buffer: bool = True):
+                    double_buffer: bool = True,
+                    pipeline: bool = True,
+                    evac_slices: int = 4):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
     ``cfg.train_every`` env iterations, ``cfg.updates_per_train`` grad
     steps each, batches sampled uniformly from the host ring.
+
+    ``pipeline`` selects the three-stage software pipeline (streamed
+    sub-chunk evacuation drained by a background worker, trains fenced
+    on the chunk's publication handle); False is the serial reference —
+    one monolithic blocking ``device_get`` + one monolithic
+    ``add_chunk``, device idle throughout. Both paths share the same
+    collect-ahead schedule (chunk g+1 dispatched with the params as
+    they stand BEFORE chunk g's train event), so they are numerically
+    IDENTICAL — tests/test_host_replay_pipeline.py pins it.
+
     ``double_buffer`` stages batch g+1's sample+H2D while step g trains
-    (replay/staging.py); False is the serial reference path —
+    (replay/staging.py); False is the serial H2D reference —
     numerically identical, tests/test_ingest_fastpath.py pins it.
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.telemetry import collectors as tmc, get_registry
 
     # Honest-unsupported-surface gates (ADVICE r5): this loop builds the
     # FEED-FORWARD actor/learner and samples the ring uniformly. A
@@ -131,6 +179,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     if cfg.replay.prioritized:
         log_fn("# prioritized replay not supported by host-replay; "
                "sampling uniformly (cfg.replay.prioritized ignored)")
+    if evac_slices < 1:
+        raise ValueError(f"--evac-slices must be >= 1, got {evac_slices}")
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -144,11 +194,6 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             "replay.frame_dedup=True but this env declares no rolling "
             "frame stack (envs/base.py JaxEnv.frame_stack)")
     stored_shape = obs_shape[:-1] + (1,) if stack else obs_shape
-
-    init_collect, collect = make_collect_chunk(cfg, env, net, stack)
-    collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
-    init_learner, train_step = make_learner(net, cfg.learner)
-    train_jit = jax.jit(train_step, donate_argnums=0)
 
     # Floor covers the n-step window AND the dedup rebuild context —
     # a smaller ring would be permanently unsampleable (can_sample
@@ -166,6 +211,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             "replay.capacity (one chunk == the whole window would make "
             "the ring a FIFO of the last chunk — keep chunk_iters well "
             "below the slot count)")
+
+    init_collect, collect = make_collect_chunk(cfg, env, net, stack)
+    collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
+    init_learner, train_step = make_learner(net, cfg.learner)
+    train_jit = jax.jit(train_step, donate_argnums=0)
+
     ring = HostTimeRing(num_slots, B, stored_shape,
                         np.dtype(env.observation_dtype), frame_stack=stack)
 
@@ -185,13 +236,40 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     def put_batch(hb: Transition) -> Transition:
         return jax.tree.map(jax.device_put, hb)
 
-    # Double-buffered H2D (the module docstring's promise, made real in
-    # replay/staging.py): batch g+1 is gathered into reusable pinned-host
-    # staging buffers and its upload dispatched while step g trains.
+    def ring_append(tree, lo, hi):
+        ring.add_chunk(tree["obs"], tree["action"], tree["reward"],
+                       tree["terminated"], tree["truncated"])
+
+    # Double-buffered H2D (replay/staging.py): batch g+1 is gathered
+    # into reusable pinned-host staging buffers and its upload
+    # dispatched while step g trains.
     stager = None
     if double_buffer:
         from dist_dqn_tpu.replay.staging import DoubleBufferedStager
         stager = DoubleBufferedStager(depth=2, name="host_replay")
+
+    # Streamed D2H + background worker (the pipeline's stages 2 and 3).
+    evacuator = worker = None
+    if pipeline:
+        from dist_dqn_tpu.replay.staging import (EvacuationWorker,
+                                                 StreamedEvacuator)
+        evacuator = StreamedEvacuator(num_slices=evac_slices,
+                                      name="host_replay")
+        worker = EvacuationWorker(evacuator, ring_append,
+                                  name="host_replay")
+
+    reg = get_registry()
+    _labels = {"loop": "host_replay"}
+    g_overlap = reg.gauge(tmc.HOST_REPLAY_OVERLAP,
+                          "share of the last chunk's evacuation hidden "
+                          "off the training critical path", _labels)
+    h_fence = reg.histogram(tmc.HOST_REPLAY_FENCE_WAIT_SECONDS,
+                            "main-thread wait on the chunk publication "
+                            "fence (evacuation on the critical path)",
+                            _labels)
+    c_d2h = reg.counter(tmc.HOST_REPLAY_D2H_BYTES,
+                        "bytes evacuated device->host by the replay "
+                        "pipeline", _labels)
 
     # Train-event cadence carries its remainder across chunks so the
     # average exactly matches the fused loop's one-event-per-train_every
@@ -200,75 +278,188 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     train_debt_iters = 0
     weights = jnp.ones((cfg.learner.batch_size,), jnp.float32)
 
+    num_chunks = max(0, math.ceil(total_env_steps / (chunk_iters * B)))
     env_steps = 0
     grad_steps = 0
+    d2h_bytes_total = 0
+    fence_wait_total = 0.0
+    overlap_fracs = []
     history = []
+    metrics = None
     t_start = time.perf_counter()
-    while env_steps < total_env_steps:
-        t0 = time.perf_counter()
-        carry, records = collect_jit(carry, state.params, chunk_iters)
-        # One D2H stream for the chunk (frames stored once).
-        host = {k: np.asarray(jax.device_get(v))
-                for k, v in records.items()}
-        t_fetch = time.perf_counter()
-        ring.add_chunk(host["obs"], host["action"], host["reward"],
-                       host["terminated"], host["truncated"])
-        env_steps += chunk_iters * B
-        t_ring = time.perf_counter()
+    try:
+        records = stats = handle = None
+        if num_chunks:
+            # Chunk 0: prologue dispatch + evacuation submit.
+            carry, records, stats = collect_jit(carry, state.params,
+                                                chunk_iters)
+            if pipeline:
+                handle = worker.submit(records)
+                records = None
+        for g in range(num_chunks):
+            t0 = time.perf_counter()
+            next_records = next_stats = None
+            if pipeline:
+                # Stage 1 — look-ahead dispatch: chunk g+1's device
+                # compute starts now and overlaps chunk g's evacuation
+                # tail + training. Its collect uses the params BEFORE
+                # chunk g's train event (one event stale — the price of
+                # the overlap; the serial path below dispatches at the
+                # same point in the data-dependency order, so the two
+                # paths stay bit-identical).
+                if g + 1 < num_chunks:
+                    carry, next_records, next_stats = collect_jit(
+                        carry, state.params, chunk_iters)
+                t_dispatch = time.perf_counter()
+                # Stage 2 — fence on chunk g's evacuation (submitted
+                # last iteration / at the prologue): its last slice
+                # must be published before the train event may sample.
+                # The wait is the portion of the evacuation left on
+                # the critical path; in steady state the worker
+                # finished it while the device ran chunk g-1's trains
+                # tail and chunk g's collect.
+                handle.wait()
+                t_fence = time.perf_counter()
+                fence_wait_s = t_fence - t_dispatch
+                evac_s = handle.stats["evac_s"]
+                d2h_bytes = handle.stats["bytes"]
+                overlap = max(0.0, min(1.0, 1.0 - fence_wait_s
+                                       / max(evac_s, 1e-9)))
+                t_evac_parts = None
+            else:
+                # Serial reference: one monolithic blocking fetch, one
+                # monolithic append, device idle throughout (the
+                # round-5 measured shape), THEN the look-ahead dispatch
+                # — same pre-train params as the pipelined path, with
+                # zero evacuation overlap.
+                host = {k: np.asarray(jax.device_get(v))
+                        for k, v in records.items()}
+                t_mono_fetch = time.perf_counter()
+                ring.add_chunk(host["obs"], host["action"], host["reward"],
+                               host["terminated"], host["truncated"])
+                t_fence = time.perf_counter()
+                fence_wait_s = evac_s = t_fence - t0
+                d2h_bytes = int(sum(v.nbytes for v in host.values()))
+                c_d2h.inc(d2h_bytes)
+                overlap = 0.0
+                t_evac_parts = (t_mono_fetch - t0, t_fence - t_mono_fetch)
+                del host
+                if g + 1 < num_chunks:
+                    carry, next_records, next_stats = collect_jit(
+                        carry, state.params, chunk_iters)
+            records = next_records
+            env_steps += chunk_iters * B
+            d2h_bytes_total += d2h_bytes
+            fence_wait_total += fence_wait_s
+            overlap_fracs.append(overlap)
+            # Both paths record the overlap instruments (a serial run's
+            # flat-zero overlap series is the dashboard A/B baseline),
+            # and the row's ring occupancy is snapshotted HERE — after
+            # the fence, before chunk g+1's background appends can
+            # advance it — so pipelined and serial rows report the same
+            # deterministic post-chunk-g state.
+            g_overlap.set(overlap)
+            h_fence.observe(fence_wait_s)
+            ring_transitions = ring.size * B
 
-        did = 0
-        if (ring.can_sample(cfg.learner.n_step)
-                and ring.size * B >= cfg.replay.min_fill):
-            train_debt_iters += chunk_iters
-            events = train_debt_iters // max(cfg.train_every, 1)
-            train_debt_iters -= events * max(cfg.train_every, 1)
-            grads_this_chunk = events * updates_per_train
-            if grads_this_chunk:
-                if stager is not None:
-                    # Double-buffered: batch g+1's gather + H2D upload
-                    # overlap step g's device time; the train dispatch
-                    # never waits on the link between steps.
-                    stager.stage(sample_host())
-                    for g in range(grads_this_chunk):
-                        batch, _ = stager.pop()
-                        state, metrics = train_jit(state, batch, weights)
-                        if g + 1 < grads_this_chunk:
-                            stager.stage(sample_host())
-                else:
-                    # Serial reference path (train.py --no-double-buffer,
-                    # tests): sample -> upload -> train, one at a time.
-                    batch = put_batch(sample_host())
-                    for g in range(grads_this_chunk):
-                        state, metrics = train_jit(state, batch, weights)
-                        if g + 1 < grads_this_chunk:
-                            batch = put_batch(sample_host())
+            # Stage 3 — train event for chunk g (samples the window
+            # INCLUDING chunk g, exactly as the serial path does).
+            did = 0
+            if (ring.can_sample(cfg.learner.n_step)
+                    and ring.size * B >= cfg.replay.min_fill):
+                train_debt_iters += chunk_iters
+                events = train_debt_iters // max(cfg.train_every, 1)
+                train_debt_iters -= events * max(cfg.train_every, 1)
+                grads_this_chunk = events * updates_per_train
+                if grads_this_chunk:
+                    if stager is not None:
+                        # Double-buffered: batch g+1's gather + H2D
+                        # upload overlap step g's device time.
+                        stager.stage(sample_host())
+                        for i in range(grads_this_chunk):
+                            batch, _ = stager.pop()
+                            state, metrics = train_jit(state, batch,
+                                                       weights)
+                            if i + 1 < grads_this_chunk:
+                                stager.stage(sample_host())
+                    else:
+                        # Serial H2D reference (--no-double-buffer):
+                        # sample -> upload -> train, one at a time.
+                        batch = put_batch(sample_host())
+                        for i in range(grads_this_chunk):
+                            state, metrics = train_jit(state, batch,
+                                                       weights)
+                            if i + 1 < grads_this_chunk:
+                                batch = put_batch(sample_host())
+                    did = grads_this_chunk
+                    grad_steps += did
+            # Chunk g+1's evacuation: every sample for chunk g's event
+            # has been drawn above, so chunk g+1's slices may publish
+            # from here on without changing what those samples saw —
+            # submit now, and its transfers overlap chunk g's train
+            # execution and chunk g+2's collect.
+            if pipeline and records is not None:
+                handle = worker.submit(records)
+                records = None
+            if did:
                 jax.block_until_ready(state.params)
-                did = grads_this_chunk
-                grad_steps += did
-        t_train = time.perf_counter()
+            t_train = time.perf_counter()
 
-        ep = float(jax.device_get(carry.completed_return)) / max(
-            float(jax.device_get(carry.completed_count)), 1.0)
-        row = {
-            "env_frames": env_steps, "grad_steps": grad_steps,
-            "episode_return": round(ep, 3),
-            "env_steps_per_sec": round(
-                chunk_iters * B / max(t_train - t0, 1e-9), 1),
-            "chunk_collect_fetch_s": round(t_fetch - t0, 4),
-            "chunk_ring_s": round(t_ring - t_fetch, 4),
-            "chunk_train_s": round(t_train - t_ring, 4),
-            "d2h_bytes": int(sum(v.nbytes for v in host.values())),
-            "ring_transitions": ring.size * B,
-            "ring_gb": round(ring.nbytes / 1e9, 3),
-        }
-        if stager is not None:
-            row["h2d_staged_bytes"] = stager.bytes_staged
-        if did:
-            row["loss"] = round(float(jax.device_get(metrics["loss"])), 4)
-        history.append(row)
-        log_fn(json.dumps(row))
+            # Fused episode-stat fetch (ISSUE 3 satellite): ONE
+            # device_get for both scalars, and its wall accounted in
+            # the row instead of hiding between t_train and the log.
+            cr, cc = jax.device_get(stats)
+            stats = next_stats
+            t_stats = time.perf_counter()
+            ep = float(cr) / max(float(cc), 1.0)
+
+            row = {
+                "env_frames": env_steps, "grad_steps": grad_steps,
+                "episode_return": round(ep, 3),
+                "env_steps_per_sec": round(
+                    chunk_iters * B / max(t_train - t0, 1e-9), 1),
+                # Whole-loop rate (ISSUE 3 satellite): includes stat
+                # fetches and logging, so it reconciles with the
+                # end-of-run summary rate; the per-chunk rate above
+                # excludes them by construction.
+                "env_steps_per_sec_loop": round(
+                    env_steps / max(t_stats - t_start, 1e-9), 1),
+                "chunk_train_s": round(t_train - t_fence, 4),
+                "chunk_stats_fetch_s": round(t_stats - t_train, 4),
+                "evac_s": round(evac_s, 4),
+                "evac_fence_wait_s": round(fence_wait_s, 4),
+                "evac_overlap_frac": round(overlap, 4),
+                # Upper bound on device idle attributable to
+                # evacuation: the fence wait (pipelined — the device
+                # may still be running collect g+1 under it) or the
+                # whole evacuation (serial — nothing is dispatched).
+                "device_idle_est_s": round(fence_wait_s, 4),
+                "d2h_bytes": d2h_bytes,
+                "ring_transitions": ring_transitions,
+                "ring_gb": round(ring.nbytes / 1e9, 3),
+            }
+            if t_evac_parts is not None:
+                row["chunk_collect_fetch_s"] = round(t_evac_parts[0], 4)
+                row["chunk_ring_s"] = round(t_evac_parts[1], 4)
+            if stager is not None:
+                row["h2d_staged_bytes"] = stager.bytes_staged
+            if did:
+                row["loss"] = round(
+                    float(jax.device_get(metrics["loss"])), 4)
+            history.append(row)
+            log_fn(json.dumps(row))
+    finally:
+        if worker is not None:
+            worker.close()
 
     wall = time.perf_counter() - t_start
+    # Pin anchor for the pipelined-vs-serial equivalence test: a cheap
+    # whole-params digest (float64 fold of float32 leaves, deterministic
+    # on one host).
+    param_checksum = float(sum(
+        np.float64(np.sum(np.asarray(leaf, np.float64)))
+        for leaf in jax.tree.leaves(jax.device_get(state.params))))
+    n = max(len(overlap_fracs), 1)
     return {
         "env_steps": env_steps, "grad_steps": grad_steps,
         "wall_s": round(wall, 1),
@@ -276,6 +467,13 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         "ring_transitions": ring.size * B,
         "ring_gb": round(ring.nbytes / 1e9, 3),
         "window_transitions_max": num_slots * B,
+        "pipeline": pipeline,
+        "evac_slices": (evacuator.num_slices if evacuator is not None
+                        else 0),
+        "d2h_bytes_total": d2h_bytes_total,
+        "evac_fence_wait_s_total": round(fence_wait_total, 4),
+        "evac_overlap_frac_mean": round(sum(overlap_fracs) / n, 4),
+        "param_checksum": param_checksum,
         "double_buffer": stager is not None,
         "h2d_staged_bytes": (stager.bytes_staged if stager is not None
                              else 0),

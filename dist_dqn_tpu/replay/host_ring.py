@@ -12,9 +12,21 @@ the device implementation on identical streams and indices.
 
 Like the actor modules this file must not import jax — host DRAM
 residency is the point.
+
+Concurrency (ISSUE 3): the pipelined host-replay runtime appends chunk
+slices from a background evacuation worker while the main thread
+samples train batches, so the ring carries a **generation fence**: every
+``add_chunk`` runs atomically under the ring lock and bumps
+``generation`` only after its arrays are fully written, and
+``sample``/``gather`` hold the same lock — a sampler can never observe
+a half-appended slice (or a slice's data without its ``pos``/``size``
+update). The lock is held only for host memcpys (the D2H transfer
+happens before ``add_chunk`` is called), so contention is microseconds
+per slice against the link-priced fetch.
 """
 from __future__ import annotations
 
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -73,6 +85,12 @@ class HostTimeRing:
         self.truncated = np.zeros((num_slots, num_envs), bool)
         self.pos = 0
         self.size = 0
+        # Generation fence (ISSUE 3): publication counter + lock. Bumped
+        # once per completed add_chunk; waiters (wait_generation) and
+        # samplers synchronize on it so concurrent slice appends are
+        # all-or-nothing from the sampler's point of view.
+        self._fence = threading.Condition(threading.RLock())
+        self.generation = 0
         # Telemetry (ISSUE 1): the host-DRAM window's occupancy and
         # add/sample volume, labeled apart from the PER host shard.
         reg = get_registry()
@@ -92,22 +110,38 @@ class HostTimeRing:
                 + self.terminated.nbytes + self.truncated.nbytes)
 
     def add_chunk(self, obs, action, reward, terminated, truncated) -> None:
-        """Append [C, B, ...] arrays (one device chunk) in time order."""
+        """Append [C, B, ...] arrays (one device chunk, or one streamed
+        slice of one) in time order. Atomic under the generation fence:
+        ``generation`` bumps only after every array is written."""
         C = action.shape[0]
         if C > self.num_slots:
             raise ValueError(f"chunk of {C} slices exceeds the "
                              f"{self.num_slots}-slot ring")
-        idx = (self.pos + np.arange(C)) % self.num_slots
-        self.obs[idx] = obs
-        self.action[idx] = action
-        self.reward[idx] = reward
-        self.terminated[idx] = terminated
-        self.truncated[idx] = truncated
-        self.pos = int((self.pos + C) % self.num_slots)
-        self.size = int(min(self.size + C, self.num_slots))
+        with self._fence:
+            idx = (self.pos + np.arange(C)) % self.num_slots
+            self.obs[idx] = obs
+            self.action[idx] = action
+            self.reward[idx] = reward
+            self.terminated[idx] = terminated
+            self.truncated[idx] = truncated
+            self.pos = int((self.pos + C) % self.num_slots)
+            self.size = int(min(self.size + C, self.num_slots))
+            self.generation += 1
+            self._fence.notify_all()
         self._c_added.inc(C * self.num_envs)
         self._g_size.set(self.size * self.num_envs)
         self._g_occ.set(self.size / self.num_slots)
+
+    def wait_generation(self, target: int,
+                        timeout: Optional[float] = None) -> bool:
+        """Block until ``generation >= target`` (slice-level publication
+        fence); returns False on timeout. Diagnostic/test primitive —
+        the training loop deliberately fences on the evacuation job's
+        completion handle instead, which also carries worker FAILURE
+        (a generation wait would hang forever on a dead worker)."""
+        with self._fence:
+            return self._fence.wait_for(lambda: self.generation >= target,
+                                        timeout=timeout)
 
     # -- sampling -----------------------------------------------------------
     def _extra(self) -> int:
@@ -133,7 +167,14 @@ class HostTimeRing:
     def gather(self, t_idx: np.ndarray, b_idx: np.ndarray, n_step: int,
                gamma: float) -> HostBatch:
         """Window-gather + n-step fold at explicit (t, b) pairs — the
-        numpy twin of device.py gather_transitions (no-final-obs path)."""
+        numpy twin of device.py gather_transitions (no-final-obs path).
+        Holds the generation fence so a concurrent slice append can
+        never tear the gathered window (RLock: sample() nests here)."""
+        with self._fence:
+            return self._gather_locked(t_idx, b_idx, n_step, gamma)
+
+    def _gather_locked(self, t_idx: np.ndarray, b_idx: np.ndarray,
+                       n_step: int, gamma: float) -> HostBatch:
         offs = np.arange(n_step, dtype=np.int32)
         tt = (t_idx[:, None] + offs[None, :]) % self.num_slots
         bb = b_idx[:, None]
@@ -158,13 +199,20 @@ class HostTimeRing:
     def sample(self, rng: np.random.Generator, batch_size: int, n_step: int,
                gamma: float) -> HostBatch:
         """Uniform over valid starts (same region as the device sampler:
-        the oldest size - n_step slots, minus the dedup context skip)."""
-        num_valid = self.size - n_step - self._extra()
-        if num_valid <= 0:
-            raise ValueError("ring not sampleable yet (gate on can_sample)")
-        u = rng.integers(0, num_valid, batch_size)
-        t_idx = (self.pos - self.size + self._extra() + u) % self.num_slots
-        b_idx = rng.integers(0, self.num_envs, batch_size)
+        the oldest size - n_step slots, minus the dedup context skip).
+        Index draw and gather share one fence hold, so the window the
+        indices were drawn against is the window that gets gathered."""
+        with self._fence:
+            num_valid = self.size - n_step - self._extra()
+            if num_valid <= 0:
+                raise ValueError(
+                    "ring not sampleable yet (gate on can_sample)")
+            u = rng.integers(0, num_valid, batch_size)
+            t_idx = (self.pos - self.size + self._extra() + u) \
+                % self.num_slots
+            b_idx = rng.integers(0, self.num_envs, batch_size)
+            batch = self._gather_locked(t_idx.astype(np.int32),
+                                        b_idx.astype(np.int32),
+                                        n_step, gamma)
         self._c_sampled.inc(batch_size)
-        return self.gather(t_idx.astype(np.int32), b_idx.astype(np.int32),
-                           n_step, gamma)
+        return batch

@@ -1,4 +1,7 @@
-"""Double-buffered host->device batch staging (ISSUE 2 tentpole #3).
+"""Host<->device batch staging: H2D double buffering (ISSUE 2) and the
+streamed D2H evacuation pipeline (ISSUE 3).
+
+H2D half — double-buffered host->device batch staging (ISSUE 2 #3).
 
 Both learner paths promise the same overlap: while train step ``g`` runs
 on the device, the host samples batch ``g+1`` and starts its H2D upload,
@@ -21,12 +24,30 @@ JAX's async dispatch:
     block-until-ready'd — a no-op in steady state, since a full train
     step has run since that upload was issued.
 
-Telemetry (ISSUE 2): queue occupancy gauge, staged-batch and staged-byte
-counters, all labeled with the owning loop's name so the service learner
-and the host-replay loop stay separable on one dashboard.
+D2H half — ``StreamedEvacuator`` + ``EvacuationWorker`` (ISSUE 3): the
+host-replay loop's chunk records leave the device as ``--evac-slices``
+time slices instead of one monolithic blocking ``device_get``. The
+evacuator compiles ONE splitting program per chunk shape (a tunnel
+round-trip is priced per dispatch, not per byte — docs/
+ingest_pipeline.md), starts every slice's host copy asynchronously
+(``copy_to_host_async``), and publishes each slice into the ring's
+preallocated slot arrays as it arrives — slice k's ring append overlaps
+slice k+1's transfer, and the whole stream overlaps the next chunk's
+device compute. The worker moves the blocking tail (transfer wait + ring
+append) off the main thread entirely, behind a per-chunk completion
+handle the training loop fences on before sampling.
+
+Telemetry (ISSUE 2/3): queue occupancy gauge, staged-batch and
+staged-byte counters, D2H byte/slice counters and evacuation-latency /
+slice-lag histograms — all labeled with the owning loop's name so the
+service learner and the host-replay loop stay separable on one
+dashboard.
 """
 from __future__ import annotations
 
+import queue
+import threading
+import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
@@ -144,3 +165,238 @@ class DoubleBufferedStager:
         out = self._queue.popleft()
         self._g_occ.set(len(self._queue))
         return out
+
+
+def _slice_bounds(length: int, num_slices: int) -> List[Tuple[int, int]]:
+    """Contiguous near-equal [lo, hi) time slices covering [0, length)."""
+    k = max(1, min(int(num_slices), int(length)))
+    base, rem = divmod(int(length), k)
+    bounds, lo = [], 0
+    for i in range(k):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+class _EvacJob:
+    """One chunk's in-flight evacuation: device slices with their host
+    copies already started, plus the completion handle state."""
+
+    def __init__(self, slices, bounds, treedef, submitted_at: float):
+        self.slices = slices            # [k][leaf] device arrays
+        self.bounds = bounds            # [k] (lo, hi)
+        self.treedef = treedef
+        self.submitted_at = submitted_at
+        self.stats: dict = {}
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+
+    # -- completion handle surface (what the training loop sees) ------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Fence: block until every slice of this chunk is appended (or
+        the worker failed). Re-raises the worker's exception."""
+        ok = self._done.wait(timeout)
+        if self._exc is not None:
+            raise self._exc
+        return ok
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set() and self._exc is None
+
+    def _finish(self, stats: dict) -> None:
+        self.stats = stats
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done.set()
+
+
+class StreamedEvacuator:
+    """Streamed sub-chunk D2H evacuation — the D2H twin of
+    ``DoubleBufferedStager`` (ISSUE 3 tentpole #2).
+
+    ``start(records)`` splits a pytree of ``[C, B, ...]`` device arrays
+    into ``num_slices`` contiguous time slices with ONE jitted device
+    program (the caller drops its records reference after — the split
+    outputs replace them) and starts every slice's asynchronous host
+    copy; it returns an
+    ``_EvacJob`` and never blocks on the link. ``drain(job, on_slice)``
+    then walks the slices in time order: each ``np.asarray`` completes
+    when that slice's transfer lands (earlier slices finish while later
+    ones are still in flight) and ``on_slice(tree, lo, hi)`` publishes
+    it. The fetched arrays go to ``on_slice`` as-is: the reusable
+    preallocated host buffers of this pipeline are the RING'S OWN slot
+    arrays, which ``add_chunk`` memcpys into synchronously before
+    ``on_slice`` returns — an intermediate staging pool here would add
+    a third full copy of every evacuated byte for a handoff nothing
+    reads afterward (unlike the H2D stager, whose pool IS read by an
+    in-flight async upload). Slice trees are only valid within their
+    ``on_slice`` call.
+
+    Splitting costs one device dispatch per chunk (not per slice) —
+    on a remote tunnel dispatches are priced at the ~70 ms round-trip
+    constant, so per-slice device slicing would cancel the win.
+    """
+
+    def __init__(self, num_slices: int = 4, name: str = "host_replay"):
+        if num_slices < 1:
+            raise ValueError(
+                f"evacuator num_slices must be >= 1, got {num_slices}")
+        import jax  # deferred: keep the module importable without jax
+
+        self._jax = jax
+        self.num_slices = int(num_slices)
+        self._split_cache: dict = {}
+        self.bytes_total = 0
+        self.slices_total = 0
+        labels = {"loop": name}
+        reg = get_registry()
+        self._c_bytes = reg.counter(
+            tm.HOST_REPLAY_D2H_BYTES,
+            "bytes evacuated device->host by the replay pipeline", labels)
+        self._c_slices = reg.counter(
+            tm.HOST_REPLAY_EVAC_SLICES,
+            "sub-chunk D2H slices streamed", labels)
+
+    def start(self, records: Any) -> _EvacJob:
+        """Dispatch the slice split + async host copies for one chunk.
+        Cheap and non-blocking; call from the thread that owns the
+        dispatch order (the training loop), BEFORE the next device
+        program is enqueued, so the transfers overlap its compute."""
+        jax = self._jax
+        leaves, treedef = jax.tree_util.tree_flatten(records)
+        C = int(leaves[0].shape[0])
+        key = (treedef, C)
+        split = self._split_cache.get(key)
+        if split is None:
+            bounds = _slice_bounds(C, self.num_slices)
+
+            def _split(tree):
+                return tuple(
+                    jax.tree_util.tree_map(lambda x: x[lo:hi], tree)
+                    for lo, hi in bounds)
+
+            # No donation: the slice outputs cannot alias the [C, ...]
+            # input buffer (XLA would warn every run); the records
+            # buffer frees when the caller drops its reference anyway.
+            split = (jax.jit(_split), bounds)
+            self._split_cache[key] = split
+        split_fn, bounds = split
+        slices = split_fn(records)
+        flat_slices = []
+        for s in slices:
+            s_leaves = jax.tree_util.tree_leaves(s)
+            for x in s_leaves:
+                copy_async = getattr(x, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+            flat_slices.append(s_leaves)
+        return _EvacJob(flat_slices, bounds, treedef,
+                        submitted_at=time.perf_counter())
+
+    def drain(self, job: _EvacJob, on_slice: Callable[[Any, int, int], None],
+              on_slice_done: Optional[Callable[[int], None]] = None) -> dict:
+        """Fetch + publish every slice of ``job`` in time order; returns
+        per-chunk stats. Runs on the evacuation worker thread (or inline
+        for a synchronous caller)."""
+        jax = self._jax
+        nbytes = 0
+        for i, (leaves, (lo, hi)) in enumerate(zip(job.slices, job.bounds)):
+            host = [np.asarray(x) for x in leaves]
+            nbytes += sum(h.nbytes for h in host)
+            job.slices[i] = None  # release the device slice promptly
+            on_slice(jax.tree_util.tree_unflatten(job.treedef, host),
+                     lo, hi)
+            self.slices_total += 1
+            self._c_slices.inc()
+            if on_slice_done is not None:
+                on_slice_done(i)
+        self.bytes_total += nbytes
+        self._c_bytes.inc(nbytes)
+        return {"bytes": nbytes, "slices": len(job.bounds),
+                "evac_s": time.perf_counter() - job.submitted_at}
+
+
+class EvacuationWorker:
+    """Background D2H evacuation (ISSUE 3 tentpole #3): drains
+    ``StreamedEvacuator`` jobs on a daemon thread so transfer waits and
+    ring appends never block ``sample_host``/``train_jit`` dispatches.
+
+    ``submit(records)`` runs ``evacuator.start`` on the CALLER's thread
+    (dispatch-order ownership, see ``start``) and queues the drain;
+    the returned job doubles as the completion handle the loop fences
+    on (``job.wait()``). A worker exception fails the in-flight job AND
+    every queued one, re-raises from ``wait()``/the next ``submit()``,
+    and exits the thread — no silent half-appended chunks, no hang.
+    """
+
+    def __init__(self, evacuator: StreamedEvacuator,
+                 on_slice: Callable[[Any, int, int], None],
+                 name: str = "host_replay"):
+        self._evac = evacuator
+        self._on_slice = on_slice
+        self._q: "queue.Queue" = queue.Queue()
+        self._exc: Optional[BaseException] = None
+        labels = {"loop": name}
+        reg = get_registry()
+        self._h_evac = reg.histogram(
+            tm.HOST_REPLAY_EVAC_SECONDS,
+            "per-chunk evacuation wall (submit -> last slice published)",
+            labels)
+        self._h_lag = reg.histogram(
+            tm.HOST_REPLAY_SLICE_LAG_SECONDS,
+            "slice publication lag behind its chunk's submission", labels)
+        self._thread = threading.Thread(
+            target=self._run, name=f"evac-{name}", daemon=True)
+        self._thread.start()
+
+    def submit(self, records: Any) -> _EvacJob:
+        if self._exc is not None:
+            raise RuntimeError(
+                "evacuation worker died; no further chunks can be "
+                "evacuated") from self._exc
+        if not self._thread.is_alive():
+            raise RuntimeError("evacuation worker is closed")
+        job = self._evac.start(records)
+        self._q.put(job)
+        return job
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                t0 = job.submitted_at
+
+                def _lag(_i):
+                    self._h_lag.observe(time.perf_counter() - t0)
+
+                stats = self._evac.drain(job, self._on_slice,
+                                         on_slice_done=_lag)
+                self._h_evac.observe(stats["evac_s"])
+                job._finish(stats)
+            except BaseException as e:  # propagate, never hang the fence
+                self._exc = e
+                job._fail(e)
+                # Stay alive as a tombstone: every job already queued or
+                # racing a submit() past the _exc check fails immediately
+                # instead of stranding its fence. close() still exits.
+                while True:
+                    pending = self._q.get()
+                    if pending is None:
+                        return
+                    pending._fail(e)
+
+    def close(self) -> None:
+        """Stop the worker and join. Queued jobs finish first; after a
+        worker death this returns immediately (the thread is gone)."""
+        self._q.put(None)
+        self._thread.join()
+
+    @property
+    def failed(self) -> Optional[BaseException]:
+        return self._exc

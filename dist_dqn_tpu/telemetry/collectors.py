@@ -48,6 +48,17 @@ STAGING_OCCUPANCY = "dqn_staging_buffer_occupancy"
 STAGING_STAGED = "dqn_staging_batches_total"
 STAGING_BYTES = "dqn_staging_bytes_total"
 
+# Host-replay D2H pipeline (ISSUE 3): the evacuation half of the
+# staging story — streamed sub-chunk D2H fetches, the background
+# evacuation worker, and the per-chunk overlap accounting. All labeled
+# {loop="host_replay"} to mirror the H2D staging families above.
+HOST_REPLAY_D2H_BYTES = "dqn_host_replay_d2h_bytes_total"
+HOST_REPLAY_EVAC_SLICES = "dqn_host_replay_evac_slices_total"
+HOST_REPLAY_EVAC_SECONDS = "dqn_host_replay_evac_seconds"
+HOST_REPLAY_SLICE_LAG_SECONDS = "dqn_host_replay_slice_lag_seconds"
+HOST_REPLAY_FENCE_WAIT_SECONDS = "dqn_host_replay_fence_wait_seconds"
+HOST_REPLAY_OVERLAP = "dqn_host_replay_evac_overlap_frac"
+
 #: Fan-in histogram buckets: powers of two from a single-lane record up
 #: to the largest plausible burst (hundreds of actors x lanes).
 FANIN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,

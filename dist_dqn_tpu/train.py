@@ -304,6 +304,23 @@ def main():
                              "train serially — the numerically identical "
                              "A/B reference for a suspected staging "
                              "issue")
+    parser.add_argument("--no-pipeline", action="store_true",
+                        help="--runtime host-replay only: disable the "
+                             "three-stage collect/evacuate/train "
+                             "pipeline (streamed sub-chunk D2H + "
+                             "background evacuation worker) and "
+                             "evacuate each chunk with one blocking "
+                             "monolithic fetch — the numerically "
+                             "identical serial A/B reference (same "
+                             "collect-ahead schedule, zero overlap)")
+    parser.add_argument("--evac-slices", type=int, default=4,
+                        help="--runtime host-replay only: time slices "
+                             "each chunk's D2H evacuation streams "
+                             "through (replay/staging.py "
+                             "StreamedEvacuator); higher overlaps "
+                             "transfers and ring appends at finer "
+                             "grain, 1 = one streamed piece. Ignored "
+                             "under --no-pipeline")
     parser.add_argument("--checkpoint-dir", default=None,
                         help="enable learner checkpoint/resume under this "
                              "directory (orbax; restores newest on start)")
@@ -471,7 +488,9 @@ def main():
         out = run_host_replay(
             cfg, total_env_steps=args.total_env_steps or cfg.total_env_steps,
             chunk_iters=args.chunk_iters, log_fn=print,
-            double_buffer=not args.no_double_buffer)
+            double_buffer=not args.no_double_buffer,
+            pipeline=not args.no_pipeline,
+            evac_slices=args.evac_slices)
         out.pop("history", None)
         print(json.dumps(out))
         return
@@ -489,6 +508,10 @@ def main():
             print("# --no-double-buffer applies to --runtime host-replay "
                   "only; the apex service staging knob is "
                   "ApexRuntimeConfig.stage_depth — ignored")
+        if args.no_pipeline \
+                or args.evac_slices != parser.get_default("evac_slices"):
+            print("# --no-pipeline/--evac-slices apply to --runtime "
+                  "host-replay only; ignored under --runtime apex")
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
@@ -524,6 +547,11 @@ def main():
         print("# --no-double-buffer applies to --runtime host-replay only; "
               "ignored under the fused runtime (its replay never leaves "
               "the device)")
+    if args.no_pipeline \
+            or args.evac_slices != parser.get_default("evac_slices"):
+        print("# --no-pipeline/--evac-slices apply to --runtime "
+              "host-replay only; ignored under the fused runtime (its "
+              "replay never leaves the device)")
     stop_fn = None
     if args.stop_at_return is not None:
         target = args.stop_at_return

@@ -1,0 +1,347 @@
+"""Pipelined host-replay runtime (ISSUE 3): overlap must change WHEN
+work happens, never WHAT is computed.
+
+The load-bearing assertions:
+
+* the PIPELINE EQUIVALENCE pin runs the hybrid loop with the three-stage
+  pipeline on and off at the same seed and requires bit-identical loss
+  histories, grad counts and a bit-identical whole-params checksum —
+  the mirror of test_ingest_fastpath.py's double-buffer pin — plus D2H
+  byte conservation (streaming the evacuation moves the same bytes);
+* the GENERATION FENCE test hammers the ring with a background slice
+  writer while sampling concurrently and requires every sampled
+  transition to be internally consistent — a sampler can never observe
+  a half-appended slice;
+* the EVACUATION WORKER tests pin the failure contract: an exception in
+  the worker propagates at the fence (and poisons later submits), and
+  the thread always joins — no hang, no silent half-evacuated chunk;
+* the BENCH A/B smoke runs benchmarks/host_replay_bench.py --ab on CPU
+  at a tiny size so the serial-vs-pipelined harness cannot bit-rot
+  (the trace_ab row must report conserved bytes and matching numerics).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.replay.host_ring import HostTimeRing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_cfg():
+    cfg = CONFIGS["cartpole"]
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+
+
+def test_pipeline_matches_serial_numerics():
+    """THE equivalence pin: the pipelined path (streamed sub-chunk
+    evacuation, background worker, collect-ahead dispatch) must yield
+    IDENTICAL learner results to the --no-pipeline serial reference —
+    same seed, bit-identical loss history, bit-identical params — while
+    moving the same D2H bytes and reporting overlap > 0."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _tiny_cfg()
+    out_p = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                            log_fn=lambda s: None, pipeline=True,
+                            evac_slices=3)
+    out_s = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                            log_fn=lambda s: None, pipeline=False)
+    assert out_p["pipeline"] and not out_s["pipeline"]
+    assert out_p["grad_steps"] == out_s["grad_steps"] > 0
+    losses_p = [r["loss"] for r in out_p["history"] if "loss" in r]
+    losses_s = [r["loss"] for r in out_s["history"] if "loss" in r]
+    assert losses_p and losses_p == losses_s
+    assert out_p["param_checksum"] == out_s["param_checksum"]
+    # D2H conservation: slicing the stream must not change its volume.
+    assert out_p["d2h_bytes_total"] == out_s["d2h_bytes_total"] > 0
+    assert sum(r["d2h_bytes"] for r in out_p["history"]) == \
+        out_p["d2h_bytes_total"]
+    # Overlap accounting: the pipelined rows must measure evacuation
+    # coming OFF the critical path; the serial reference pins 0.
+    assert out_p["evac_overlap_frac_mean"] > 0.0
+    assert out_s["evac_overlap_frac_mean"] == 0.0
+    for row in out_p["history"]:
+        assert 0.0 <= row["evac_overlap_frac"] <= 1.0
+        assert row["evac_fence_wait_s"] <= row["evac_s"] + 1e-6
+
+
+def test_pipeline_rows_account_stats_and_loop_rate():
+    """ISSUE 3 satellites: the fused episode-stat fetch is one timed
+    row field (not an unattributed sync), and rows carry the whole-loop
+    rate that reconciles with the end-of-run summary rate."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    out = run_host_replay(_tiny_cfg(), total_env_steps=1600,
+                          chunk_iters=50, log_fn=lambda s: None)
+    assert out["history"]
+    for row in out["history"]:
+        assert row["chunk_stats_fetch_s"] >= 0.0
+        assert row["env_steps_per_sec_loop"] > 0.0
+    # The last row's loop rate and the summary rate measure the same
+    # quantity up to the final logging call — same order of magnitude,
+    # unlike the per-chunk rate which excludes stats/log time entirely.
+    last = out["history"][-1]["env_steps_per_sec_loop"]
+    assert out["env_steps_per_sec"] <= last * 1.05
+
+
+class TestGenerationFence:
+    def test_sample_never_sees_half_appended_slice(self):
+        """Background slice appends vs concurrent sampling: every
+        transition drawn must be internally consistent (obs == action
+        == reward == the writing slice's sequence number). A torn
+        append — data without its size/pos publication, or a sampler
+        reading mid-write — fails the cross-field equality."""
+        ring = HostTimeRing(num_slots=256, num_envs=4, obs_shape=(3,),
+                            obs_dtype=np.float32)
+        n_slices, C = 400, 16
+        rng = np.random.default_rng(0)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            for s in range(1, n_slices + 1):
+                v = np.float32(s)
+                ring.add_chunk(
+                    np.full((C, 4, 3), v, np.float32),
+                    np.full((C, 4), s, np.int32),
+                    np.full((C, 4), v, np.float32),
+                    np.zeros((C, 4), bool), np.zeros((C, 4), bool))
+            stop.set()
+
+        def sampler():
+            while not stop.is_set():
+                if not ring.can_sample(1):
+                    continue
+                hb = ring.sample(rng, 64, n_step=1, gamma=0.99)
+                a = hb.action.astype(np.float32)
+                if not (np.all(hb.obs == a[:, None])
+                        and np.all(hb.reward == a)):
+                    errors.append((hb.obs[:2], hb.action[:2],
+                                   hb.reward[:2]))
+                    return
+
+        t_w = threading.Thread(target=writer)
+        t_s = threading.Thread(target=sampler)
+        t_s.start()
+        t_w.start()
+        t_w.join(timeout=60)
+        t_s.join(timeout=60)
+        assert not t_w.is_alive() and not t_s.is_alive()
+        assert not errors, f"torn sample observed: {errors[0]}"
+        assert ring.generation == n_slices
+
+    def test_wait_generation(self):
+        ring = HostTimeRing(num_slots=16, num_envs=2, obs_shape=(2,),
+                            obs_dtype=np.float32)
+        assert ring.wait_generation(0)
+        assert not ring.wait_generation(1, timeout=0.05)
+
+        def later():
+            time.sleep(0.05)
+            ring.add_chunk(np.zeros((2, 2, 2), np.float32),
+                           np.zeros((2, 2), np.int32),
+                           np.zeros((2, 2), np.float32),
+                           np.zeros((2, 2), bool), np.zeros((2, 2), bool))
+
+        t = threading.Thread(target=later)
+        t.start()
+        assert ring.wait_generation(1, timeout=10)
+        t.join()
+
+
+class TestStreamedEvacuator:
+    def _records(self, C=12, B=3):
+        import jax.numpy as jnp
+        return {
+            "obs": jnp.arange(C * B * 2, dtype=jnp.float32
+                              ).reshape(C, B, 2),
+            "action": jnp.arange(C * B, dtype=jnp.int32).reshape(C, B),
+        }
+
+    def test_slices_cover_chunk_in_order(self):
+        """The streamed slices must tile [0, C) exactly once, in time
+        order, and reassemble to the monolithic fetch bit-for-bit."""
+        import jax
+
+        from dist_dqn_tpu.replay.staging import StreamedEvacuator
+
+        ev = StreamedEvacuator(num_slices=5, name="test_evac")
+        records = self._records()
+        want = jax.device_get(records)
+        got, spans = [], []
+        stats = ev.drain(ev.start(records),
+                         lambda tree, lo, hi: (
+                             got.append({k: v.copy()
+                                         for k, v in tree.items()}),
+                             spans.append((lo, hi))))
+        assert spans == [(0, 3), (3, 6), (6, 8), (8, 10), (10, 12)]
+        re = {k: np.concatenate([s[k] for s in got]) for k in want}
+        np.testing.assert_array_equal(re["obs"], want["obs"])
+        np.testing.assert_array_equal(re["action"], want["action"])
+        assert stats["slices"] == 5
+        assert stats["bytes"] == sum(v.nbytes for v in want.values())
+
+    def test_repeated_chunks_accumulate_counters(self):
+        from dist_dqn_tpu.replay.staging import StreamedEvacuator
+
+        ev = StreamedEvacuator(num_slices=2, name="test_evac")
+        for _ in range(3):
+            ev.drain(ev.start(self._records()), lambda tree, lo, hi: None)
+        assert ev.slices_total == 6
+        # One split program compiled for the repeated (treedef, C) shape.
+        assert len(ev._split_cache) == 1
+
+    def test_more_slices_than_iters_clamps(self):
+        from dist_dqn_tpu.replay.staging import StreamedEvacuator
+
+        ev = StreamedEvacuator(num_slices=64, name="test_evac")
+        spans = []
+        stats = ev.drain(ev.start(self._records(C=4)),
+                         lambda tree, lo, hi: spans.append((lo, hi)))
+        assert spans == [(0, 1), (1, 2), (2, 3), (3, 4)]
+        assert stats["slices"] == 4
+
+    def test_rejects_bad_slice_count(self):
+        from dist_dqn_tpu.replay.staging import StreamedEvacuator
+
+        with pytest.raises(ValueError, match="num_slices"):
+            StreamedEvacuator(num_slices=0)
+
+
+class TestEvacuationWorker:
+    def _worker(self, on_slice, num_slices=3):
+        from dist_dqn_tpu.replay.staging import (EvacuationWorker,
+                                                 StreamedEvacuator)
+        ev = StreamedEvacuator(num_slices=num_slices, name="test_worker")
+        return EvacuationWorker(ev, on_slice, name="test_worker")
+
+    def _records(self):
+        import jax.numpy as jnp
+        return {"x": jnp.ones((9, 2, 4), jnp.float32)}
+
+    def test_handle_completes_and_clean_shutdown(self):
+        done = []
+        w = self._worker(lambda tree, lo, hi: done.append((lo, hi)))
+        try:
+            h = w.submit(self._records())
+            assert h.wait(timeout=30)
+            assert h.done and h.stats["slices"] == 3
+            assert [lo for lo, _ in done] == sorted(lo for lo, _ in done)
+        finally:
+            w.close()
+        assert not w._thread.is_alive()
+
+    def test_worker_exception_propagates_no_hang(self):
+        """ISSUE 3 satellite: an exception in the evacuation worker
+        must re-raise at the fence AND poison later submits — never a
+        hung thread or a silently half-evacuated chunk."""
+
+        def boom(tree, lo, hi):
+            raise RuntimeError("ring append exploded")
+
+        w = self._worker(boom)
+        try:
+            h = w.submit(self._records())
+            with pytest.raises(RuntimeError, match="exploded"):
+                h.wait(timeout=30)
+            assert w.failed is not None
+            with pytest.raises(RuntimeError, match="worker died"):
+                w.submit(self._records())
+        finally:
+            w.close()
+        assert not w._thread.is_alive()
+
+    def test_queued_jobs_fail_after_worker_death(self):
+        """Jobs already queued behind the failing one must fail too —
+        their fences would otherwise hang the training loop forever."""
+        gate = threading.Event()
+
+        def slow_boom(tree, lo, hi):
+            gate.wait(timeout=30)
+            raise RuntimeError("late failure")
+
+        w = self._worker(slow_boom, num_slices=1)
+        try:
+            h1 = w.submit(self._records())
+            h2 = w.submit(self._records())
+            gate.set()
+            with pytest.raises(RuntimeError, match="late failure"):
+                h1.wait(timeout=30)
+            with pytest.raises(RuntimeError, match="late failure"):
+                h2.wait(timeout=30)
+        finally:
+            w.close()
+        assert not w._thread.is_alive()
+
+    def test_loop_surfaces_worker_failure(self):
+        """End to end: a ring append that blows up mid-run must abort
+        run_host_replay with the worker's exception (after closing the
+        worker), not wedge the fence."""
+        from dist_dqn_tpu import host_replay_loop as hrl
+
+        class _BoomRing(HostTimeRing):
+            def add_chunk(self, *a, **k):
+                if self.generation >= 2:
+                    raise RuntimeError("DRAM append failed")
+                super().add_chunk(*a, **k)
+
+        orig = hrl.HostTimeRing
+        hrl.HostTimeRing = _BoomRing
+        try:
+            with pytest.raises(RuntimeError, match="DRAM append failed"):
+                hrl.run_host_replay(_tiny_cfg(), total_env_steps=3200,
+                                    chunk_iters=50, log_fn=lambda s: None,
+                                    pipeline=True, evac_slices=2)
+        finally:
+            hrl.HostTimeRing = orig
+
+
+def test_host_replay_bench_ab_smoke():
+    """ISSUE 3 CI satellite: the serial-vs-pipelined A/B harness runs
+    end to end on CPU at a tiny size and its trace_ab row reports
+    conserved D2H bytes and matching numerics. Tier-1-safe: one small
+    subprocess, CPU-clamped sizes."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # never touch the tunnel
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/host_replay_bench.py", "--allow-cpu",
+         "--ab", "--chunks", "2", "--chunk-iters", "10", "--lanes", "4",
+         "--batch-size", "8", "--train-every", "4", "--window", "4096"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rows = []
+    for line in proc.stdout.splitlines():
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            pass
+    legs = {r.get("phase"): r for r in rows if "phase" in r}
+    assert {"ab_serial", "ab_pipelined", "trace_ab"} <= set(legs)
+    ab = legs["trace_ab"]
+    assert ab["d2h_bytes_conserved"] is True
+    assert ab["numerics_match"] is True
+    assert ab["pipelined_evac_overlap_frac_mean"] >= 0.0
+    assert legs["ab_pipelined"]["pipeline"] is True
+    assert legs["ab_serial"]["pipeline"] is False
+    assert legs["ab_pipelined"]["grad_steps"] > 0
+    assert ab["platforms"] == "cpu"
