@@ -352,6 +352,11 @@ def _measure(jax, device, smoke: bool):
                       "per-grad-step share of the chunk wall") \
             .observe(dt / measure_chunks / gsteps)
     extras["telemetry"] = telemetry.snapshot(reg)
+    # Run manifest (ISSUE 4 satellite): BENCH rows self-describe their
+    # provenance — git sha, jax/numpy versions, platform, the exact
+    # measured config (hashed), argv, schema_version — the same block
+    # train.py logs and forensics bundles embed (telemetry/manifest.py).
+    extras["manifest"] = telemetry.build_manifest(cfg)
     if s["prioritized"]:
         extras["prioritized"] = True  # opt-in: default line unchanged
         extras["sampler"] = "pallas" if s["pallas_sampler"] else "xla"

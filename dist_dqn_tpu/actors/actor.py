@@ -23,6 +23,7 @@ from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
 from dist_dqn_tpu.envs.gym_adapter import make_host_env
 from dist_dqn_tpu.telemetry import (get_registry,
                                     maybe_install_snapshot_from_env)
+from dist_dqn_tpu.telemetry import watchdog
 
 
 def _actor_telemetry(actor_id: int, tag: str):
@@ -31,14 +32,26 @@ def _actor_telemetry(actor_id: int, tag: str):
     is process-local; DQN_TELEMETRY_SNAPSHOT dumps it on exit (including
     SIGTERM — the lifecycle hook), which is how a post-mortem can tell a
     wedged actor (stale heartbeat) from a dead one (no snapshot update).
+
+    Also arms the per-process stall watchdog from DQN_FORENSICS_DIR
+    (ISSUE 4 — set by the service CLI's --forensics-dir) and returns a
+    "actor.loop" stage heartbeat: a worker wedged inside env.step or a
+    transport wait dumps its own forensics bundle, named stacks and all.
     """
     reg = get_registry()
     maybe_install_snapshot_from_env(tag=f"{tag}{actor_id}")
+    watchdog.maybe_install_from_env()
     labels = {"actor": str(actor_id)}
     return (reg.gauge("dqn_actor_heartbeat_timestamp",
                       "unix time of the last step-loop pass", labels),
             reg.counter("dqn_actor_env_steps_total",
-                        "env steps taken by this actor process", labels))
+                        "env steps taken by this actor process", labels),
+            # Startup grace: the first loop pass blocks on the SERVICE's
+            # first act-program compile — the same slow start the
+            # service's own stages get grace for.
+            watchdog.heartbeat(
+                "actor.loop",
+                startup_grace_s=watchdog.STARTUP_GRACE_S))
 
 
 def _step_and_encode(env, actions, actor_id: int, t: int,
@@ -77,7 +90,7 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     while not ring.push(payload):
         time.sleep(0.001)
 
-    heartbeat, steps_total = _actor_telemetry(actor_id, "actor")
+    heartbeat, steps_total, hb_stage = _actor_telemetry(actor_id, "actor")
     steps = 0
     while steps < max_env_steps and not os.path.exists(stop_path):
         # Wait for the actions computed for our step-t observations.
@@ -91,6 +104,7 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         steps += num_envs
         steps_total.inc(num_envs)
         heartbeat.set(time.time())
+        hb_stage.beat()
         while not ring.push(payload):
             if os.path.exists(stop_path):
                 return
@@ -128,7 +142,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             compress="auto"))
         return client
 
-    heartbeat, steps_total = _actor_telemetry(actor_id, "remote")
+    heartbeat, steps_total, hb_stage = _actor_telemetry(actor_id, "remote")
     reconnects = get_registry().counter(
         "dqn_actor_reconnects_total",
         "remote-actor connection (re)establishments",
@@ -142,6 +156,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     while steps < max_env_steps and not os.path.exists(stop_path) \
             and failures < max_consecutive_failures:
         if client is None:           # between (re)connect attempts
+            hb_stage.beat()          # retrying is responsive, not wedged
             try:
                 client = connect_and_hello(obs, t)
                 failures = 0
@@ -161,6 +176,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         steps += num_envs
         steps_total.inc(num_envs)
         heartbeat.set(time.time())
+        hb_stage.beat()
         if not client.push(payload):
             client.close()
             client = None

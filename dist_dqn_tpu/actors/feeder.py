@@ -35,6 +35,7 @@ from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
                                            encode_arrays)
 from dist_dqn_tpu.telemetry import (get_registry,
                                     maybe_install_snapshot_from_env)
+from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
 
 #: records pre-encoded per feeder; cycled round-robin while pumping.
 POOL_RECORDS = 48
@@ -139,6 +140,15 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
     # measure); the heartbeat gauge is wall-clock of the last loop.
     reg = get_registry()
     maybe_install_snapshot_from_env(tag=f"feeder{actor_id}")
+    # Stall watchdog (ISSUE 4): feeders are separate processes, so each
+    # arms its OWN watchdog from DQN_FORENSICS_DIR (set by the parent's
+    # --forensics-dir) and beats a per-process stage heartbeat on the
+    # same cadence as the liveness gauge below.
+    tm_watchdog.maybe_install_from_env()
+    # Startup grace: the first beat waits on the service's hello reply,
+    # which waits on its first act-program compile.
+    hb = tm_watchdog.heartbeat(
+        "feeder.pump", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
     labels = {"actor": str(actor_id)}
     c_records = reg.counter("dqn_feeder_records_total",
                             "records pushed into the shm ring", labels)
@@ -179,10 +189,12 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
                 stop = os.path.exists(stop_path)
                 c_records.inc(256)
                 g_heartbeat.set(time.time())
+                hb.beat()
         else:
             # Ring full: the service is the bottleneck (that is the
             # point of the measurement) — yield briefly and retry.
             c_full.inc()
             g_heartbeat.set(time.time())
+            hb.beat()
             time.sleep(0.0005)
             stop = os.path.exists(stop_path)

@@ -181,7 +181,8 @@ class MultihostLearner:
                 result["err"] = e
 
         timeout_s = float(os.environ.get("DQN_AGREE_TIMEOUT_S", "600"))
-        worker = threading.Thread(target=collective, daemon=True)
+        worker = threading.Thread(target=collective, name="mh-agree",
+                                  daemon=True)
         worker.start()
         # <= 0 means "no timeout" (block forever, the pre-fix behavior).
         worker.join(timeout_s if timeout_s > 0 else None)

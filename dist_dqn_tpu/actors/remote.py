@@ -40,7 +40,22 @@ def main():
                              "text) on this port; 0 = ephemeral. Worker "
                              "hosts are scraped independently of the "
                              "learner (docs/observability.md)")
+    parser.add_argument("--forensics-dir", default=None,
+                        help="arm this worker's stall watchdog: a wedged "
+                             "step loop dumps a forensics bundle (named "
+                             "thread stacks, flight-recorder tail, "
+                             "registry snapshot, manifest) under this "
+                             "directory and flips the worker's /healthz "
+                             "to 503 (docs/observability.md runbook)")
     args = parser.parse_args()
+    if args.forensics_dir:
+        # Through the environment so the watchdog arms in the same place
+        # spawned workers arm theirs (actors/actor.py _actor_telemetry).
+        # Plain assignment: an explicit flag overrides whatever the
+        # supervisor exported (same precedence as train.py's).
+        import os
+
+        os.environ["DQN_FORENSICS_DIR"] = args.forensics_dir
     if args.telemetry_port is not None:
         from dist_dqn_tpu import telemetry
         server = telemetry.start_server(args.telemetry_port)

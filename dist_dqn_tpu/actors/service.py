@@ -40,6 +40,7 @@ from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing, shm_dir,
 from dist_dqn_tpu.config import ExperimentConfig
 from dist_dqn_tpu.replay.host import pad_pow2
 from dist_dqn_tpu.telemetry import collectors as tmc, get_registry
+from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
 from dist_dqn_tpu.utils.metrics import MetricLogger
 
 _PRIO_CHUNK = 256
@@ -1237,6 +1238,16 @@ class ApexLearnerService:
         # steps — the operationally honest number for the host loop).
         self._tm_grad_latency.observe(time.perf_counter() - t_dispatch)
         self._last_loss = float(metrics["loss"])
+        # Divergence sentinel (ISSUE 4): every retired step's loss and
+        # grad norm — NaN/Inf dumps a forensics bundle once instead of
+        # the run training on to garbage. Scalars from the step just
+        # materialized above, so no extra device round-trip.
+        grad_norm = metrics.get("grad_norm")
+        tm_watchdog.observe_divergence(
+            loss=self._last_loss,
+            grad_norm=(float(grad_norm) if grad_norm is not None
+                       else None),
+            step=self.grad_steps)
         # Batched priority write-backs (ISSUE 2): accumulate completed
         # steps' (idx, |TD|, gen) and apply them as ONE vectorized
         # sum-tree update — K batch-sized set() calls collapse into one
@@ -1461,12 +1472,25 @@ class ApexLearnerService:
         # env builds, first inference) is not an ingest stall.
         self._last_record = time.perf_counter()
         last_log = time.perf_counter()
+        # Stall-watchdog heartbeats (ISSUE 4; null-safe until the CLI
+        # arms --forensics-dir): "apex.ingest" proves the drain/act half
+        # of the loop is turning over, "apex.learner" the train half. A
+        # loop pass wedged inside a device call, a transport lock or the
+        # sum tree leaves BOTH stale and the forensics stacks show where.
+        # Startup grace covers the first pass's jit compiles; a compile
+        # outliving grace + deadline is the wedged-tunnel hang.
+        hb_ingest = tm_watchdog.heartbeat(
+            "apex.ingest", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+        hb_learner = tm_watchdog.heartbeat(
+            "apex.learner", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
         try:
             while self._progress() < self.rt.total_env_steps:
                 drained = self._drain_transports()
                 self._flush_act_queue()
                 self._flush_pending()
+                hb_ingest.beat()
                 self._maybe_train()
+                hb_learner.beat()
                 if self._ckpt is not None:
                     if self._ckpt.maybe_save(self._progress(), self.state):
                         self._save_replay_snapshot()
@@ -1536,6 +1560,8 @@ class ApexLearnerService:
                 self._ckpt.close()
                 self._save_replay_snapshot()
         finally:
+            hb_ingest.close()
+            hb_learner.close()
             self.tracer.close()
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,

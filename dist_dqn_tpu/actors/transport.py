@@ -325,7 +325,7 @@ class TcpRecordServer:
                                   "live remote-actor connections")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        name="tcp-accept", daemon=True)
         self._thread.start()
 
     def _accept_loop(self):
@@ -343,6 +343,7 @@ class TcpRecordServer:
                 self._conns[conn_id] = conn
                 self._g_conns.set(len(self._conns))
             threading.Thread(target=self._serve, args=(conn_id, conn),
+                             name=f"tcp-serve-{conn_id}",
                              daemon=True).start()
 
     def _serve(self, conn_id: int, conn: socket.socket):

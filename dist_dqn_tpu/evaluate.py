@@ -291,10 +291,34 @@ def main():
                              "(params only, no optimizer state — the "
                              "deploy artifact; JAX-env surface, newest/"
                              "single step)")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        help="serve this process's telemetry registry "
+                             "(/metrics, /metrics.json, /healthz, "
+                             "/debug/*) on this port; 0 binds an "
+                             "ephemeral port (reported as a "
+                             "telemetry_port log line) — eval runs are "
+                             "scrapable exactly like train runs "
+                             "(docs/observability.md)")
+    parser.add_argument("--telemetry-snapshot", default=None,
+                        help="dump a JSON snapshot of the telemetry "
+                             "registry to this path at exit (offline "
+                             "runs; same data as /metrics.json)")
     args = parser.parse_args()
     if args.export_params and (args.all_steps or args.host_env):
         parser.error("--export-params applies to the single-point JAX-env "
                      "surface (not --all-steps or --host-env)")
+    # Telemetry surface parity with the train CLI (ISSUE 4 satellite):
+    # eval processes populate the same registry (checkpoint restore
+    # spans, env steps), so expose the same scrape/snapshot knobs.
+    if args.telemetry_snapshot:
+        from dist_dqn_tpu.telemetry import install_snapshot_dump
+
+        install_snapshot_dump(args.telemetry_snapshot)
+    if args.telemetry_port is not None:
+        from dist_dqn_tpu import telemetry
+
+        _srv = telemetry.start_server(args.telemetry_port)
+        print(json.dumps({"telemetry_port": _srv.port}))
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     try:

@@ -166,6 +166,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
     from dist_dqn_tpu.telemetry import collectors as tmc, get_registry
+    from dist_dqn_tpu.telemetry import flight as tm_flight
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
 
     # Honest-unsupported-surface gates (ADVICE r5): this loop builds the
     # FEED-FORWARD actor/learner and samples the ring uniformly. A
@@ -258,6 +260,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         worker = EvacuationWorker(evacuator, ring_append,
                                   name="host_replay")
 
+    # Crash forensics (ISSUE 4): per-stage heartbeats (the evacuation
+    # stage's heartbeat lives inside EvacuationWorker as
+    # "evac.host_replay") + per-chunk flight events; the divergence
+    # sentinel sees every train event's loss and the end-of-run param
+    # checksum. All null-safe no-ops until the CLI arms them
+    # (--forensics-dir / --no-flight-recorder, train.py). Startup grace
+    # covers the first-chunk jit compile; a compile outliving it is the
+    # wedged-tunnel hang and trips with its stack on record.
+    fr = tm_flight.get_flight()
+    hb_collect = tm_watchdog.heartbeat(
+        "host_replay.collect",
+        startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+    hb_train = tm_watchdog.heartbeat(
+        "host_replay.train", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+
     reg = get_registry()
     _labels = {"loop": "host_replay"}
     g_overlap = reg.gauge(tmc.HOST_REPLAY_OVERLAP,
@@ -310,6 +327,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 if g + 1 < num_chunks:
                     carry, next_records, next_stats = collect_jit(
                         carry, state.params, chunk_iters)
+                hb_collect.beat()
                 t_dispatch = time.perf_counter()
                 # Stage 2 — fence on chunk g's evacuation (submitted
                 # last iteration / at the prologue): its last slice
@@ -347,7 +365,11 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 if g + 1 < num_chunks:
                     carry, next_records, next_stats = collect_jit(
                         carry, state.params, chunk_iters)
+                hb_collect.beat()
             records = next_records
+            fr.record("fence", "host_replay.chunk", chunk=g,
+                      fence_wait_s=round(fence_wait_s, 4),
+                      evac_s=round(evac_s, 4), d2h_bytes=d2h_bytes)
             env_steps += chunk_iters * B
             d2h_bytes_total += d2h_bytes
             fence_wait_total += fence_wait_s
@@ -403,7 +425,10 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 records = None
             if did:
                 jax.block_until_ready(state.params)
+            hb_train.beat()
             t_train = time.perf_counter()
+            fr.record("train", "host_replay.train_event", chunk=g,
+                      grad_steps=did)
 
             # Fused episode-stat fetch (ISSUE 3 satellite): ONE
             # device_get for both scalars, and its wall accounted in
@@ -444,13 +469,19 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             if stager is not None:
                 row["h2d_staged_bytes"] = stager.bytes_staged
             if did:
-                row["loss"] = round(
-                    float(jax.device_get(metrics["loss"])), 4)
+                loss_val = float(jax.device_get(metrics["loss"]))
+                row["loss"] = round(loss_val, 4)
+                # Divergence sentinel (ISSUE 4): a NaN/Inf loss dumps a
+                # forensics bundle instead of training on silently.
+                tm_watchdog.observe_divergence(loss=loss_val,
+                                               step=grad_steps)
             history.append(row)
             log_fn(json.dumps(row))
     finally:
         if worker is not None:
             worker.close()
+        hb_collect.close()
+        hb_train.close()
 
     wall = time.perf_counter() - t_start
     # Pin anchor for the pipelined-vs-serial equivalence test: a cheap
@@ -459,6 +490,15 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     param_checksum = float(sum(
         np.float64(np.sum(np.asarray(leaf, np.float64)))
         for leaf in jax.tree.leaves(jax.device_get(state.params))))
+    # The checksum doubles as the sentinel's divergence signal: NaN/Inf
+    # parameters at run end produce a bundle even when no per-chunk loss
+    # was sampled (e.g. a run that never reached min_fill). Finiteness
+    # only — the sentinel's explosion tracking compares consecutive
+    # observations of ONE run's stream, and this is a once-per-run value
+    # (two runs in one process would cross-compare).
+    if not math.isfinite(param_checksum):
+        tm_watchdog.observe_divergence(param_checksum=param_checksum,
+                                       step=grad_steps)
     n = max(len(overlap_fracs), 1)
     return {
         "env_steps": env_steps, "grad_steps": grad_steps,

@@ -54,6 +54,8 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 from dist_dqn_tpu.telemetry import collectors as tm, get_registry
+from dist_dqn_tpu.telemetry import flight as tm_flight
+from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
 
 
 class DoubleBufferedStager:
@@ -340,6 +342,14 @@ class EvacuationWorker:
         self._on_slice = on_slice
         self._q: "queue.Queue" = queue.Queue()
         self._exc: Optional[BaseException] = None
+        # Stall-watchdog heartbeat (ISSUE 4): beaten per queue wake and
+        # per published slice, so a worker wedged inside a transfer wait
+        # or a ring append goes stale and the forensics stacks name the
+        # "evac-<name>" thread. Idle is healthy: the drain loop wakes on
+        # a queue timeout and beats even with nothing to do.
+        self._hb = tm_watchdog.heartbeat(f"evac.{name}")
+        self._flight = tm_flight.get_flight()
+        self._name = name
         labels = {"loop": name}
         reg = get_registry()
         self._h_evac = reg.histogram(
@@ -361,41 +371,71 @@ class EvacuationWorker:
         if not self._thread.is_alive():
             raise RuntimeError("evacuation worker is closed")
         job = self._evac.start(records)
+        self._flight.record("queue", f"evac.{self._name}.submit",
+                            slices=len(job.bounds))
         self._q.put(job)
         return job
 
+    def _get_beating(self):
+        """Queue pop that beats the heartbeat while idle (an empty queue
+        is healthy; a worker stuck mid-drain is the stall). The wake
+        period stays well under the stage's deadline, or idling BETWEEN
+        beats would itself read as a stall."""
+        timeout = min(1.0, self._hb.deadline_s / 4.0)
+        while True:
+            self._hb.beat()
+            try:
+                return self._q.get(timeout=timeout)
+            except queue.Empty:
+                continue
+
     def _run(self) -> None:
         while True:
-            job = self._q.get()
+            job = self._get_beating()
             if job is None:
+                self._hb.close()
                 return
             try:
                 t0 = job.submitted_at
 
                 def _lag(_i):
                     self._h_lag.observe(time.perf_counter() - t0)
+                    self._hb.beat()
 
                 stats = self._evac.drain(job, self._on_slice,
                                          on_slice_done=_lag)
                 self._h_evac.observe(stats["evac_s"])
+                self._flight.record("queue", f"evac.{self._name}.drained",
+                                    slices=stats["slices"],
+                                    bytes=stats["bytes"],
+                                    evac_s=round(stats["evac_s"], 4))
                 job._finish(stats)
             except BaseException as e:  # propagate, never hang the fence
                 self._exc = e
+                self._flight.record("queue", f"evac.{self._name}.failed",
+                                    error=f"{type(e).__name__}: {e}")
                 job._fail(e)
                 # Stay alive as a tombstone: every job already queued or
                 # racing a submit() past the _exc check fails immediately
                 # instead of stranding its fence. close() still exits.
+                # Tombstone passes still beat — a DEAD worker re-raises
+                # loudly from submit()/wait(); the watchdog hunts the
+                # silent kind.
                 while True:
-                    pending = self._q.get()
+                    pending = self._get_beating()
                     if pending is None:
+                        self._hb.close()
                         return
                     pending._fail(e)
 
     def close(self) -> None:
         """Stop the worker and join. Queued jobs finish first; after a
-        worker death this returns immediately (the thread is gone)."""
+        worker death this returns immediately (the thread is gone). The
+        stage heartbeat deregisters with the thread — a closed worker is
+        not a stall."""
         self._q.put(None)
         self._thread.join()
+        self._hb.close()
 
     @property
     def failed(self) -> Optional[BaseException]:

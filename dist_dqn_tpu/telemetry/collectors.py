@@ -59,6 +59,19 @@ HOST_REPLAY_SLICE_LAG_SECONDS = "dqn_host_replay_slice_lag_seconds"
 HOST_REPLAY_FENCE_WAIT_SECONDS = "dqn_host_replay_fence_wait_seconds"
 HOST_REPLAY_OVERLAP = "dqn_host_replay_evac_overlap_frac"
 
+# Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
+# heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
+# (the full stage table is in docs/observability.md), divergence trips
+# {signal="loss_nonfinite"|...}, bundles {trigger="watchdog_stall"|
+# "divergence_*"}.
+WATCHDOG_STALLS = "dqn_watchdog_stalls_total"
+WATCHDOG_HEARTBEAT_AGE = "dqn_watchdog_heartbeat_age_seconds"
+WATCHDOG_STAGES = "dqn_watchdog_stages"
+DIVERGENCE_TRIPS = "dqn_divergence_trips_total"
+FORENSICS_BUNDLES = "dqn_forensics_bundles_total"
+FLIGHT_EVENTS = "dqn_flight_events"
+FLIGHT_CAPACITY = "dqn_flight_capacity"
+
 #: Fan-in histogram buckets: powers of two from a single-lane record up
 #: to the largest plausible burst (hundreds of actors x lanes).
 FANIN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,

@@ -1,16 +1,27 @@
-"""Prometheus scrape endpoint: a stdlib http.server on a daemon thread.
+"""Prometheus scrape endpoint + debug surface: a stdlib http.server on a
+daemon thread.
 
 ``TelemetryServer(port=0)`` binds an ephemeral port (the bound port is on
 ``.port``) and serves
 
   * ``/metrics``      — Prometheus text exposition (scrape this)
   * ``/metrics.json`` — the JSON snapshot (same data, offline tooling)
-  * ``/healthz``      — liveness probe (always ``ok``)
+  * ``/healthz``      — liveness probe. WATCHDOG-BACKED (ISSUE 4): when
+    a stall watchdog is installed (telemetry/watchdog.py) and any stage
+    heartbeat is past its deadline, this returns **503** with the stale
+    stages as JSON — so the same probe a balancer polls also says WHICH
+    pipeline stage wedged. Without a watchdog it stays the static
+    ``ok`` it always was.
+  * ``/debug/stacks`` — every live thread's Python stack, by thread
+    NAME (what you'd get from a forensics bundle's stacks.txt, live)
+  * ``/debug/flight`` — the flight recorder's event tail as JSON
+  * ``/debug/config`` — the run manifest (git sha, versions, config
+    hash/dict, argv; telemetry/manifest.py) of this process
 
 The handler renders under the registry's own locks, so a scrape never
 blocks the training hot path for more than an instrument read. Loopback
-by default — the metric surface is unauthenticated, same posture as the
-TCP record listener (actors/service.py).
+by default — the metric/debug surface is unauthenticated, same posture
+as the TCP record listener (actors/service.py).
 """
 from __future__ import annotations
 
@@ -19,9 +30,23 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from dist_dqn_tpu.telemetry import flight as flight_mod
+from dist_dqn_tpu.telemetry import manifest as manifest_mod
+from dist_dqn_tpu.telemetry import watchdog as watchdog_mod
 from dist_dqn_tpu.telemetry.exposition import (CONTENT_TYPE,
                                                render_prometheus, snapshot)
 from dist_dqn_tpu.telemetry.registry import Registry, get_registry
+
+
+def _healthz_body():
+    """(status, body): 200 ``ok`` when nothing armed reports trouble;
+    503 + JSON naming stale stages and/or latched divergence signals
+    otherwise (telemetry/watchdog.py ``health_state``)."""
+    ok, detail = watchdog_mod.health_state()
+    if ok:
+        return 200, b"ok\n"
+    return 503, (json.dumps({"status": "unhealthy", **detail},
+                            sort_keys=True) + "\n").encode()
 
 
 class TelemetryServer:
@@ -32,6 +57,7 @@ class TelemetryServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path in ("/metrics", "/"):
                     body = render_prometheus(registry).encode()
                     ctype = CONTENT_TYPE
@@ -40,11 +66,25 @@ class TelemetryServer:
                             + "\n").encode()
                     ctype = "application/json"
                 elif path == "/healthz":
-                    body, ctype = b"ok\n", "text/plain"
+                    status, body = _healthz_body()
+                    ctype = ("text/plain" if status == 200
+                             else "application/json")
+                elif path == "/debug/stacks":
+                    body = watchdog_mod.format_stacks().encode()
+                    ctype = "text/plain"
+                elif path == "/debug/flight":
+                    body = (json.dumps(flight_mod.get_flight().snapshot())
+                            + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/debug/config":
+                    man = manifest_mod.get_run_manifest()
+                    body = (json.dumps(man if man is not None else {},
+                                       sort_keys=True) + "\n").encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -83,6 +83,17 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     # exist from the first scrape even before the first chunk lands.
     from dist_dqn_tpu import telemetry
     from dist_dqn_tpu.telemetry import collectors as tmc
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
+    # Crash forensics (ISSUE 4; null-safe no-ops until --forensics-dir /
+    # --no-flight-recorder arm or disarm them): a per-chunk stage
+    # heartbeat, a per-chunk flight event, and the divergence sentinel
+    # on every chunk's loss. Registered WITH startup grace: the first
+    # chunk carries the jit compile, whose legitimate wall must not read
+    # as a stall — but a compile that outlives grace + deadline is the
+    # classic wedged-tunnel hang and trips with its stack on record.
+    _flight = telemetry.get_flight()
+    _hb_chunk = tm_watchdog.heartbeat(
+        "fused.chunk", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
     _reg = telemetry.get_registry()
     _tm = {
         "env_steps": _reg.counter(tmc.ENV_STEPS, "env frames processed"),
@@ -212,71 +223,84 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     # Trace the second chunk (the first is compile+warmup noise) — unless
     # the whole run fits in one chunk, then trace that one rather than none.
     profile_chunk = 1 if total > frames + chunk_iters * B else 0
-    while frames < total:
-        profiling = profile_dir is not None and chunk_index == profile_chunk
-        if profiling:
-            jax.profiler.start_trace(profile_dir)
-        t0 = time.perf_counter()
-        carry, metrics = run(carry, chunk_iters)
-        metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
-        dt = time.perf_counter() - t0
-        if profiling:
-            jax.profiler.stop_trace()
-            log_fn(json.dumps({"profile_trace": profile_dir}))
-        chunk_index += 1
-        prev_frames = frames
-        frames = frame_offset + int(metrics["env_frames"])
-        grad_steps_chunk = float(metrics["grad_steps_in_chunk"])
-        frames_delta = max(frames - prev_frames, 0)
-        _tm["env_steps"].inc(frames_delta)
-        # Global frames over wall time — under a mesh the chunk covers
-        # num_shards * chunk_iters * B frames, so chunk_iters * B / dt
-        # (the per-process log row) would under-report by the shard count.
-        _tm["env_rate"].set(frames_delta / dt)
-        _tm["grad_steps"].inc(grad_steps_chunk)
-        _tm["chunk"].observe(dt)
-        # Host-visible params refresh once per chunk boundary, so the
-        # chunk wall bounds their staleness; grad-step latency is the
-        # per-step share of the fused chunk (the steps run inside one
-        # XLA program — there is no finer host-observable boundary).
-        _tm["staleness"].observe(dt)
-        if grad_steps_chunk:
-            _tm["grad_latency"].observe(dt / grad_steps_chunk)
-        _tm["loss"].set(float(metrics["loss"]))
-        _tm["episodes"].inc(max(float(metrics["episodes"]), 0.0))
-        if float(metrics["episodes"]):
-            _tm["ep_return"].set(float(metrics["episode_return"]))
-        tmc.observe_device_ring(carry.replay)
-        row = {
-            "env_frames": frames,
-            "episode_return": float(metrics["episode_return"]),
-            # Disambiguates episode_return's no-episodes sentinel (0.0
-            # with episodes == 0) from a genuine 0.0 average return.
-            "episodes": float(metrics["episodes"]),
-            "loss": float(metrics["loss"]),
-            "env_steps_per_sec": chunk_iters * B / dt,
-            "grad_steps_in_chunk": float(metrics["grad_steps_in_chunk"]),
-            "grad_steps_per_sec": float(metrics["grad_steps_in_chunk"]) / dt,
-        }
-        if frames >= next_eval:
-            # Every process consumes k_eval so rng streams stay in
-            # lockstep even where run_eval is None (non-logging processes).
-            rng, k_eval = jax.random.split(rng)
-            if run_eval is not None:
-                row["eval_return"] = run_eval(carry.learner.params, k_eval)
-            next_eval = frames + cfg.eval_every_steps
-        history.append(row)
-        log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
-                           for k, v in row.items()}))
-        if ckpt is not None:
-            ckpt.maybe_save(frames,
-                            carry if checkpoint_replay else carry.learner)
-        # Early stop (single-process only: a data-dependent exit would
-        # desync multi-process lockstep): stop_fn sees each metric row —
-        # solve-detection for tests, target-return stops for users.
-        if stop_fn is not None and jax.process_count() == 1 \
-                and stop_fn(row):
-            break
+    try:
+        while frames < total:
+            profiling = (profile_dir is not None
+                         and chunk_index == profile_chunk)
+            if profiling:
+                jax.profiler.start_trace(profile_dir)
+            t0 = time.perf_counter()
+            carry, metrics = run(carry, chunk_iters)
+            metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
+            dt = time.perf_counter() - t0
+            if profiling:
+                jax.profiler.stop_trace()
+                log_fn(json.dumps({"profile_trace": profile_dir}))
+            chunk_index += 1
+            prev_frames = frames
+            frames = frame_offset + int(metrics["env_frames"])
+            grad_steps_chunk = float(metrics["grad_steps_in_chunk"])
+            frames_delta = max(frames - prev_frames, 0)
+            _tm["env_steps"].inc(frames_delta)
+            # Global frames over wall time — under a mesh the chunk covers
+            # num_shards * chunk_iters * B frames, so chunk_iters * B / dt
+            # (the per-process log row) would under-report by the shard count.
+            _tm["env_rate"].set(frames_delta / dt)
+            _tm["grad_steps"].inc(grad_steps_chunk)
+            _tm["chunk"].observe(dt)
+            # Host-visible params refresh once per chunk boundary, so the
+            # chunk wall bounds their staleness; grad-step latency is the
+            # per-step share of the fused chunk (the steps run inside one
+            # XLA program — there is no finer host-observable boundary).
+            _tm["staleness"].observe(dt)
+            if grad_steps_chunk:
+                _tm["grad_latency"].observe(dt / grad_steps_chunk)
+            _hb_chunk.beat()
+            _loss = float(metrics["loss"])
+            _flight.record("chunk", "fused.chunk", frames=frames,
+                           loss=_loss, wall_s=round(dt, 4))
+            tm_watchdog.observe_divergence(loss=_loss, step=frames)
+            _tm["loss"].set(_loss)
+            _tm["episodes"].inc(max(float(metrics["episodes"]), 0.0))
+            if float(metrics["episodes"]):
+                _tm["ep_return"].set(float(metrics["episode_return"]))
+            tmc.observe_device_ring(carry.replay)
+            row = {
+                "env_frames": frames,
+                "episode_return": float(metrics["episode_return"]),
+                # Disambiguates episode_return's no-episodes sentinel (0.0
+                # with episodes == 0) from a genuine 0.0 average return.
+                "episodes": float(metrics["episodes"]),
+                "loss": float(metrics["loss"]),
+                "env_steps_per_sec": chunk_iters * B / dt,
+                "grad_steps_in_chunk": float(metrics["grad_steps_in_chunk"]),
+                "grad_steps_per_sec":
+                    float(metrics["grad_steps_in_chunk"]) / dt,
+            }
+            if frames >= next_eval:
+                # Every process consumes k_eval so rng streams stay in
+                # lockstep even where run_eval is None (non-logging processes).
+                rng, k_eval = jax.random.split(rng)
+                if run_eval is not None:
+                    row["eval_return"] = run_eval(carry.learner.params, k_eval)
+                next_eval = frames + cfg.eval_every_steps
+            history.append(row)
+            log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
+                               for k, v in row.items()}))
+            if ckpt is not None:
+                ckpt.maybe_save(frames,
+                                carry if checkpoint_replay else carry.learner)
+            # Early stop (single-process only: a data-dependent exit would
+            # desync multi-process lockstep): stop_fn sees each metric row —
+            # solve-detection for tests, target-return stops for users.
+            if stop_fn is not None and jax.process_count() == 1 \
+                    and stop_fn(row):
+                break
+    finally:
+        # Deregistered even when the loop raises: a leaked
+        # heartbeat would read as a permanent stall in a
+        # process that caught the exception and lived on.
+        _hb_chunk.close()
     if ckpt is not None:
         ckpt.save(frames, carry if checkpoint_replay else carry.learner)
         ckpt.close()
@@ -360,6 +384,34 @@ def main():
                         help="dump a JSON snapshot of the telemetry "
                              "registry to this path at exit (offline "
                              "runs; same data as /metrics.json)")
+    parser.add_argument("--forensics-dir", default=None,
+                        help="arm the stall watchdog + divergence "
+                             "sentinel (telemetry/watchdog.py): a "
+                             "pipeline stage missing its heartbeat "
+                             "deadline, or a NaN/Inf loss, dumps a "
+                             "forensics bundle (named thread stacks, "
+                             "flight-recorder tail, registry snapshot, "
+                             "run manifest) under this directory and "
+                             "flips /healthz to 503. Exported as "
+                             "DQN_FORENSICS_DIR so spawned actor/feeder "
+                             "processes arm their own. See the "
+                             "'debugging a hang' runbook in "
+                             "docs/observability.md")
+    parser.add_argument("--watchdog-deadline-s", type=float, default=120.0,
+                        help="heartbeat staleness that counts as a stall "
+                             "(per stage; requires --forensics-dir)")
+    parser.add_argument("--watchdog-abort", action="store_true",
+                        help="after dumping the forensics bundle, "
+                             "SIGTERM the process (graceful: telemetry "
+                             "flush + device-grant release chain off "
+                             "SIGTERM) with a bounded hard-exit "
+                             "fallback — for supervisors that restart "
+                             "on exit rather than scrape /healthz")
+    parser.add_argument("--no-flight-recorder", action="store_true",
+                        help="disable the in-memory flight-recorder "
+                             "ring (telemetry/flight.py; ~1µs/event "
+                             "when on). Forensics bundles and "
+                             "/debug/flight then carry no event tail")
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu, tpu); "
                              "overrides site-level platform selection")
@@ -427,6 +479,24 @@ def main():
     if args.telemetry_snapshot:
         from dist_dqn_tpu.telemetry import install_snapshot_dump
         install_snapshot_dump(args.telemetry_snapshot)
+    import os as _os
+    import sys as _sys
+    if args.no_flight_recorder:
+        # Before any loop wires its recorder reference, and through the
+        # environment so spawned actor/feeder processes disable theirs.
+        from dist_dqn_tpu.telemetry import flight as _flight_mod
+        _os.environ["DQN_FLIGHT_RECORDER"] = "0"
+        _flight_mod.configure(enabled=False)
+    if args.forensics_dir:
+        from dist_dqn_tpu.telemetry import watchdog as _wd
+        _os.environ["DQN_FORENSICS_DIR"] = args.forensics_dir
+        _os.environ["DQN_WATCHDOG_DEADLINE_S"] = \
+            str(args.watchdog_deadline_s)
+        _wd.install_watchdog(forensics_dir=args.forensics_dir,
+                             deadline_s=args.watchdog_deadline_s,
+                             abort=args.watchdog_abort)
+        _wd.install_sentinel(forensics_dir=args.forensics_dir,
+                             abort=args.watchdog_abort)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     if args.coordinator:
@@ -443,6 +513,13 @@ def main():
         # truthiness test here silently fell back to the config period.
         import dataclasses as _dc
         cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)
+    # Run manifest (ISSUE 4 satellite): one provenance line per run —
+    # git sha, versions, config hash, argv — reused verbatim by the
+    # forensics bundles and served at /debug/config.
+    from dist_dqn_tpu.telemetry import manifest as _manifest
+    _man = _manifest.build_manifest(cfg, argv=_sys.argv)
+    _manifest.set_run_manifest(_man)
+    print(json.dumps({"manifest": _man}))
     if args.runtime == "host-replay":
         # Hybrid fused loop with the replay window in host DRAM
         # (host_replay_loop.py): device env chunks stream transitions

@@ -21,6 +21,15 @@ Chrome trace and the /metrics endpoint tell one consistent story. Flush
 is registered on the shared exit lifecycle (telemetry/lifecycle.py):
 traces from atexit'd or SIGTERM'd processes keep every flushed-plus-
 buffered event instead of silently losing the tail.
+
+Flight-recorder integration (ISSUE 4): the same span call sites feed the
+process flight ring (telemetry/flight.py) — with a trace path, the full
+``SpanTracer`` mirrors every event there too; WITHOUT one,
+``make_tracer`` now hands back a ``FlightTracer`` (ring-only, no file,
+no per-event serialization) instead of the inert ``NullTracer``, so a
+hung service's forensics bundle carries its last ~thousand host-loop
+events even when nobody asked for a Chrome trace up front. The
+``NullTracer`` remains the true zero path (``--no-flight-recorder``).
 """
 from __future__ import annotations
 
@@ -62,6 +71,38 @@ class NullTracer:
         pass
 
 
+class FlightTracer(NullTracer):
+    """Span surface that records ONLY into the flight-recorder ring.
+
+    The default tracer when Chrome tracing is off but the flight
+    recorder is on: one ``record()`` per span close / instant / counter
+    (~1µs), no buffering, no file. ``enabled`` stays False — callers
+    that gate EXPENSIVE argument computation on ``tracer.enabled`` keep
+    skipping it; the ring gets the cheap events.
+    """
+
+    def __init__(self, flight=None):
+        self._flight = (flight if flight is not None
+                        else telemetry.get_flight())
+
+    @contextmanager
+    def span(self, name: str, **args):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._flight.record(
+                "span", name,
+                dur_s=round(time.perf_counter() - start, 6),
+                **args)
+
+    def instant(self, name: str, **args) -> None:
+        self._flight.record("instant", name, **args)
+
+    def counter(self, name: str, value: float) -> None:
+        self._flight.record("counter", name, value=float(value))
+
+
 class SpanTracer(NullTracer):
     """Chrome trace-event recorder for one host process.
 
@@ -88,6 +129,7 @@ class SpanTracer(NullTracer):
         self._closed = False
         self.registry = (registry if registry is not None
                          else telemetry.get_registry())
+        self._flight = telemetry.get_flight()
         self._span_hists: Dict[str, object] = {}
         self._counter_gauges: Dict[str, object] = {}
         # Shared flush lifecycle: a SIGTERM'd/atexit'd process keeps its
@@ -118,11 +160,15 @@ class SpanTracer(NullTracer):
                     ("X", name, start, end - start,
                      threading.get_ident(), args or None))
             self._span_hist(name).observe((end - start) / 1e6)
+            self._flight.record("span", name,
+                                dur_s=round((end - start) / 1e6, 6),
+                                **(args or {}))
 
     def instant(self, name: str, **args) -> None:
         with self._lock:
             self._events.append(("i", name, self._now_us(), 0.0,
                                  threading.get_ident(), args or None))
+        self._flight.record("instant", name, **args)
 
     def counter(self, name: str, value: float) -> None:
         with self._lock:
@@ -193,8 +239,12 @@ class SpanTracer(NullTracer):
 
 def make_tracer(trace_path: Optional[str],
                 process_name: str = "dist_dqn_tpu"):
-    """Tracer factory: a real SpanTracer when a path is given, else the
-    no-op twin."""
+    """Tracer factory: a real SpanTracer when a path is given; the
+    flight-ring-only tracer when the flight recorder is on (the default
+    — ISSUE 4); the inert twin when both are off."""
     if trace_path:
         return SpanTracer(trace_path, process_name=process_name)
+    flight = telemetry.get_flight()
+    if flight.enabled:
+        return FlightTracer(flight)
     return NullTracer()

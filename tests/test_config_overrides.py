@@ -86,9 +86,14 @@ def test_train_cli_honors_set(tmp_path, capsys):
     rows = [json.loads(line) for line in
             capsys.readouterr().out.splitlines()
             if line.startswith("{")]
+    # The CLI's first JSON line is the run manifest (ISSUE 4) — and it
+    # must fingerprint the OVERRIDDEN config, not the preset.
+    assert rows and rows[0]["manifest"]["config"]["actor"][
+        "num_envs"] == 4
     # 4 env lanes (not the preset's 16): 150-iter chunks advance 600
     # frames each.
-    assert rows and rows[0]["env_frames"] == 600
+    metric_rows = [r for r in rows if "env_frames" in r]
+    assert metric_rows and metric_rows[0]["env_frames"] == 600
 
 
 def test_train_cli_eval_zero_disables_without_save_churn(tmp_path, capsys):
