@@ -9,17 +9,24 @@ the module docstring of host_replay_loop.py carries the TPU-VM link
 model (~10 GB/s => ~1.4M deduped env-steps/s admissible), and the
 byte columns this bench emits are what make that model checkable.
 
-``--ab`` (ISSUE 3) runs the pipelined runtime against its
-``--no-pipeline`` serial reference at the SAME sizes in one process
-(compiles cached between the legs) and emits a ``trace_ab`` row —
-steady rates, speedup, D2H byte conservation, and the numerics pin
-(identical ``param_checksum``) — the same before/after discipline as
+``--ab`` (ISSUE 3, re-armed for ISSUE 5's sample side) runs THREE legs
+at the SAME sizes in one process (compiles cached between them):
+uniform sampling with the serial sample-in-loop path
+(``--no-prefetch``), uniform sampling with the background
+SamplePrefetcher, and prioritized (PER) sampling with the prefetcher.
+The ``trace_ab`` row carries the steady rates and speedups, the
+prefetch overlap accounting (``sample_s`` measured off the critical
+path: the prefetch leg's ``prefetch_wait_s`` against the serial leg's
+``sample_s``), D2H byte conservation across all legs, the PER leg's
+write-back volume + IS-weight sanity, and the uniform numerics pin
+(serial and prefetched legs must produce an identical
+``param_checksum``) — the same before/after discipline as
 ``apex_feeder_bench --trace``. tests/test_host_replay_pipeline.py runs
 it as a tier-1 CPU smoke so the A/B harness cannot bit-rot.
 
 Usage: python benchmarks/host_replay_bench.py [--allow-cpu] [--ab]
            [--lanes 64] [--chunks 10] [--chunk-iters 100]
-           [--evac-slices 4] [--no-pipeline]
+           [--evac-slices 4] [--no-pipeline] [--no-prefetch] [--per]
 """
 from __future__ import annotations
 
@@ -53,6 +60,9 @@ def _steady_fields(out) -> dict:
         "steady_evac_overlap_frac": steady.get("evac_overlap_frac"),
         "steady_train_s": steady.get("chunk_train_s"),
         "steady_collect_fetch_s": steady.get("chunk_collect_fetch_s"),
+        "steady_sample_s": steady.get("sample_s"),
+        "steady_prefetch_wait_s": steady.get("prefetch_wait_s"),
+        "steady_prefetch_depth": steady.get("prefetch_depth"),
     }
 
 
@@ -68,10 +78,20 @@ def main() -> int:
                    help="measure the serial monolithic-evacuation "
                         "reference instead of the pipelined runtime")
     p.add_argument("--evac-slices", type=int, default=4)
+    p.add_argument("--no-prefetch", action="store_true",
+                   help="measure the serial sample-in-loop reference "
+                        "instead of the background SamplePrefetcher")
+    p.add_argument("--prefetch-depth", type=int, default=2)
+    p.add_argument("--per", action="store_true",
+                   help="sample the host window by sum-tree priority "
+                        "(IS weights + batched TD write-backs) instead "
+                        "of uniformly")
     p.add_argument("--ab", action="store_true",
-                   help="run serial AND pipelined at the same sizes and "
-                        "emit a trace_ab comparison row (rates, overlap, "
-                        "byte conservation, numerics pin)")
+                   help="run uniform-serial, uniform-prefetch and "
+                        "PER-prefetch at the same sizes and emit a "
+                        "trace_ab comparison row (rates, prefetch "
+                        "overlap, byte conservation, write-back volume, "
+                        "uniform numerics pin)")
     p.add_argument("--window", type=int, default=1_048_576,
                    help="host-DRAM window in transitions (DRAM-priced: "
                         "1M deduped pixel transitions ~ 0.45 GB/lane-KB)")
@@ -113,13 +133,17 @@ def main() -> int:
     )
     total = args.chunks * args.chunk_iters * args.lanes
 
-    def _measure(pipeline: bool):
+    def _measure(pipeline: bool, prefetch: bool = True,
+                 per: bool = False):
         t0 = time.perf_counter()
         out = run_host_replay(cfg, total_env_steps=total,
                               chunk_iters=args.chunk_iters,
                               log_fn=lambda s: print(s, flush=True),
                               pipeline=pipeline,
-                              evac_slices=args.evac_slices)
+                              evac_slices=args.evac_slices,
+                              prefetch=prefetch,
+                              prefetch_depth=args.prefetch_depth,
+                              prioritized=per)
         return out, time.perf_counter() - t0
 
     def _row(out, wall, **extra):
@@ -139,46 +163,85 @@ def main() -> int:
 
     if args.ab:
         # Each leg builds its own jit wrappers (run_host_replay creates
-        # fresh closures), so both pay compiles — the headline speedup
-        # therefore compares the STEADY last-chunk rates, which exclude
-        # compile wall by construction; the whole-run rates are emitted
-        # beside them for the compile-inclusive picture.
-        out_a, wall_a = _measure(pipeline=False)
-        _emit(_row(out_a, wall_a, phase="ab_serial"))
-        out_b, wall_b = _measure(pipeline=True)
-        _emit(_row(out_b, wall_b, phase="ab_pipelined"))
-        steady_a = out_a["history"][-1]["env_steps_per_sec"] \
-            if out_a["history"] else out_a["env_steps_per_sec"]
-        steady_b = out_b["history"][-1]["env_steps_per_sec"] \
-            if out_b["history"] else out_b["env_steps_per_sec"]
+        # fresh closures), so every leg pays compiles — the headline
+        # speedups therefore compare the STEADY last-chunk rates, which
+        # exclude compile wall by construction; the whole-run rates are
+        # emitted beside them for the compile-inclusive picture. The
+        # D2H axis stays pipelined in all three legs (ISSUE 3's
+        # serial-vs-pipelined pin lives in
+        # tests/test_host_replay_pipeline.py); the A/B axis here is the
+        # SAMPLE side: serial sample-in-loop vs prefetched vs
+        # prefetched+prioritized.
+        pipeline = not args.no_pipeline
+        out_a, wall_a = _measure(pipeline, prefetch=False)
+        _emit(_row(out_a, wall_a, phase="ab_uniform_serial"))
+        out_b, wall_b = _measure(pipeline, prefetch=True)
+        _emit(_row(out_b, wall_b, phase="ab_uniform_prefetch"))
+        out_c, wall_c = _measure(pipeline, prefetch=True, per=True)
+        _emit(_row(out_c, wall_c, phase="ab_per_prefetch"))
+
+        def _steady(out):
+            return out["history"][-1]["env_steps_per_sec"] \
+                if out["history"] else out["env_steps_per_sec"]
+
+        steady_a, steady_b, steady_c = (_steady(out_a), _steady(out_b),
+                                        _steady(out_c))
         _emit({
             "bench": "host_replay", "phase": "trace_ab",
             "platforms": platforms, "total_env_steps": total,
             "serial_env_steps_per_sec": steady_a,
-            "pipelined_env_steps_per_sec": steady_b,
+            "prefetch_env_steps_per_sec": steady_b,
+            "per_env_steps_per_sec": steady_c,
             "serial_env_steps_per_sec_avg": out_a["env_steps_per_sec"],
-            "pipelined_env_steps_per_sec_avg": out_b["env_steps_per_sec"],
-            "speedup_x": round(steady_b / max(steady_a, 1e-9), 3),
+            "prefetch_env_steps_per_sec_avg": out_b["env_steps_per_sec"],
+            "per_env_steps_per_sec_avg": out_c["env_steps_per_sec"],
+            "speedup_prefetch_x": round(steady_b / max(steady_a, 1e-9),
+                                        3),
+            "speedup_per_x": round(steady_c / max(steady_a, 1e-9), 3),
+            # Prefetch overlap: the serial leg pays sample_s on the
+            # critical path; the prefetch legs pay only the residual
+            # main-thread wait for the background thread.
+            "serial_sample_s_total": out_a["sample_s_total"],
+            "prefetch_sample_s_total": out_b["sample_s_total"],
+            "prefetch_wait_s_total": out_b["prefetch_wait_s_total"],
+            "per_prefetch_wait_s_total": out_c["prefetch_wait_s_total"],
+            "prefetch_overlap_frac": round(
+                max(0.0, 1.0 - out_b["prefetch_wait_s_total"]
+                    / max(out_b["sample_s_total"], 1e-9)), 4),
+            "sample_off_critical_path":
+                out_b["prefetch_wait_s_total"]
+                < out_a["sample_s_total"],
+            "stale_batches": out_b["stale_batches"]
+            + out_c["stale_batches"],
+            # PER leg health: write-backs actually flowed, IS weights
+            # are sane (normalized into (0, 1]).
+            "per_prio_writeback_flushes":
+                out_c["prio_writeback_flushes"],
+            "per_prio_writeback_rows": out_c["prio_writeback_rows"],
+            "per_prio_writeback_dropped":
+                out_c["prio_writeback_dropped"],
+            "per_is_weight_mean": out_c["is_weight_mean"],
+            "per_is_weight_min": out_c["is_weight_min"],
             "d2h_bytes_serial": out_a["d2h_bytes_total"],
-            "d2h_bytes_pipelined": out_b["d2h_bytes_total"],
+            "d2h_bytes_prefetch": out_b["d2h_bytes_total"],
+            "d2h_bytes_per": out_c["d2h_bytes_total"],
             "d2h_bytes_conserved":
-                out_a["d2h_bytes_total"] == out_b["d2h_bytes_total"],
-            "pipelined_evac_overlap_frac_mean":
-                out_b["evac_overlap_frac_mean"],
-            "pipelined_fence_wait_s_total":
-                out_b["evac_fence_wait_s_total"],
-            "serial_evac_wall_share": round(
-                sum(r["evac_s"] for r in out_a["history"])
-                / max(out_a["wall_s"], 1e-9), 4),
+                out_a["d2h_bytes_total"] == out_b["d2h_bytes_total"]
+                == out_c["d2h_bytes_total"],
+            "evac_overlap_frac_mean": out_b["evac_overlap_frac_mean"],
             "serial_param_checksum": out_a["param_checksum"],
-            "pipelined_param_checksum": out_b["param_checksum"],
+            "prefetch_param_checksum": out_b["param_checksum"],
+            # The uniform numerics pin: prefetching may only change
+            # WHEN sampling happens, never what is trained on. (The
+            # PER leg legitimately trains on different batches.)
             "numerics_match":
                 out_a["param_checksum"] == out_b["param_checksum"]
                 and out_a["grad_steps"] == out_b["grad_steps"],
         })
         return 0
 
-    out, wall = _measure(pipeline=not args.no_pipeline)
+    out, wall = _measure(pipeline=not args.no_pipeline,
+                         prefetch=not args.no_prefetch, per=args.per)
     _emit(_row(out, wall))
     return 0
 

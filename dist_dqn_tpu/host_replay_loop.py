@@ -27,6 +27,21 @@ SOFTWARE PIPELINE rather than a serial chunk loop:
   device: train_step (donated state), exactly the learner the fused
           loop runs; sampled batches H2D double-buffered as before
 
+Since ISSUE 5 the H2D side is pipelined too: a SamplePrefetcher thread
+(replay/staging.py — the H2D twin of the EvacuationWorker) runs
+sample -> gather -> pin -> upload ahead of the learner, so train steps
+pop device-resident batches instead of paying host-side sampling on
+the critical path; batch k's RNG is a per-index stream split from the
+seed, so the prefetched and serial paths draw bit-identical batches
+(``prefetch=False`` / --no-prefetch is the pinned serial reference).
+Sampling is also PRIORITIZED now (cfg.replay.prioritized / --per): a
+NativeSumTree shard over the ring's slots, kept in lockstep with the
+ring by the evacuation worker's appends (new chunks seeded at max
+priority, under the generation fence), stratified draws + IS weights,
+and TD-error write-backs batched into one vectorized tree update per
+``prio_writeback_batch`` train steps (PR 2's semantics: chronological
+last-wins + per-slot expected-generation drop).
+
 Throughput model: the link, not HBM, prices the window. Per env step
 the D2H cost is one stored frame; per grad step the H2D cost is one
 batch (2 x batch x obs bytes). On a TPU-VM host link (~10 GB/s) that
@@ -70,6 +85,13 @@ class CollectCarry(NamedTuple):
     rng: Array
     iteration: Array
     ep_return: Array
+
+
+class _UniformTag(NamedTuple):
+    """Uniform-mode sample bookkeeping: just the ring generation the
+    batch was drawn against (the prefetcher's staleness handshake)."""
+
+    generation: int
 
 
 class _ScanCarry(NamedTuple):
@@ -143,12 +165,18 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     env: Optional[JaxEnv] = None,
                     double_buffer: bool = True,
                     pipeline: bool = True,
-                    evac_slices: int = 4):
+                    evac_slices: int = 4,
+                    prefetch: bool = True,
+                    prefetch_depth: int = 2,
+                    prioritized: Optional[bool] = None,
+                    prio_writeback_batch: int = 8):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
     ``cfg.train_every`` env iterations, ``cfg.updates_per_train`` grad
-    steps each, batches sampled uniformly from the host ring.
+    steps each, batches sampled from the host ring — uniformly, or by
+    sum-tree priority when ``prioritized`` (default:
+    ``cfg.replay.prioritized``) is set.
 
     ``pipeline`` selects the three-stage software pipeline (streamed
     sub-chunk evacuation drained by a background worker, trains fenced
@@ -159,9 +187,27 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     they stand BEFORE chunk g's train event), so they are numerically
     IDENTICAL — tests/test_host_replay_pipeline.py pins it.
 
-    ``double_buffer`` stages batch g+1's sample+H2D while step g trains
-    (replay/staging.py); False is the serial H2D reference —
+    ``prefetch`` moves the whole sample -> gather -> stage chain onto a
+    background SamplePrefetcher thread (replay/staging.py); False keeps
+    the sample-in-loop path as the serial reference. Batch RNG streams
+    are split from ``cfg.seed`` per batch INDEX, so the two paths draw
+    bit-identical batches in uniform mode — the ISSUE 5 equivalence
+    pin. PER mode is the one deliberate exception to bit-level
+    reproducibility under prefetch: batch k+1's sum-tree draw races
+    the batched |TD| write-backs of steps <= k on the fence lock, so
+    WHICH priorities a draw sees is timing-dependent (every
+    interleaving is a valid PER schedule — write-backs already lag by
+    up to ``prio_writeback_batch`` steps by design; ``--no-prefetch``
+    PER remains run-to-run deterministic for debugging).
+    ``prefetch_depth`` bounds how many device-resident batches may
+    be staged ahead. With ``prefetch`` the legacy ``double_buffer``
+    knob is moot (the prefetcher owns its own stager); without it,
+    ``double_buffer=False`` is the fully serial H2D reference —
     numerically identical, tests/test_ingest_fastpath.py pins it.
+
+    ``prio_writeback_batch`` batches that many train steps' |TD|
+    write-backs into one vectorized sum-tree update (PER only; 1 =
+    per-step flush), mirroring the apex service's knob.
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
@@ -169,20 +215,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     from dist_dqn_tpu.telemetry import flight as tm_flight
     from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
 
-    # Honest-unsupported-surface gates (ADVICE r5): this loop builds the
-    # FEED-FORWARD actor/learner and samples the ring uniformly. A
-    # recurrent config would silently train the wrong program; a PER
-    # config silently loses its prioritization — say so.
+    # Honest-unsupported-surface gate (ADVICE r5): this loop builds the
+    # FEED-FORWARD actor/learner; a recurrent config would silently
+    # train the wrong program — say so.
     if cfg.network.lstm_size > 0:
         raise ValueError(
             "host-replay runs the feed-forward collect/train split; "
             "recurrent (R2D2, network.lstm_size>0) configs need the "
             "sequence learner — use the apex runtime or the fused loop")
-    if cfg.replay.prioritized:
-        log_fn("# prioritized replay not supported by host-replay; "
-               "sampling uniformly (cfg.replay.prioritized ignored)")
     if evac_slices < 1:
         raise ValueError(f"--evac-slices must be >= 1, got {evac_slices}")
+    if prio_writeback_batch < 1:
+        raise ValueError("prio_writeback_batch must be >= 1, got "
+                         f"{prio_writeback_batch}")
+    per_enabled = (cfg.replay.prioritized if prioritized is None
+                   else prioritized)
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -227,26 +274,72 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     carry = init_collect(k_carry)
     obs_example = jax.tree.map(lambda x: x[0], carry.obs)
     state = init_learner(k_learn, obs_example)
-    host_rng = np.random.default_rng(cfg.seed)
 
-    def sample_host() -> Transition:
-        hb = ring.sample(host_rng, cfg.learner.batch_size,
+    # Prioritized sampling (ISSUE 5): a sum-tree shard over the ring's
+    # slots, kept in lockstep with every append (main thread or
+    # evacuation worker) through the ring's publish hook — under the
+    # same generation fence the samplers hold.
+    per_sampler = None
+    if per_enabled:
+        from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
+        per_sampler = RingPrioritySampler(
+            ring, n_step=cfg.learner.n_step,
+            alpha=cfg.replay.priority_exponent,
+            beta=cfg.replay.importance_exponent,
+            eps=cfg.replay.priority_eps)
+        log_fn("# host-replay sampler: prioritized sum-tree "
+               f"({type(per_sampler.tree).__name__}, "
+               f"alpha={cfg.replay.priority_exponent}, "
+               f"beta={cfg.replay.importance_exponent}, "
+               f"prio_writeback_batch={prio_writeback_batch})")
+    else:
+        log_fn("# host-replay sampler: uniform")
+
+    def _batch_rng(k: int) -> np.random.Generator:
+        # Per-batch-index RNG streams split from the seed: batch k's
+        # content is a pure function of (k, ring window), never of
+        # which thread drew it or when — the property that makes the
+        # prefetched and serial paths bit-identical.
+        return np.random.default_rng(
+            np.random.SeedSequence(cfg.seed, spawn_key=(k,)))
+
+    def sample_host(k: int):
+        """Batch k's host-side sample+gather -> (host pytree, aux)."""
+        rng_k = _batch_rng(k)
+        if per_sampler is not None:
+            hb, aux = per_sampler.sample(rng_k, cfg.learner.batch_size,
+                                         cfg.learner.gamma)
+            tr = Transition(obs=hb.obs, action=hb.action,
+                            reward=hb.reward, discount=hb.discount,
+                            next_obs=hb.next_obs)
+            # IS weights travel WITH the batch through the staging
+            # pipeline, so the upload and the bookkeeping stay one unit.
+            return (tr, aux.weights), aux
+        hs = ring.sample(rng_k, cfg.learner.batch_size,
                          cfg.learner.n_step, cfg.learner.gamma)
-        return Transition(obs=hb.obs, action=hb.action, reward=hb.reward,
-                          discount=hb.discount, next_obs=hb.next_obs)
+        hb = hs.batch
+        tr = Transition(obs=hb.obs, action=hb.action, reward=hb.reward,
+                        discount=hb.discount, next_obs=hb.next_obs)
+        return tr, _UniformTag(generation=hs.generation)
 
-    def put_batch(hb: Transition) -> Transition:
-        return jax.tree.map(jax.device_put, hb)
+    def put_batch(tree):
+        return jax.tree.map(jax.device_put, tree)
 
     def ring_append(tree, lo, hi):
         ring.add_chunk(tree["obs"], tree["action"], tree["reward"],
                        tree["terminated"], tree["truncated"])
 
-    # Double-buffered H2D (replay/staging.py): batch g+1 is gathered
-    # into reusable pinned-host staging buffers and its upload
-    # dispatched while step g trains.
-    stager = None
-    if double_buffer:
+    # Sample-side pipeline (ISSUE 5): a background prefetcher runs
+    # sample -> gather -> stage ahead of the learner. Without it, the
+    # legacy main-thread double-buffered stager (ISSUE 2) or the fully
+    # serial put_batch path serve as the pinned references.
+    prefetcher = stager = None
+    if prefetch:
+        from dist_dqn_tpu.replay.staging import SamplePrefetcher
+        prefetcher = SamplePrefetcher(sample_host, depth=prefetch_depth,
+                                      name="host_replay",
+                                      wait_generation=ring.wait_generation)
+    elif double_buffer:
         from dist_dqn_tpu.replay.staging import DoubleBufferedStager
         stager = DoubleBufferedStager(depth=2, name="host_replay")
 
@@ -295,11 +388,45 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     train_debt_iters = 0
     weights = jnp.ones((cfg.learner.batch_size,), jnp.float32)
 
+    # Batched priority write-backs (ISSUE 5, PER only): each train
+    # step's |TD| plane stays a device array in this pending list (its
+    # dispatch is long retired by flush time, so the np.asarray there
+    # costs a copy, not a sync) and lands in the sum-tree as ONE
+    # vectorized set per prio_writeback_batch steps. Chronological
+    # order + the per-slot generation guard preserve last-write-wins.
+    wb_pending = []
+    is_w_sum, is_w_count, is_w_min = 0.0, 0, 1.0
+
+    def _wb_add(aux, metrics):
+        nonlocal is_w_sum, is_w_count, is_w_min
+        if per_sampler is None:
+            return
+        wb_pending.append((aux.leaf, metrics["priorities"],
+                           aux.slot_gen))
+        is_w_sum += float(aux.weights.sum())
+        is_w_count += int(aux.weights.shape[0])
+        is_w_min = min(is_w_min, float(aux.weights.min()))
+        if len(wb_pending) >= prio_writeback_batch:
+            _wb_flush()
+
+    def _wb_flush():
+        if per_sampler is None or not wb_pending:
+            return
+        pending, wb_pending[:] = wb_pending[:], []
+        leaf = np.concatenate([e[0] for e in pending])
+        prios = np.concatenate([np.asarray(e[1], np.float64)
+                                for e in pending])
+        gens = np.concatenate([e[2] for e in pending])
+        per_sampler.update_priorities(leaf, prios, expected_gen=gens)
+
     num_chunks = max(0, math.ceil(total_env_steps / (chunk_iters * B)))
     env_steps = 0
     grad_steps = 0
+    sample_k = 0          # global batch index — the RNG-stream cursor
     d2h_bytes_total = 0
     fence_wait_total = 0.0
+    sample_s_total = 0.0
+    prefetch_wait_s_total = 0.0
     overlap_fracs = []
     history = []
     metrics = None
@@ -387,6 +514,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # Stage 3 — train event for chunk g (samples the window
             # INCLUDING chunk g, exactly as the serial path does).
             did = 0
+            ev_sample_s = ev_wait_s = 0.0
+            ev_depth_sum = ev_stale = 0
             if (ring.can_sample(cfg.learner.n_step)
                     and ring.size * B >= cfg.replay.min_fill):
                 train_debt_iters += chunk_iters
@@ -394,27 +523,82 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 train_debt_iters -= events * max(cfg.train_every, 1)
                 grads_this_chunk = events * updates_per_train
                 if grads_this_chunk:
-                    if stager is not None:
-                        # Double-buffered: batch g+1's gather + H2D
-                        # upload overlap step g's device time.
-                        stager.stage(sample_host())
+                    # The window every one of this event's batches must
+                    # see: chunk g is published (fenced above) and
+                    # chunk g+1's appends are gated until the event's
+                    # last sample is drawn, so the generation is stable
+                    # across the event.
+                    fence_gen = ring.generation
+
+                    def _unpack(dev):
+                        # PER stages (batch, IS weights) as one tree;
+                        # uniform reuses the constant device ones.
+                        return dev if per_sampler is not None \
+                            else (dev, weights)
+
+                    if prefetcher is not None:
+                        # Sample-ahead: the prefetcher thread samples/
+                        # gathers/uploads batch i+1.. while batch i
+                        # trains; pops verify the generation tag.
+                        s0 = (prefetcher.sample_s_total,
+                              prefetcher.wait_s_total,
+                              prefetcher.stale_total)
+                        prefetcher.request(grads_this_chunk, fence_gen)
                         for i in range(grads_this_chunk):
-                            batch, _ = stager.pop()
-                            state, metrics = train_jit(state, batch,
-                                                       weights)
+                            dev, aux = prefetcher.pop(fence_gen)
+                            ev_depth_sum += len(prefetcher)
+                            batch, w = _unpack(dev)
+                            state, metrics = train_jit(state, batch, w)
+                            _wb_add(aux, metrics)
+                        ev_sample_s = prefetcher.sample_s_total - s0[0]
+                        ev_wait_s = prefetcher.wait_s_total - s0[1]
+                        ev_stale = prefetcher.stale_total - s0[2]
+                        sample_k = prefetcher.next_k
+                    elif stager is not None:
+                        # Serial reference with main-thread double
+                        # buffering (--no-prefetch): batch i+1's gather
+                        # + upload still overlap step i's device time,
+                        # but the sample itself stays on this thread.
+                        t_s = time.perf_counter()
+                        host, aux = sample_host(sample_k)
+                        stager.stage(host, aux=aux)
+                        ev_sample_s += time.perf_counter() - t_s
+                        sample_k += 1
+                        for i in range(grads_this_chunk):
+                            dev, aux = stager.pop()
+                            batch, w = _unpack(dev)
+                            state, metrics = train_jit(state, batch, w)
+                            _wb_add(aux, metrics)
                             if i + 1 < grads_this_chunk:
-                                stager.stage(sample_host())
+                                t_s = time.perf_counter()
+                                host, nxt = sample_host(sample_k)
+                                stager.stage(host, aux=nxt)
+                                ev_sample_s += time.perf_counter() - t_s
+                                sample_k += 1
                     else:
-                        # Serial H2D reference (--no-double-buffer):
+                        # Fully serial H2D reference
+                        # (--no-prefetch --no-double-buffer):
                         # sample -> upload -> train, one at a time.
-                        batch = put_batch(sample_host())
+                        t_s = time.perf_counter()
+                        host, aux = sample_host(sample_k)
+                        dev = put_batch(host)
+                        ev_sample_s += time.perf_counter() - t_s
+                        sample_k += 1
                         for i in range(grads_this_chunk):
-                            state, metrics = train_jit(state, batch,
-                                                       weights)
+                            batch, w = _unpack(dev)
+                            state, metrics = train_jit(state, batch, w)
+                            _wb_add(aux, metrics)
                             if i + 1 < grads_this_chunk:
-                                batch = put_batch(sample_host())
+                                t_s = time.perf_counter()
+                                host, aux = sample_host(sample_k)
+                                dev = put_batch(host)
+                                ev_sample_s += \
+                                    time.perf_counter() - t_s
+                                sample_k += 1
                     did = grads_this_chunk
                     grad_steps += did
+                    sample_s_total += ev_sample_s
+                    prefetch_wait_s_total += ev_wait_s
             # Chunk g+1's evacuation: every sample for chunk g's event
             # has been drawn above, so chunk g+1's slices may publish
             # from here on without changing what those samples saw —
@@ -462,11 +646,24 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 "d2h_bytes": d2h_bytes,
                 "ring_transitions": ring_transitions,
                 "ring_gb": round(ring.nbytes / 1e9, 3),
+                # Sample-side overlap accounting (ISSUE 5): sample_s is
+                # the host sampling wall this chunk (on the critical
+                # path when prefetch is off, overlapped when on);
+                # prefetch_wait_s is the share still blocking the main
+                # thread; prefetch_depth the mean batches staged ahead
+                # at pop time; stale_batches the generation-fence drops.
+                "sample_s": round(ev_sample_s, 4),
+                "prefetch_wait_s": round(ev_wait_s, 4),
+                "prefetch_depth": round(ev_depth_sum / did, 2) if did
+                else 0.0,
+                "stale_batches": ev_stale,
             }
             if t_evac_parts is not None:
                 row["chunk_collect_fetch_s"] = round(t_evac_parts[0], 4)
                 row["chunk_ring_s"] = round(t_evac_parts[1], 4)
-            if stager is not None:
+            if prefetcher is not None:
+                row["h2d_staged_bytes"] = prefetcher.bytes_staged
+            elif stager is not None:
                 row["h2d_staged_bytes"] = stager.bytes_staged
             if did:
                 loss_val = float(jax.device_get(metrics["loss"]))
@@ -480,9 +677,14 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     finally:
         if worker is not None:
             worker.close()
+        if prefetcher is not None:
+            prefetcher.close()
         hb_collect.close()
         hb_train.close()
 
+    # Apply any accumulated-but-unflushed |TD| write-backs before the
+    # summary counts them (the PER twin of the apex barrier flush).
+    _wb_flush()
     wall = time.perf_counter() - t_start
     # Pin anchor for the pipelined-vs-serial equivalence test: a cheap
     # whole-params digest (float64 fold of float32 leaves, deterministic
@@ -514,8 +716,26 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         "evac_fence_wait_s_total": round(fence_wait_total, 4),
         "evac_overlap_frac_mean": round(sum(overlap_fracs) / n, 4),
         "param_checksum": param_checksum,
-        "double_buffer": stager is not None,
-        "h2d_staged_bytes": (stager.bytes_staged if stager is not None
-                             else 0),
+        "double_buffer": stager is not None or prefetcher is not None,
+        "h2d_staged_bytes": (
+            prefetcher.bytes_staged if prefetcher is not None
+            else stager.bytes_staged if stager is not None else 0),
+        # Sample-side pipeline summary (ISSUE 5).
+        "prefetch": prefetcher is not None,
+        "prefetch_depth": prefetch_depth if prefetcher is not None else 0,
+        "prioritized": per_sampler is not None,
+        "sample_s_total": round(sample_s_total, 4),
+        "prefetch_wait_s_total": round(prefetch_wait_s_total, 4),
+        "stale_batches": (prefetcher.stale_total
+                          if prefetcher is not None else 0),
+        "prio_writeback_flushes": (per_sampler.writeback_flushes
+                                   if per_sampler is not None else 0),
+        "prio_writeback_rows": (per_sampler.writeback_rows
+                                if per_sampler is not None else 0),
+        "prio_writeback_dropped": (per_sampler.writeback_dropped
+                                   if per_sampler is not None else 0),
+        "is_weight_mean": round(is_w_sum / is_w_count, 6)
+        if is_w_count else 1.0,
+        "is_weight_min": round(is_w_min, 6) if is_w_count else 1.0,
         "history": history,
     }

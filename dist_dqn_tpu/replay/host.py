@@ -46,6 +46,17 @@ def pad_pow2(n: int) -> int:
     return padded
 
 
+def stratified_mass(rng: np.random.Generator, batch_size: int,
+                    total: float) -> np.ndarray:
+    """One mass value per batch row from equal-width strata:
+    u_i ~ U[i, i+1) / S * total. The jitter scheme every host-side PER
+    sampler shares (this shard and the host-ring sampler in
+    replay/host_ring.py) — stratification bounds the per-draw variance
+    the plain-uniform scheme leaves on the table."""
+    return (np.arange(batch_size) + rng.uniform(size=batch_size)) \
+        / batch_size * total
+
+
 
 def _check_tree_idx(idx: np.ndarray, capacity: int) -> np.ndarray:
     """Shared leaf-index validation for both tree backends: negative numpy
@@ -409,9 +420,8 @@ class PrioritizedHostReplay:
                                                       self._size)
         else:
             total = self.tree.total
-            strata = (np.arange(batch_size)
-                      + self._rng.uniform(size=batch_size)) / batch_size
-            idx = self.tree.sample(strata * total)
+            idx = self.tree.sample(
+                stratified_mass(self._rng, batch_size, total))
             idx = np.minimum(idx, self._size - 1)
             p_sel = self.tree.get(idx) / total
             weights = (self._size * np.maximum(p_sel, 1e-12)) ** (-beta)

@@ -27,7 +27,7 @@ per slice against the link-priced fetch.
 from __future__ import annotations
 
 import threading
-from typing import NamedTuple, Optional, Tuple
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +40,31 @@ class HostBatch(NamedTuple):
     reward: np.ndarray
     discount: np.ndarray
     next_obs: np.ndarray
+
+
+class HostSample(NamedTuple):
+    """One drawn batch plus the slot identities it was drawn at (ISSUE 5:
+    priority write-backs need to address the slots a batch came from, and
+    tests need to pin that draws stay inside the valid region)."""
+
+    batch: HostBatch
+    t_idx: np.ndarray       # [S] time-slot index of each transition
+    b_idx: np.ndarray       # [S] env-lane index of each transition
+    generation: int         # ring generation the draw was made against
+
+
+class PerSample(NamedTuple):
+    """A prioritized draw's bookkeeping (RingPrioritySampler.sample):
+    everything a deferred, batched priority write-back needs to apply the
+    learner's |TD| to the right slots — or drop the update when the slot
+    was overwritten in the meantime."""
+
+    leaf: np.ndarray        # [S] flat slot ids (t * num_envs + b)
+    t_idx: np.ndarray
+    b_idx: np.ndarray
+    slot_gen: np.ndarray    # [S] per-slot write generation at sample time
+    weights: np.ndarray     # [S] normalized importance-sampling weights
+    generation: int         # ring generation the draw was made against
 
 
 def _np_n_step(reward_w, term_w, trunc_w, gamma: float):
@@ -91,6 +116,19 @@ class HostTimeRing:
         # all-or-nothing from the sampler's point of view.
         self._fence = threading.Condition(threading.RLock())
         self.generation = 0
+        # Per-slot write generation (ISSUE 5): each time-slot is stamped
+        # with the generation that last wrote it, so a deferred priority
+        # write-back can detect that its slot was overwritten since the
+        # sample and drop the update (same guard as replay/host.py's
+        # _slot_gen, at t-slot granularity — a chunk overwrites whole
+        # lane rows at once).
+        self.slot_gen = np.zeros(num_slots, np.int64)
+        # Publish hooks (ISSUE 5): called under the fence lock with the
+        # t-slot indices just written, AFTER the arrays/pos/size/
+        # generation update — a prioritized sampler keeps its sum-tree
+        # mass in lockstep with the ring through this, atomically with
+        # respect to concurrent samplers.
+        self._publish_hooks: List[Callable[[np.ndarray], None]] = []
         # Telemetry (ISSUE 1): the host-DRAM window's occupancy and
         # add/sample volume, labeled apart from the PER host shard.
         reg = get_registry()
@@ -127,10 +165,22 @@ class HostTimeRing:
             self.pos = int((self.pos + C) % self.num_slots)
             self.size = int(min(self.size + C, self.num_slots))
             self.generation += 1
+            self.slot_gen[idx] = self.generation
+            for hook in self._publish_hooks:
+                hook(idx)
             self._fence.notify_all()
         self._c_added.inc(C * self.num_envs)
         self._g_size.set(self.size * self.num_envs)
         self._g_occ.set(self.size / self.num_slots)
+
+    def add_publish_hook(self, hook: Callable[[np.ndarray], None]) -> None:
+        """Register ``hook(idx)`` to run under the fence lock on every
+        ``add_chunk``, after the write is published. The hook must be
+        cheap (it extends every append's critical section) and must not
+        call back into ring methods that take the fence (RLock — same
+        thread re-entry is fine, but keep it simple)."""
+        with self._fence:
+            self._publish_hooks.append(hook)
 
     def wait_generation(self, target: int,
                         timeout: Optional[float] = None) -> bool:
@@ -197,22 +247,226 @@ class HostTimeRing:
                          next_obs=next_obs)
 
     def sample(self, rng: np.random.Generator, batch_size: int, n_step: int,
-               gamma: float) -> HostBatch:
+               gamma: float) -> HostSample:
         """Uniform over valid starts (same region as the device sampler:
         the oldest size - n_step slots, minus the dedup context skip).
         Index draw and gather share one fence hold, so the window the
-        indices were drawn against is the window that gets gathered."""
+        indices were drawn against is the window that gets gathered.
+        Returns the drawn (t, b) identities and the generation alongside
+        the batch (ISSUE 5: write-backs address slots, the prefetcher
+        tags batches with the window they saw)."""
         with self._fence:
             num_valid = self.size - n_step - self._extra()
             if num_valid <= 0:
                 raise ValueError(
                     "ring not sampleable yet (gate on can_sample)")
             u = rng.integers(0, num_valid, batch_size)
-            t_idx = (self.pos - self.size + self._extra() + u) \
-                % self.num_slots
-            b_idx = rng.integers(0, self.num_envs, batch_size)
-            batch = self._gather_locked(t_idx.astype(np.int32),
-                                        b_idx.astype(np.int32),
-                                        n_step, gamma)
+            t_idx = ((self.pos - self.size + self._extra() + u)
+                     % self.num_slots).astype(np.int32)
+            b_idx = rng.integers(0, self.num_envs,
+                                 batch_size).astype(np.int32)
+            generation = self.generation
+            batch = self._gather_locked(t_idx, b_idx, n_step, gamma)
         self._c_sampled.inc(batch_size)
-        return batch
+        return HostSample(batch=batch, t_idx=t_idx, b_idx=b_idx,
+                          generation=generation)
+
+
+class RingPrioritySampler:
+    """Prioritized (PER) sampling over a ``HostTimeRing``'s slots — the
+    sum-tree companion the host-replay runtime was missing (ISSUE 5).
+
+    Flat slot ids are ``t * num_envs + b`` over a ``NativeSumTree``
+    shard (replay/host.py — C++ delta-propagation writes, ~3x numpy at
+    1M slots; numpy fallback where the toolchain can't build it). The
+    tree is kept in lockstep with the ring BY THE APPEND PATH:
+    construction registers a publish hook, so every ``add_chunk`` —
+    whether from the main thread or the background evacuation worker —
+    seeds its newly written slots at the running max priority (evicted
+    slots are overwritten by the same write) and re-masks the
+    valid-region boundary, all under the ring's generation fence. A
+    concurrent sampler can therefore never observe ring data and tree
+    mass in disagreement.
+
+    The tree carries mass ONLY for currently-sampleable slots (the same
+    region ``HostTimeRing.sample`` draws uniformly from: everything but
+    the newest ``n_step`` bootstrap window and the oldest frame-stack
+    context); the authoritative per-slot mass lives in the ``_mass``
+    shadow array, so a slot re-entering the valid region as new chunks
+    land gets its priority back instead of max-priority amnesia.
+
+    Write-backs batch (``update_priorities``): chronological concat +
+    per-slot expected-generation filter + ONE vectorized ``tree.set``,
+    mirroring the apex service's ``prio_writeback_batch`` semantics
+    (last write wins for slots hit by several batched steps).
+    """
+
+    def __init__(self, ring: HostTimeRing, n_step: int,
+                 alpha: float = 0.6, beta: float = 0.4,
+                 eps: float = 1e-6, native: Optional[bool] = None,
+                 name: str = "host_replay"):
+        from dist_dqn_tpu.replay.host import make_sum_tree, \
+            stratified_mass
+
+        self._stratified = stratified_mass
+        self._ring = ring
+        self.n_step = int(n_step)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.eps = float(eps)
+        B = ring.num_envs
+        self.capacity = ring.num_slots * B
+        self.tree = make_sum_tree(self.capacity, native=native)
+        # Authoritative p^alpha per flat slot; the tree holds
+        # _mass * valid_region_mask.
+        self._mass = np.zeros(self.capacity, np.float64)
+        self._max_priority = 1.0
+        self._invalid_t = np.empty(0, np.int64)
+        self.writeback_flushes = 0
+        self.writeback_rows = 0
+        self.writeback_dropped = 0
+        labels = {"loop": name}
+        reg = get_registry()
+        self._c_wb_batches = reg.counter(
+            tm.HOST_REPLAY_PRIO_WB_BATCHES,
+            "batched priority write-back flushes applied to the ring's "
+            "sum-tree", labels)
+        self._c_wb_rows = reg.counter(
+            tm.HOST_REPLAY_PRIO_WB_ROWS,
+            "priority rows written back (post generation filter)", labels)
+        self._c_wb_dropped = reg.counter(
+            tm.HOST_REPLAY_PRIO_WB_DROPPED,
+            "priority rows dropped because their slot was overwritten "
+            "before the batched write-back", labels)
+        self._g_max_prio = reg.gauge(tm.REPLAY_MAX_PRIORITY,
+                                     "running max |TD| priority",
+                                     {"store": "host_ring"})
+        self._g_mass = reg.gauge(
+            tm.REPLAY_PRIORITY_MASS,
+            "total p^alpha mass over the ring's valid region",
+            {"store": "host_ring"})
+        with ring._fence:
+            if ring.size:
+                # Adopt a pre-filled ring: everything stored is fresh
+                # as far as priorities go — seed it all at max.
+                j = np.arange(ring.size, dtype=np.int64)
+                self._on_publish((ring.pos - ring.size + j)
+                                 % ring.num_slots)
+            ring.add_publish_hook(self._on_publish)
+
+    # -- ring-append synchronization (runs under the ring fence) ------------
+    def _flat(self, t: np.ndarray) -> np.ndarray:
+        B = self._ring.num_envs
+        return (np.asarray(t, np.int64)[:, None] * B
+                + np.arange(B, dtype=np.int64)[None, :]).reshape(-1)
+
+    def _invalid_ts(self) -> np.ndarray:
+        """t-slots currently stored but NOT sampleable: the oldest
+        frame-stack context and the newest n_step bootstrap window."""
+        ring = self._ring
+        lo = min(ring._extra(), ring.size)
+        hi = max(ring.size - self.n_step, lo)
+        inv_j = np.concatenate([np.arange(lo, dtype=np.int64),
+                                np.arange(hi, ring.size, dtype=np.int64)])
+        return (ring.pos - ring.size + inv_j) % ring.num_slots
+
+    def _on_publish(self, idx: np.ndarray) -> None:
+        new_t = np.asarray(idx, np.int64)
+        self._mass[self._flat(new_t)] = self._max_priority ** self.alpha
+        cur_invalid = self._invalid_ts()
+        # One vectorized tree write covers the fresh slots, the slots
+        # leaving the invalid boundary (restore their shadow mass) and
+        # the slots entering it (zero them).
+        touched = np.unique(np.concatenate([new_t, self._invalid_t,
+                                            cur_invalid]))
+        flat = self._flat(touched)
+        vals = self._mass[flat].copy().reshape(touched.shape[0], -1)
+        vals[np.isin(touched, cur_invalid)] = 0.0
+        self.tree.set(flat, vals.reshape(-1))
+        self._invalid_t = cur_invalid
+        self._g_mass.set(self.tree.total)
+
+    # -- sampling -----------------------------------------------------------
+    def sample(self, rng: np.random.Generator, batch_size: int,
+               gamma: float) -> Tuple[HostBatch, PerSample]:
+        """Stratified prioritized draw + gather under ONE fence hold ->
+        (batch, PerSample bookkeeping). P(i) ~ p_i^alpha over the valid
+        region; IS weights (N * P)^-beta, normalized to max 1."""
+        ring = self._ring
+        B = ring.num_envs
+        with ring._fence:
+            num_valid = ring.size - self.n_step - ring._extra()
+            if num_valid <= 0:
+                raise ValueError(
+                    "ring not sampleable yet (gate on can_sample)")
+            total = self.tree.total
+            leaf = self.tree.sample(self._stratified(rng, batch_size,
+                                                     total))
+            mass = self.tree.get(leaf)
+            # A draw can land on a zero-mass (invalid-region) leaf only
+            # through fp boundary pathology. Substitute the oldest valid
+            # slot and zero the IS weight so the stand-in contributes
+            # nothing to the loss (same discipline as
+            # replay/host.py DevicePrioritySampler).
+            bad = mass <= 0.0
+            if bad.any():
+                oldest_valid = ((ring.pos - ring.size + ring._extra())
+                                % ring.num_slots) * B
+                leaf = np.where(bad, oldest_valid, leaf)
+                mass = self.tree.get(leaf)
+            t_idx = (leaf // B).astype(np.int32)
+            b_idx = (leaf % B).astype(np.int32)
+            p_sel = mass / max(total, 1e-300)
+            w = (num_valid * B * np.maximum(p_sel, 1e-12)) ** (-self.beta)
+            w = (w / w.max()).astype(np.float32)
+            if bad.any():
+                w[bad] = 0.0
+            slot_gen = self._ring.slot_gen[t_idx].copy()
+            generation = ring.generation
+            batch = ring._gather_locked(t_idx, b_idx, self.n_step, gamma)
+        ring._c_sampled.inc(batch_size)
+        return batch, PerSample(leaf=leaf, t_idx=t_idx, b_idx=b_idx,
+                                slot_gen=slot_gen, weights=w,
+                                generation=generation)
+
+    # -- priority write-backs ----------------------------------------------
+    def update_priorities(self, leaf: np.ndarray, priorities: np.ndarray,
+                          expected_gen: np.ndarray) -> Tuple[int, int]:
+        """Write learner |TD| priorities back to their slots; rows whose
+        slot was overwritten since the sample (per-slot generation
+        mismatch) are dropped, never stamped onto a different
+        transition. Returns (applied, dropped) row counts. Callers batch
+        several train steps' rows in chronological order into one call
+        (one vectorized tree propagation; last write wins)."""
+        ring = self._ring
+        leaf = np.asarray(leaf, np.int64)
+        p = np.abs(np.asarray(priorities, np.float64)) + self.eps
+        with ring._fence:
+            live = ring.slot_gen[leaf // ring.num_envs] == \
+                np.asarray(expected_gen, np.int64)
+            dropped = int(leaf.shape[0] - int(live.sum()))
+            leaf, p = leaf[live], p[live]
+            if leaf.size:
+                self._max_priority = max(self._max_priority,
+                                         float(p.max()))
+                mass = p ** self.alpha
+                self._mass[leaf] = mass
+                # Keep the valid-region mask: a write-back to a slot
+                # currently inside the bootstrap/context boundary stays
+                # shadow-only until an append re-validates it.
+                inv = np.isin(leaf // ring.num_envs, self._invalid_t)
+                self.tree.set(leaf, np.where(inv, 0.0, mass))
+            # Still under the fence: tree.total must not race a
+            # concurrent publish hook's tree.set on the evacuation
+            # worker thread.
+            total = self.tree.total
+        applied = int(leaf.size)
+        self.writeback_flushes += 1
+        self.writeback_rows += applied
+        self.writeback_dropped += dropped
+        self._c_wb_batches.inc()
+        self._c_wb_rows.inc(applied)
+        self._c_wb_dropped.inc(dropped)
+        self._g_max_prio.set(self._max_priority)
+        self._g_mass.set(total)
+        return applied, dropped

@@ -1,5 +1,6 @@
-"""Host<->device batch staging: H2D double buffering (ISSUE 2) and the
-streamed D2H evacuation pipeline (ISSUE 3).
+"""Host<->device batch staging: H2D double buffering (ISSUE 2), the
+streamed D2H evacuation pipeline (ISSUE 3), and the prioritized
+sample-ahead prefetcher (ISSUE 5).
 
 H2D half — double-buffered host->device batch staging (ISSUE 2 #3).
 
@@ -37,9 +38,22 @@ device compute. The worker moves the blocking tail (transfer wait + ring
 append) off the main thread entirely, behind a per-chunk completion
 handle the training loop fences on before sampling.
 
-Telemetry (ISSUE 2/3): queue occupancy gauge, staged-batch and
+Sample-ahead half — ``SamplePrefetcher`` (ISSUE 5): the H2D twin of the
+``EvacuationWorker``. A background thread runs the whole
+sample -> gather -> stage (reusable pinned-host copy + async H2D
+upload) chain AHEAD of the learner, feeding a bounded queue of
+device-resident batches through an internal ``DoubleBufferedStager``;
+the training loop pops finished batches instead of paying host-side
+sampling (uniform gathers or sum-tree descents) on its critical path.
+A generation-fence handshake with the ring keeps it honest: every
+batch is tagged with the ring generation it sampled against, and a
+batch sampled against an OLDER window than the train event fenced on
+is counted, dropped and re-sampled — never trained on silently.
+
+Telemetry (ISSUE 2/3/5): queue occupancy gauge, staged-batch and
 staged-byte counters, D2H byte/slice counters and evacuation-latency /
-slice-lag histograms — all labeled with the owning loop's name so the
+slice-lag histograms, sample-latency / prefetch-wait histograms and the
+stale-batch counter — all labeled with the owning loop's name so the
 service learner and the host-replay loop stay separable on one
 dashboard.
 """
@@ -82,6 +96,24 @@ class DoubleBufferedStager:
         self._jax = jax
         self.depth = depth
         self._put = device_put if device_put is not None else jax.device_put
+        # Alias guard (found by the ISSUE 5 prefetcher's equivalence
+        # pin): CPU PJRT zero-copies suitably-aligned numpy buffers, so
+        # the "uploaded" Array can ALIAS the staging pages for its whole
+        # lifetime — the reuse barrier below (upload ready) then does
+        # not stop a later np.copyto into the slot from rewriting data
+        # a still-pending train step has not read yet. One jitted
+        # device-side copy breaks the alias, and ITS readiness (the
+        # barrier waits on the copy's output) proves the staging pages
+        # were fully read. Real accelerators DMA a genuine copy on
+        # device_put, so the guard and its extra device memcpy stay off
+        # there.
+        self._alias_guard = (device_put is None
+                             and jax.default_backend() == "cpu")
+        if self._alias_guard:
+            import jax.numpy as jnp
+
+            self._unalias = jax.jit(
+                lambda tree: jax.tree_util.tree_map(jnp.copy, tree))
         # host staging sets, allocated lazily from the first batch:
         # _bufs[i] is a list of numpy leaves matching the batch treedef.
         self._bufs: List[Optional[List[np.ndarray]]] = [None] * depth
@@ -152,6 +184,8 @@ class DoubleBufferedStager:
             nbytes += arr.nbytes
         device_batch = self._put(
             jax.tree_util.tree_unflatten(self._treedef, bufs))
+        if self._alias_guard:
+            device_batch = self._unalias(device_batch)
         self._last_upload[slot] = device_batch
         self._queue.append((device_batch, aux))
         self._staged_total += 1
@@ -434,6 +468,253 @@ class EvacuationWorker:
         stage heartbeat deregisters with the thread — a closed worker is
         not a stall."""
         self._q.put(None)
+        self._thread.join()
+        self._hb.close()
+
+    @property
+    def failed(self) -> Optional[BaseException]:
+        return self._exc
+
+
+class SamplePrefetcher:
+    """Background sample-ahead pipeline (ISSUE 5 tentpole): the H2D twin
+    of ``EvacuationWorker``. A daemon thread executes
+    ``sample_fn(k) -> (host_batch, aux)`` work items and stages each
+    result through an internal ``DoubleBufferedStager`` (reusable
+    page-warm host buffers, async ``device_put``); the training loop
+    pops device-resident batches in strict ``k`` order.
+
+    Determinism contract: batch ``k``'s content must be a pure function
+    of ``(k, ring window)`` — callers derive batch ``k``'s RNG from a
+    per-index stream split from the run seed
+    (``np.random.SeedSequence(seed, spawn_key=(k,))``), never from a
+    shared stateful generator. That is what makes the prefetched path
+    BIT-IDENTICAL to the serial sample-in-loop reference: thread timing
+    can change WHEN a batch is drawn, never WHAT it contains.
+
+    Generation-fence handshake: ``request(n, min_generation)`` tags the
+    work with the ring generation the upcoming train event fenced on.
+    The worker blocks on ``wait_generation(min_generation)`` before
+    sampling (so a request issued ahead of the publication simply
+    waits), and ``pop(min_generation)`` re-checks the tag the sample
+    actually carried: a batch sampled against an OLDER window is
+    counted (``dqn_host_replay_stale_batches_total``), dropped, and
+    re-sampled at the fenced window on the calling thread — stale data
+    is never trained on silently, and the counter makes any occurrence
+    visible. ``depth`` bounds host memory and how far sampling runs
+    ahead of training, exactly like the stager it wraps.
+
+    Failure contract mirrors ``EvacuationWorker``: a worker exception
+    re-raises from ``pop()``/``request()`` and the thread drains to a
+    tombstone so ``close()`` never hangs.
+    """
+
+    def __init__(self, sample_fn: Callable[[int], Tuple[Any, Any]],
+                 depth: int = 2, name: str = "host_replay",
+                 wait_generation: Optional[Callable] = None,
+                 device_put: Optional[Callable] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        import jax  # deferred: keep the module importable without jax
+
+        self._jax = jax
+        self._sample_fn = sample_fn
+        self._wait_gen = wait_generation
+        self._put = device_put if device_put is not None \
+            else jax.device_put
+        self.depth = int(depth)
+        self._stager = DoubleBufferedStager(depth=depth, name=name,
+                                            device_put=device_put)
+        self._work: "queue.Queue" = queue.Queue()
+        self._ready = threading.Semaphore(0)
+        self._free = threading.Semaphore(depth)
+        self._exc: Optional[BaseException] = None
+        self._closing = False
+        self._next_k = 0
+        self.sample_s_total = 0.0
+        self.wait_s_total = 0.0
+        self.stale_total = 0
+        self.sampled_total = 0
+        labels = {"loop": name}
+        reg = get_registry()
+        self._h_sample = reg.histogram(
+            tm.HOST_REPLAY_SAMPLE_SECONDS,
+            "host-side sample+gather wall per batch (prefetcher thread "
+            "when prefetching — off the critical path)", labels)
+        self._h_wait = reg.histogram(
+            tm.HOST_REPLAY_PREFETCH_WAIT_SECONDS,
+            "main-thread wait for a prefetched batch (the sample-side "
+            "share left on the critical path)", labels)
+        self._c_stale = reg.counter(
+            tm.HOST_REPLAY_STALE_BATCHES,
+            "prefetched batches dropped for carrying a ring generation "
+            "older than the train event's fence", labels)
+        self._g_depth = reg.gauge(
+            tm.HOST_REPLAY_PREFETCH_DEPTH,
+            "device-resident batches staged ahead of the learner",
+            labels)
+        self._hb = tm_watchdog.heartbeat(f"prefetch.{name}")
+        self._flight = tm_flight.get_flight()
+        self._name = name
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"prefetch-{name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def __len__(self) -> int:
+        """Batches staged and not yet popped (observed prefetch depth)."""
+        return len(self._stager)
+
+    @property
+    def next_k(self) -> int:
+        """The next batch index request() will hand out — the caller's
+        RNG-stream cursor."""
+        return self._next_k
+
+    @property
+    def bytes_staged(self) -> int:
+        """Host bytes copied through the internal staging buffers."""
+        return self._stager.bytes_staged
+
+    def request(self, n: int, min_generation: int) -> None:
+        """Enqueue the next ``n`` batch indices, to be sampled against a
+        ring window of at least ``min_generation``. Call once per train
+        event, after fencing the chunk whose data the event must see."""
+        if self._exc is not None:
+            raise RuntimeError(
+                "sample prefetcher died; no further batches can be "
+                "prefetched") from self._exc
+        if self._closing or not self._thread.is_alive():
+            raise RuntimeError("sample prefetcher is closed")
+        for _ in range(int(n)):
+            self._work.put((self._next_k, int(min_generation)))
+            self._next_k += 1
+
+    def _beat_timeout(self) -> float:
+        return min(0.5, self._hb.deadline_s / 4.0)
+
+    def _resample(self, k: int, min_generation: int) -> Tuple[Any, Any]:
+        """Stale-batch backstop: re-draw batch ``k`` on the CALLING
+        thread once the ring reaches ``min_generation``. Rare by
+        construction (the loop gates appends on sampling), so the
+        direct ``device_put`` here skips the staging pool."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            if self._wait_gen is not None:
+                reached = self._wait_gen(
+                    min_generation,
+                    timeout=max(deadline - time.monotonic(), 0.0))
+            else:
+                # No fence waiter provided: poll with a backoff instead
+                # of hot-looping full re-draws.
+                reached = True
+            if reached:
+                host_batch, aux = self._sample_fn(k)
+                if getattr(aux, "generation", min_generation) \
+                        >= min_generation:
+                    return self._put(host_batch), aux
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"prefetch batch {k} waited 30s for ring "
+                    f"generation {min_generation} which never "
+                    "published — appends stopped while a train event "
+                    "still expected them")
+            if self._wait_gen is None:
+                time.sleep(0.01)
+
+    def pop(self, min_generation: int) -> Tuple[Any, Any]:
+        """Next batch in ``k`` order -> (device_batch, aux). Blocks for
+        the worker; drops + re-samples batches tagged with a generation
+        older than ``min_generation``."""
+        t0 = time.perf_counter()
+        while not self._ready.acquire(timeout=0.1):
+            if self._exc is not None:
+                # Re-raise the worker's own exception (the
+                # _EvacJob.wait discipline): the loop surfaces the real
+                # cause, not a wrapper.
+                raise self._exc
+            if self._closing or not self._thread.is_alive():
+                raise RuntimeError("sample prefetcher is closed")
+        device_batch, (k, aux) = self._stager.pop()
+        self._free.release()
+        if getattr(aux, "generation", min_generation) < min_generation:
+            self.stale_total += 1
+            self._c_stale.inc()
+            self._flight.record(
+                "queue", f"prefetch.{self._name}.stale", k=k,
+                sampled_gen=int(aux.generation),
+                required_gen=int(min_generation))
+            device_batch, aux = self._resample(k, min_generation)
+        self._g_depth.set(len(self._stager))
+        dt = time.perf_counter() - t0
+        self.wait_s_total += dt
+        self._h_wait.observe(dt)
+        return device_batch, aux
+
+    def _run(self) -> None:
+        timeout = self._beat_timeout()
+        while True:
+            self._hb.beat()
+            try:
+                item = self._work.get(timeout=timeout)
+            except queue.Empty:
+                if self._closing:
+                    self._hb.close()
+                    return
+                continue
+            if item is None:
+                self._hb.close()
+                return
+            k, min_gen = item
+            try:
+                # Fence handshake: never sample a window older than the
+                # one the train event will fence on.
+                if self._wait_gen is not None:
+                    while not self._wait_gen(min_gen, timeout=timeout):
+                        self._hb.beat()
+                        if self._closing:
+                            self._hb.close()
+                            return
+                while not self._free.acquire(timeout=timeout):
+                    self._hb.beat()
+                    if self._closing:
+                        self._hb.close()
+                        return
+                t0 = time.perf_counter()
+                host_batch, aux = self._sample_fn(k)
+                dt = time.perf_counter() - t0
+                self.sample_s_total += dt
+                self.sampled_total += 1
+                self._h_sample.observe(dt)
+                self._stager.stage(host_batch, aux=(k, aux))
+                self._g_depth.set(len(self._stager))
+                self._ready.release()
+            except BaseException as e:  # propagate, never hang a pop
+                self._exc = e
+                self._flight.record("queue",
+                                    f"prefetch.{self._name}.failed",
+                                    error=f"{type(e).__name__}: {e}")
+                # Tombstone: drain remaining work so close() returns;
+                # pop()/request() re-raise loudly.
+                while True:
+                    self._hb.beat()
+                    try:
+                        pending = self._work.get(timeout=timeout)
+                    except queue.Empty:
+                        if self._closing:
+                            self._hb.close()
+                            return
+                        continue
+                    if pending is None:
+                        self._hb.close()
+                        return
+
+    def close(self) -> None:
+        """Stop the worker and join; staged-but-unpopped batches are
+        discarded. Safe after a worker death (the thread is already in
+        its tombstone loop or gone)."""
+        self._closing = True
+        self._work.put(None)
         self._thread.join()
         self._hb.close()
 

@@ -59,6 +59,21 @@ HOST_REPLAY_SLICE_LAG_SECONDS = "dqn_host_replay_slice_lag_seconds"
 HOST_REPLAY_FENCE_WAIT_SECONDS = "dqn_host_replay_fence_wait_seconds"
 HOST_REPLAY_OVERLAP = "dqn_host_replay_evac_overlap_frac"
 
+# Host-replay sample-side pipeline (ISSUE 5): the H2D prefetcher — the
+# sample/gather wall moved off the critical path, the residual
+# main-thread wait, generation-stale drops, and the batched PER
+# write-back stream. Labeled {loop="host_replay"} like the D2H half.
+HOST_REPLAY_SAMPLE_SECONDS = "dqn_host_replay_sample_seconds"
+HOST_REPLAY_PREFETCH_WAIT_SECONDS = \
+    "dqn_host_replay_prefetch_wait_seconds"
+HOST_REPLAY_PREFETCH_DEPTH = "dqn_host_replay_prefetch_depth"
+HOST_REPLAY_STALE_BATCHES = "dqn_host_replay_stale_batches_total"
+HOST_REPLAY_PRIO_WB_BATCHES = \
+    "dqn_host_replay_prio_writeback_batches_total"
+HOST_REPLAY_PRIO_WB_ROWS = "dqn_host_replay_prio_writeback_rows_total"
+HOST_REPLAY_PRIO_WB_DROPPED = \
+    "dqn_host_replay_prio_writeback_dropped_total"
+
 # Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
 # heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
 # (the full stage table is in docs/observability.md), divergence trips

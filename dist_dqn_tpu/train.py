@@ -345,6 +345,28 @@ def main():
                              "transfers and ring appends at finer "
                              "grain, 1 = one streamed piece. Ignored "
                              "under --no-pipeline")
+    parser.add_argument("--no-prefetch", action="store_true",
+                        help="--runtime host-replay only: disable the "
+                             "background SamplePrefetcher (replay/"
+                             "staging.py) and sample train batches on "
+                             "the main thread between steps — the "
+                             "numerically identical serial A/B "
+                             "reference for the sample-side pipeline "
+                             "(bit-identical under a fixed seed in "
+                             "uniform mode)")
+    parser.add_argument("--prefetch-depth", type=int, default=2,
+                        help="--runtime host-replay only: device-"
+                             "resident batches the SamplePrefetcher "
+                             "may stage ahead of the learner (bounds "
+                             "host staging memory and sample "
+                             "run-ahead). Ignored under --no-prefetch")
+    parser.add_argument("--per", action="store_true",
+                        help="--runtime host-replay only: force "
+                             "prioritized (sum-tree) replay sampling "
+                             "with IS weights and batched TD-error "
+                             "write-backs; presets with "
+                             "replay.prioritized=True enable it by "
+                             "default (uniform otherwise)")
     parser.add_argument("--checkpoint-dir", default=None,
                         help="enable learner checkpoint/resume under this "
                              "directory (orbax; restores newest on start)")
@@ -567,7 +589,11 @@ def main():
             chunk_iters=args.chunk_iters, log_fn=print,
             double_buffer=not args.no_double_buffer,
             pipeline=not args.no_pipeline,
-            evac_slices=args.evac_slices)
+            evac_slices=args.evac_slices,
+            prefetch=not args.no_prefetch,
+            prefetch_depth=args.prefetch_depth,
+            # None = follow cfg.replay.prioritized; --per forces it on.
+            prioritized=True if args.per else None)
         out.pop("history", None)
         print(json.dumps(out))
         return
@@ -589,6 +615,13 @@ def main():
                 or args.evac_slices != parser.get_default("evac_slices"):
             print("# --no-pipeline/--evac-slices apply to --runtime "
                   "host-replay only; ignored under --runtime apex")
+        if args.no_prefetch or args.per \
+                or args.prefetch_depth != parser.get_default(
+                    "prefetch_depth"):
+            print("# --no-prefetch/--prefetch-depth/--per apply to "
+                  "--runtime host-replay only; the apex service is "
+                  "always prioritized and staged via "
+                  "ApexRuntimeConfig — ignored")
         import dataclasses
 
         from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
@@ -629,6 +662,12 @@ def main():
         print("# --no-pipeline/--evac-slices apply to --runtime "
               "host-replay only; ignored under the fused runtime (its "
               "replay never leaves the device)")
+    if args.no_prefetch or args.per \
+            or args.prefetch_depth != parser.get_default("prefetch_depth"):
+        print("# --no-prefetch/--prefetch-depth/--per apply to "
+              "--runtime host-replay only; ignored under the fused "
+              "runtime (its replay samples on device — "
+              "replay.prioritized selects the device sampler there)")
     stop_fn = None
     if args.stop_at_return is not None:
         target = args.stop_at_return

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Lint: no NEW JSON-line metric emission bypassing the telemetry registry.
+"""Lint: no NEW JSON-line metric emission bypassing the telemetry registry,
+and no ``dqn_*`` metric family undocumented in docs/observability.md.
 
 ISSUE 1 unified metrics behind ``dist_dqn_tpu/telemetry`` — new code
 should record through the registry (and let MetricLogger / the /metrics
@@ -12,6 +13,15 @@ bench.py's single contract line, train.py's log rows). The lint fails
 when a file GROWS new call sites or a new file starts emitting directly;
 shrinking is always allowed (update the allowlist in the same PR).
 
+ISSUE 5 added the docs-drift half: every ``dqn_*`` family name that
+appears at a registry registration site (``.counter(/.gauge(/
+.histogram(`` with a literal name) or as a canonical constant in
+``telemetry/collectors.py`` must appear in docs/observability.md, so
+the naming table can no longer silently lag the code. Names that are
+deliberately undocumented live in DOCS_ALLOWLIST with a rationale;
+dynamically composed names (f-strings) are out of scope by
+construction.
+
 Run from the repo root: ``python scripts/check_metrics.py``. Wired into
 tier-1 via tests/test_metrics_lint.py.
 """
@@ -22,6 +32,24 @@ import sys
 from pathlib import Path
 
 PATTERN = re.compile(r"(?:print|log_fn)\(json\.dumps")
+
+#: Registry registration with a literal family name. ``\s`` spans
+#: newlines, so multi-line calls are covered.
+REGISTRATION = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"'](dqn_[a-z0-9_]+)[\"']")
+#: Canonical name constants in telemetry/collectors.py (including the
+#: ``NAME = \`` + next-line-string spelling).
+CONSTANT = re.compile(
+    r"^[A-Z0-9_]+\s*=\s*(?:\\\s*)?[\"'](dqn_[a-z0-9_]+)[\"']", re.M)
+
+#: dqn_* families allowed to be absent from docs/observability.md,
+#: each with the reason it stays undocumented.
+DOCS_ALLOWLIST = {
+    # Internal plumbing of the span tracer: a scratch gauge the
+    # MetricLogger uses to mirror counter-style extras; not a scrape
+    # surface anyone should alert on (utils/trace.py).
+    "dqn_trace_counter",
+}
 
 #: file (repo-relative, posix) -> call sites grandfathered at ISSUE 1.
 ALLOWLIST = {
@@ -71,6 +99,29 @@ def scan(repo_root: Path):
     return counts
 
 
+def scan_metric_names(repo_root: Path):
+    """Every dqn_* family name the package registers or canonicalizes."""
+    names = set()
+    pkg = repo_root / "dist_dqn_tpu"
+    for f in sorted(pkg.rglob("*.py")):
+        names.update(REGISTRATION.findall(f.read_text()))
+    names.update(CONSTANT.findall(
+        (pkg / "telemetry" / "collectors.py").read_text()))
+    return names
+
+
+def check_docs(repo_root: Path):
+    """Names registered in code but absent from docs/observability.md
+    (minus the rationale'd allowlist). Whole-name match: a family that
+    is merely a prefix of a documented longer name (dqn_foo vs
+    dqn_foo_seconds) still counts as undocumented."""
+    doc = (repo_root / "docs" / "observability.md").read_text()
+    return sorted(
+        n for n in scan_metric_names(repo_root)
+        if not re.search(rf"{re.escape(n)}(?![a-z0-9_])", doc)
+        and n not in DOCS_ALLOWLIST)
+
+
 def main() -> int:
     repo_root = Path(__file__).resolve().parent.parent
     counts = scan(repo_root)
@@ -83,13 +134,21 @@ def main() -> int:
                 f"(allowlist: {allowed}). New metrics must go through "
                 f"dist_dqn_tpu/telemetry (registry counters/gauges/"
                 f"histograms); see docs/observability.md.")
+    undocumented = check_docs(repo_root)
+    for name in undocumented:
+        failures.append(
+            f"{name}: registered in dist_dqn_tpu/ but missing from the "
+            f"docs/observability.md naming table. Document the family "
+            f"(or add it to DOCS_ALLOWLIST with a rationale).")
     if failures:
         print("check_metrics: FAIL", file=sys.stderr)
         for f in failures:
             print("  " + f, file=sys.stderr)
         return 1
     print(f"check_metrics: OK ({sum(counts.values())} grandfathered "
-          f"call sites in {len(counts)} files)")
+          f"call sites in {len(counts)} files; "
+          f"{len(scan_metric_names(repo_root))} dqn_* families "
+          f"documented)")
     return 0
 
 

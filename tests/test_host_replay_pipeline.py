@@ -132,7 +132,7 @@ class TestGenerationFence:
             while not stop.is_set():
                 if not ring.can_sample(1):
                     continue
-                hb = ring.sample(rng, 64, n_step=1, gamma=0.99)
+                hb = ring.sample(rng, 64, n_step=1, gamma=0.99).batch
                 a = hb.action.astype(np.float32)
                 if not (np.all(hb.obs == a[:, None])
                         and np.all(hb.reward == a)):
@@ -316,17 +316,351 @@ class TestEvacuationWorker:
             hrl.HostTimeRing = orig
 
 
+def test_prefetch_matches_serial_numerics():
+    """THE ISSUE 5 equivalence pin: the background SamplePrefetcher
+    (sample -> gather -> stage off the main thread) must yield
+    IDENTICAL learner results to the --no-prefetch serial reference in
+    uniform mode — per-batch-index RNG streams make batch content a
+    pure function of (k, ring window), so thread timing changes WHEN a
+    batch is drawn, never what is trained on."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _tiny_cfg()
+    out_p = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                            log_fn=lambda s: None, prefetch=True)
+    out_s = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                            log_fn=lambda s: None, prefetch=False)
+    out_ss = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                             log_fn=lambda s: None, prefetch=False,
+                             double_buffer=False)
+    assert out_p["prefetch"] and not out_s["prefetch"]
+    assert out_p["grad_steps"] == out_s["grad_steps"] > 0
+    losses_p = [r["loss"] for r in out_p["history"] if "loss" in r]
+    losses_s = [r["loss"] for r in out_s["history"] if "loss" in r]
+    assert losses_p and losses_p == losses_s
+    assert out_p["param_checksum"] == out_s["param_checksum"]
+    # ...and the double-buffered reference equals the fully serial one.
+    assert out_s["param_checksum"] == out_ss["param_checksum"]
+    # No batch went stale (appends are gated on the event's samples),
+    # and the overlap accounting measured real work on both sides.
+    assert out_p["stale_batches"] == 0
+    assert out_p["sample_s_total"] > 0.0
+    assert out_s["sample_s_total"] > 0.0
+    assert out_s["prefetch_wait_s_total"] == 0.0
+    for row in out_p["history"]:
+        assert row["prefetch_wait_s"] >= 0.0
+        assert row["stale_batches"] == 0
+
+
+def test_host_replay_per_end_to_end():
+    """PER host-replay trains end to end under the full pipeline:
+    write-backs flow (batched, generation-guarded), IS weights are
+    sane, the summary says which sampler ran."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _tiny_cfg()
+    cfg = dataclasses.replace(
+        cfg, replay=dataclasses.replace(cfg.replay, prioritized=True))
+    out = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                          log_fn=lambda s: None, prefetch=True,
+                          prio_writeback_batch=4)
+    assert out["prioritized"] and out["prefetch"]
+    assert out["grad_steps"] > 0
+    assert out["prio_writeback_flushes"] > 0
+    assert out["prio_writeback_rows"] > 0
+    # Every row carries a batch worth of write-backs minus the
+    # generation-guard drops.
+    assert out["prio_writeback_rows"] + out["prio_writeback_dropped"] \
+        == out["grad_steps"] * cfg.learner.batch_size
+    assert 0.0 < out["is_weight_min"] <= out["is_weight_mean"] <= 1.0
+    assert np.isfinite(out["param_checksum"])
+
+
+class TestRingPrioritySampler:
+    def _ring(self, slots=64, lanes=2, steps=48):
+        ring = HostTimeRing(slots, lanes, (3,), np.float32)
+        for lo in range(0, steps, 12):
+            C = min(12, steps - lo)
+            ring.add_chunk(np.ones((C, lanes, 3), np.float32),
+                           np.zeros((C, lanes), np.int32),
+                           np.zeros((C, lanes), np.float32),
+                           np.zeros((C, lanes), bool),
+                           np.zeros((C, lanes), bool))
+        return ring
+
+    def test_oversampling_ratio_and_is_compensation(self):
+        """ISSUE 5 satellite: a slot with 10x the priority of its peers
+        is drawn ~10x as often (alpha=1), and its IS weight compensates
+        by the inverse ratio (beta=1)."""
+        from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
+
+        ring = self._ring()
+        s = RingPrioritySampler(ring, n_step=1, alpha=1.0, beta=1.0,
+                                eps=0.0, name="test_per")
+        # All slots seeded at max priority 1.0; boost ONE valid slot.
+        hot_t, hot_b = 7, 1
+        hot_leaf = np.array([hot_t * ring.num_envs + hot_b])
+        s.update_priorities(hot_leaf, np.array([10.0]),
+                            expected_gen=ring.slot_gen[[hot_t]])
+        rng = np.random.default_rng(3)
+        draws = 40_000
+        hot = others = 0
+        w_hot, w_other = [], []
+        for _ in range(draws // 200):
+            _, aux = s.sample(rng, 200, gamma=0.99)
+            is_hot = aux.leaf == hot_leaf[0]
+            hot += int(is_hot.sum())
+            others += int((~is_hot).sum())
+            w_hot.extend(aux.weights[is_hot].tolist())
+            w_other.extend(aux.weights[~is_hot].tolist())
+        # Expected ratio: p_hot / p_other = 10 (alpha = 1). The hot
+        # slot's draw share vs the MEAN other slot's share:
+        valid_slots = (ring.size - 1) * ring.num_envs  # n_step=1, no
+        per_other = others / (valid_slots - 1)         # dedup context
+        ratio = hot / max(per_other, 1e-9)
+        assert 7.0 < ratio < 13.0, ratio
+        # IS weights: w ~ (N p)^-1, so hot weight / other weight = 1/10.
+        w_ratio = np.mean(w_hot) / np.mean(w_other)
+        assert 0.07 < w_ratio < 0.13, w_ratio
+
+    def test_writeback_generation_guard_drops_overwritten(self):
+        """A write-back whose slot was overwritten between sample and
+        flush must be dropped, not stamped onto the new transition."""
+        from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
+
+        ring = self._ring(slots=16, lanes=2, steps=12)
+        s = RingPrioritySampler(ring, n_step=1, alpha=1.0, beta=1.0,
+                                eps=0.0, name="test_per_guard")
+        rng = np.random.default_rng(0)
+        _, aux = s.sample(rng, 8, gamma=0.99)
+        # Overwrite the whole ring (16 slots) => every sampled slot's
+        # generation moves on.
+        ring.add_chunk(np.zeros((12, 2, 3), np.float32),
+                       np.zeros((12, 2), np.int32),
+                       np.zeros((12, 2), np.float32),
+                       np.zeros((12, 2), bool), np.zeros((12, 2), bool))
+        ring.add_chunk(np.zeros((12, 2, 3), np.float32),
+                       np.zeros((12, 2), np.int32),
+                       np.zeros((12, 2), np.float32),
+                       np.zeros((12, 2), bool), np.zeros((12, 2), bool))
+        applied, dropped = s.update_priorities(
+            aux.leaf, np.full(8, 99.0), expected_gen=aux.slot_gen)
+        assert applied == 0 and dropped == 8
+        # The poisoned priority never entered the tree: no leaf mass
+        # anywhere near 99^alpha.
+        assert s.tree.total < ring.num_slots * ring.num_envs * 2.0
+
+    def test_tree_tracks_appends_under_fence(self):
+        """The publish hook keeps tree mass == valid region after every
+        append, including wraparound evictions."""
+        from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
+
+        ring = HostTimeRing(16, 2, (3,), np.float32)
+        s = RingPrioritySampler(ring, n_step=2, alpha=1.0, beta=1.0,
+                                eps=0.0, name="test_per_sync")
+        for _ in range(5):  # wraps the 16-slot ring
+            ring.add_chunk(np.zeros((8, 2, 3), np.float32),
+                           np.zeros((8, 2), np.int32),
+                           np.zeros((8, 2), np.float32),
+                           np.zeros((8, 2), bool),
+                           np.zeros((8, 2), bool))
+            valid = max(ring.size - 2, 0) * 2  # (size - n_step) * lanes
+            assert s.tree.total == pytest.approx(valid)  # all prio 1.0
+
+
+class TestSamplePrefetcher:
+    """Unit coverage mirroring TestEvacuationWorker: the fence
+    handshake, stale drop+redraw, exception propagation, shutdown."""
+
+    def _ring_and_sampler(self, slots=128, lanes=2):
+        ring = HostTimeRing(slots, lanes, (3,), np.float32)
+
+        def append(v, C=16):
+            ring.add_chunk(np.full((C, lanes, 3), v, np.float32),
+                           np.full((C, lanes), int(v), np.int32),
+                           np.full((C, lanes), v, np.float32),
+                           np.zeros((C, lanes), bool),
+                           np.zeros((C, lanes), bool))
+
+        def sample_fn(k):
+            rng = np.random.default_rng(
+                np.random.SeedSequence(0, spawn_key=(k,)))
+            hs = ring.sample(rng, 32, n_step=1, gamma=0.99)
+            return {"obs": hs.batch.obs, "action": hs.batch.action,
+                    "reward": hs.batch.reward}, hs
+        return ring, append, sample_fn
+
+    def _prefetcher(self, sample_fn, ring, **kw):
+        from dist_dqn_tpu.replay.staging import SamplePrefetcher
+        kw.setdefault("name", "test_prefetch")
+        return SamplePrefetcher(sample_fn, depth=2,
+                                wait_generation=ring.wait_generation,
+                                **kw)
+
+    def test_request_pop_in_order_and_shutdown(self):
+        ring, append, sample_fn = self._ring_and_sampler()
+        append(1.0)
+        p = self._prefetcher(sample_fn, ring)
+        try:
+            p.request(4, ring.generation)
+            batches = [p.pop(ring.generation) for _ in range(4)]
+            # Content is internally consistent and deterministic: the
+            # same k against the same window redraws identically.
+            for k, (dev, aux) in enumerate(batches):
+                obs = np.asarray(dev["obs"])
+                assert np.all(obs == 1.0)
+                redraw, re_aux = sample_fn(k)
+                np.testing.assert_array_equal(
+                    np.asarray(dev["action"]), redraw["action"])
+                assert aux.generation == re_aux.generation
+            assert p.stale_total == 0
+        finally:
+            p.close()
+        assert not p._thread.is_alive()
+
+    def test_request_ahead_of_publication_waits_for_fence(self):
+        """A request for a generation the ring has not reached yet must
+        block the worker on the fence, then sample the NEW window —
+        the handshake that keeps look-ahead honest."""
+        ring, append, sample_fn = self._ring_and_sampler()
+        append(1.0)
+        p = self._prefetcher(sample_fn, ring)
+        try:
+            target = ring.generation + 1
+            p.request(1, target)  # window not published yet
+            time.sleep(0.1)
+            assert len(p) == 0   # worker is parked on the fence
+            append(2.0)          # publish generation 2
+            dev, aux = p.pop(target)
+            assert aux.generation >= target
+        finally:
+            p.close()
+
+    def test_stale_batch_dropped_and_redrawn(self):
+        """A batch sampled against an older window than the pop's fence
+        is counted, dropped and re-drawn at the fenced window."""
+        ring, append, sample_fn = self._ring_and_sampler()
+        append(1.0)
+        p = self._prefetcher(sample_fn, ring)
+        try:
+            old_gen = ring.generation
+            p.request(2, old_gen)
+            # Let the worker sample both batches against the old window.
+            deadline = time.time() + 30
+            while p.sampled_total < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert p.sampled_total == 2
+            append(2.0)  # window moves on
+            dev, aux = p.pop(ring.generation)  # fence ahead of the tags
+            assert p.stale_total >= 1
+            assert aux.generation >= old_gen + 1
+            # The redraw saw the new window: slots from the new chunk
+            # exist, and every obs matches its action stamp (no tear).
+            obs = np.asarray(dev["obs"])
+            act = np.asarray(dev["action"]).astype(np.float32)
+            assert np.all(obs == act[:, None])
+        finally:
+            p.close()
+
+    def test_concurrent_append_vs_prefetch_hammer(self):
+        """Fence hammer: background appends race prefetched sampling;
+        every popped batch must be internally consistent (obs == action
+        == reward stamps) and at least as new as its requested fence."""
+        ring, append, sample_fn = self._ring_and_sampler()
+        append(1.0)
+        p = self._prefetcher(sample_fn, ring)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            v = 2.0
+            while not stop.is_set():
+                append(v)
+                v += 1.0
+                time.sleep(0.001)
+
+        t_w = threading.Thread(target=writer, name="hammer-writer")
+        t_w.start()
+        try:
+            for _ in range(60):
+                fence = ring.generation
+                p.request(1, fence)
+                dev, aux = p.pop(fence)
+                if aux.generation < fence:
+                    errors.append(("stale delivered", aux.generation,
+                                   fence))
+                obs = np.asarray(dev["obs"])
+                act = np.asarray(dev["action"]).astype(np.float32)
+                rew = np.asarray(dev["reward"])
+                if not (np.all(obs == act[:, None])
+                        and np.all(rew == act)):
+                    errors.append(("torn batch", obs[:2], act[:2]))
+        finally:
+            stop.set()
+            t_w.join(timeout=30)
+            p.close()
+        assert not errors, errors[0]
+        assert not p._thread.is_alive()
+
+    def test_worker_exception_propagates_no_hang(self):
+        """An exception inside sample_fn must re-raise from pop() AND
+        poison later requests — never a hung pop."""
+        from dist_dqn_tpu.replay.staging import SamplePrefetcher
+
+        def boom(k):
+            raise RuntimeError("gather exploded")
+
+        p = SamplePrefetcher(boom, depth=2, name="test_prefetch_boom")
+        try:
+            p.request(1, 0)
+            # pop re-raises the worker's own exception (the
+            # _EvacJob.wait discipline); request names the dead worker.
+            with pytest.raises(RuntimeError, match="exploded"):
+                p.pop(0)
+            assert p.failed is not None
+            with pytest.raises(RuntimeError, match="died"):
+                p.request(1, 0)
+        finally:
+            p.close()
+        assert not p._thread.is_alive()
+
+    def test_loop_surfaces_prefetcher_failure(self):
+        """End to end: a sampler that blows up mid-run must abort
+        run_host_replay with the worker's exception, not wedge a pop."""
+        from dist_dqn_tpu import host_replay_loop as hrl
+
+        class _BoomRing(HostTimeRing):
+            def sample(self, *a, **k):
+                if self.generation >= 3:
+                    raise RuntimeError("DRAM gather failed")
+                return super().sample(*a, **k)
+
+        orig = hrl.HostTimeRing
+        hrl.HostTimeRing = _BoomRing
+        try:
+            with pytest.raises(RuntimeError, match="DRAM gather failed"):
+                hrl.run_host_replay(_tiny_cfg(), total_env_steps=3200,
+                                    chunk_iters=50,
+                                    log_fn=lambda s: None,
+                                    prefetch=True)
+        finally:
+            hrl.HostTimeRing = orig
+
+
 def test_host_replay_bench_ab_smoke():
-    """ISSUE 3 CI satellite: the serial-vs-pipelined A/B harness runs
-    end to end on CPU at a tiny size and its trace_ab row reports
-    conserved D2H bytes and matching numerics. Tier-1-safe: one small
+    """ISSUE 3/5 CI satellite: the three-arm A/B harness
+    (uniform-serial vs uniform-prefetch vs PER-prefetch) runs end to
+    end on CPU at a tiny size; the trace_ab row must report conserved
+    D2H bytes, the uniform numerics pin, sample_s measured off the
+    critical path (prefetch_wait < serial sample_s), and a healthy PER
+    arm (nonzero write-backs, sane IS weights). Tier-1-safe: one small
     subprocess, CPU-clamped sizes."""
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}  # never touch the tunnel
     proc = subprocess.run(
         [sys.executable, "benchmarks/host_replay_bench.py", "--allow-cpu",
-         "--ab", "--chunks", "2", "--chunk-iters", "10", "--lanes", "4",
-         "--batch-size", "8", "--train-every", "4", "--window", "4096"],
+         "--ab", "--chunks", "3", "--chunk-iters", "10", "--lanes", "4",
+         "--batch-size", "16", "--train-every", "2", "--window", "4096"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     rows = []
@@ -336,12 +670,22 @@ def test_host_replay_bench_ab_smoke():
         except ValueError:
             pass
     legs = {r.get("phase"): r for r in rows if "phase" in r}
-    assert {"ab_serial", "ab_pipelined", "trace_ab"} <= set(legs)
+    assert {"ab_uniform_serial", "ab_uniform_prefetch",
+            "ab_per_prefetch", "trace_ab"} <= set(legs)
     ab = legs["trace_ab"]
     assert ab["d2h_bytes_conserved"] is True
+    # The uniform numerics pin: prefetching changes WHEN sampling
+    # happens, never what is trained on.
     assert ab["numerics_match"] is True
-    assert ab["pipelined_evac_overlap_frac_mean"] >= 0.0
-    assert legs["ab_pipelined"]["pipeline"] is True
-    assert legs["ab_serial"]["pipeline"] is False
-    assert legs["ab_pipelined"]["grad_steps"] > 0
+    # Acceptance: sample_s measured off the critical path.
+    assert ab["sample_off_critical_path"] is True
+    assert ab["prefetch_wait_s_total"] < ab["serial_sample_s_total"]
+    assert legs["ab_uniform_serial"]["prefetch"] is False
+    assert legs["ab_uniform_prefetch"]["prefetch"] is True
+    assert legs["ab_per_prefetch"]["prioritized"] is True
+    # The PER arm is alive: write-backs flowed, IS weights sane.
+    assert ab["per_prio_writeback_rows"] > 0
+    assert 0.0 < ab["per_is_weight_min"] <= ab["per_is_weight_mean"] \
+        <= 1.0
+    assert legs["ab_per_prefetch"]["grad_steps"] > 0
     assert ab["platforms"] == "cpu"

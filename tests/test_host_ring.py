@@ -86,6 +86,71 @@ def test_host_ring_chunk_wrap_and_bytes():
                        np.zeros((11, 2), bool), np.zeros((11, 2), bool))
 
 
+def _fill_ring(ring, steps, lanes, chunk=10, obs_dim=3):
+    """Append a recognizable stream: obs/action/reward all carry the
+    global step number, so slot identity checks are cross-checkable."""
+    for lo in range(0, steps, chunk):
+        hi = min(lo + chunk, steps)
+        t = np.arange(lo, hi, dtype=np.float32)
+        obs = np.repeat(np.repeat(t[:, None, None], lanes, 1), obs_dim, 2)
+        ring.add_chunk(obs, np.broadcast_to(t[:, None].astype(np.int32),
+                                            (hi - lo, lanes)),
+                       np.broadcast_to(t[:, None], (hi - lo, lanes)),
+                       np.zeros((hi - lo, lanes), bool),
+                       np.zeros((hi - lo, lanes), bool))
+
+
+@pytest.mark.parametrize("steps,extra", [(80, 0), (80, 3)])
+def test_sample_indices_stay_in_valid_region_after_wraparound(steps,
+                                                              extra):
+    """ISSUE 5 satellite (pre-existing test gap): after the ring wraps,
+    sampled (t_idx, b_idx) must stay inside the SAME valid region the
+    uniform draw advertises — the oldest `size - n_step` slots minus
+    the dedup context skip — and the exposed identities must be the
+    slots the batch was actually gathered at."""
+    slots, lanes, n_step = 32, 2, 3
+    stack = extra + 1 if extra else 0
+    ring = HostTimeRing(slots, lanes, (3,) if not stack else (1,),
+                        np.float32, frame_stack=stack)
+    _fill_ring(ring, steps, lanes, obs_dim=3 if not stack else 1)
+    assert ring.size == slots and ring.pos == steps % slots  # wrapped
+
+    offsets = np.arange(extra, ring.size - n_step)
+    valid_t = set(((ring.pos - ring.size + offsets) % slots).tolist())
+    rng = np.random.default_rng(7)
+    hs = ring.sample(rng, 512, n_step=n_step, gamma=0.99)
+    assert set(hs.t_idx.tolist()) <= valid_t
+    assert hs.b_idx.min() >= 0 and hs.b_idx.max() < lanes
+    assert hs.generation == ring.generation
+    # The identities are REAL: the stored stream stamps the global step
+    # number into action AND reward, and the oldest valid slot maps to
+    # step steps - slots + extra — so each sampled action must equal its
+    # slot's stored step, which the t index recovers modulo the ring.
+    stored_step = hs.batch.action  # == global step written at that t
+    assert np.all((stored_step % slots) == (hs.t_idx % slots))
+    # And the gathered batch is the one at those identities: re-gather
+    # at the exposed (t, b) pairs and compare bit-for-bit.
+    again = ring.gather(hs.t_idx, hs.b_idx, n_step, 0.99)
+    np.testing.assert_array_equal(again.obs, hs.batch.obs)
+    np.testing.assert_array_equal(again.reward, hs.batch.reward)
+
+
+def test_slot_generation_stamps_track_overwrites():
+    """slot_gen must carry the generation that last wrote each t-slot —
+    the write-back staleness guard."""
+    ring = HostTimeRing(8, 2, (2,), np.float32)
+    for _ in range(3):  # 3 chunks x 4 slots over an 8-slot ring: wraps
+        ring.add_chunk(np.zeros((4, 2, 2), np.float32),
+                       np.zeros((4, 2), np.int32),
+                       np.zeros((4, 2), np.float32),
+                       np.zeros((4, 2), bool), np.zeros((4, 2), bool))
+    assert ring.generation == 3
+    # slots 0..3 were written by chunk 1 then overwritten by chunk 3;
+    # slots 4..7 by chunk 2.
+    np.testing.assert_array_equal(ring.slot_gen,
+                                  [3, 3, 3, 3, 2, 2, 2, 2])
+
+
 def test_hybrid_loop_vector_env_trains():
     """run_host_replay on CartPole: the full cycle executes, the learner
     steps at the fused cadence, metrics are finite."""

@@ -207,11 +207,15 @@ def test_host_replay_double_buffer_matches_serial():
                                    prioritized=False),
         learner=dataclasses.replace(cfg.learner, batch_size=16),
     )
+    # prefetch=False on both legs: this pin isolates the legacy
+    # main-thread double-buffer knob (the prefetched path owns its own
+    # stager and is pinned by test_host_replay_pipeline.py).
     out_db = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
-                             log_fn=lambda s: None, double_buffer=True)
+                             log_fn=lambda s: None, double_buffer=True,
+                             prefetch=False)
     out_serial = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
                                  log_fn=lambda s: None,
-                                 double_buffer=False)
+                                 double_buffer=False, prefetch=False)
     assert out_db["double_buffer"] and not out_serial["double_buffer"]
     assert out_db["grad_steps"] == out_serial["grad_steps"] > 0
     assert out_db["h2d_staged_bytes"] > 0
@@ -373,7 +377,11 @@ def test_feeder_flags_mutually_exclusive():
         feeder_mod.P_TERMINATED, feeder_mod.P_TRUNCATED = old_t, old_tr
 
 
-def test_host_replay_rejects_recurrent_and_notices_prioritized():
+def test_host_replay_rejects_recurrent_and_logs_active_sampler():
+    """ISSUE 5 satellite: the false "prioritized not supported" notice
+    is gone — a prioritized config RUNS prioritized and the loop logs
+    which sampler is active (with alpha/beta and the write-back batch);
+    a uniform config logs uniform."""
     from dist_dqn_tpu.host_replay_loop import run_host_replay
 
     cfg = CONFIGS["cartpole"]
@@ -389,10 +397,24 @@ def test_host_replay_rejects_recurrent_and_notices_prioritized():
         replay=dataclasses.replace(cfg.replay, capacity=2048, min_fill=32,
                                    prioritized=True),
         learner=dataclasses.replace(cfg.learner, batch_size=8))
-    run_host_replay(cfg_p, total_env_steps=400, chunk_iters=20,
-                    log_fn=notices.append)
-    assert any("prioritized replay not supported" in str(n)
-               for n in notices)
+    out = run_host_replay(cfg_p, total_env_steps=400, chunk_iters=20,
+                          log_fn=notices.append)
+    assert not any("not supported" in str(n) for n in notices)
+    sampler_lines = [str(n) for n in notices
+                     if "sampler: prioritized" in str(n)]
+    assert sampler_lines, notices[:3]
+    assert "alpha=0.6" in sampler_lines[0]
+    assert "beta=0.4" in sampler_lines[0]
+    assert "prio_writeback_batch=8" in sampler_lines[0]
+    assert out["prioritized"] is True
+
+    uniform_notices = []
+    cfg_u = dataclasses.replace(
+        cfg_p, replay=dataclasses.replace(cfg_p.replay,
+                                          prioritized=False))
+    run_host_replay(cfg_u, total_env_steps=400, chunk_iters=20,
+                    log_fn=uniform_notices.append)
+    assert any("sampler: uniform" in str(n) for n in uniform_notices)
 
 
 def test_host_replay_validates_chunk_iters_before_compile():
