@@ -154,6 +154,15 @@ def _sizes(smoke: bool) -> dict:
         "prioritized": os.environ.get("BENCH_PRIORITIZED") == "1",
         "pallas_sampler": os.environ.get("BENCH_PALLAS_SAMPLER") == "1",
         "frame_dedup": frame_dedup,
+        # Learner-utilization knobs (ISSUE 6): grad sub-steps per train
+        # event (scanned on device), pow2-bucketed train-batch widening
+        # (0 = batch as-is), and the actor-inference dtype split. The
+        # defaults reproduce the pre-knob program exactly; the BENCH
+        # JSON always records all three next to mfu so the trajectory
+        # knows WHICH configuration produced each number.
+        "replay_ratio": _env_int("BENCH_REPLAY_RATIO", 1),
+        "train_batch": _env_int("BENCH_TRAIN_BATCH", 0),
+        "actor_dtype": os.environ.get("BENCH_ACTOR_DTYPE", "float32"),
     }
 
 
@@ -237,11 +246,16 @@ def _learner_step_flops(jax, cfg, env, net):
     from dist_dqn_tpu.types import Transition
     from dist_dqn_tpu.utils import flops as flops_util
 
+    from dist_dqn_tpu import loop_common
+
     init, train_step = make_learner(net, cfg.learner)
     obs_shape = env.observation_shape
     obs_dtype = np.dtype(env.observation_dtype)
     state = init(jax.random.PRNGKey(0), jax.numpy.zeros(obs_shape, obs_dtype))
-    B = cfg.learner.batch_size
+    # The census must price the step the fused program ACTUALLY runs:
+    # the bucketed train width, not the nominal batch_size — otherwise
+    # a BENCH_TRAIN_BATCH-widened row under-reports mfu by the ratio.
+    B = loop_common.resolve_train_batch(cfg)
     r = np.random.default_rng(0)
 
     def obs():
@@ -303,10 +317,15 @@ def _measure(jax, device, smoke: bool):
             prioritized=s["prioritized"],
             pallas_sampler=s["pallas_sampler"],
             frame_dedup=s["frame_dedup"],
+            updates_per_chunk=s["replay_ratio"],
+            train_batch=s["train_batch"],
             min_fill=128 if smoke else 4_096),
         learner=dataclasses.replace(
             cfg.learner,
             batch_size=s["batch"]),
+        network=dataclasses.replace(
+            cfg.network,
+            actor_dtype=s["actor_dtype"]),
         train_every=s["train_every"],
     )
     env = make_jax_env(cfg.env_name)
@@ -351,7 +370,6 @@ def _measure(jax, device, smoke: bool):
         reg.histogram(tmc.GRAD_LATENCY,
                       "per-grad-step share of the chunk wall") \
             .observe(dt / measure_chunks / gsteps)
-    extras["telemetry"] = telemetry.snapshot(reg)
     # Run manifest (ISSUE 4 satellite): BENCH rows self-describe their
     # provenance — git sha, jax/numpy versions, platform, the exact
     # measured config (hashed), argv, schema_version — the same block
@@ -364,6 +382,13 @@ def _measure(jax, device, smoke: bool):
         # ON by default since round 5: the default contract line carries
         # this field (value/unit/vs_baseline schema unchanged).
         extras["frame_dedup"] = True
+    # Learner-utilization config provenance (ISSUE 6): ALWAYS next to
+    # mfu, so every BENCH row names the replay ratio / effective train
+    # batch / actor dtype that produced its utilization numbers.
+    from dist_dqn_tpu import loop_common as _lc
+    extras["replay_ratio"] = s["replay_ratio"]
+    extras["train_batch"] = _lc.resolve_train_batch(cfg)
+    extras["actor_dtype"] = s["actor_dtype"]
     # Conventional MFU: learner fwd+bwd+optimizer FLOPs only. Grad-step
     # count uses the last chunk's census — the cadence is deterministic in
     # steady state, so every measured chunk ran the same number (reading
@@ -377,6 +402,16 @@ def _measure(jax, device, smoke: bool):
         extras["learner_grad_steps_per_sec"] = round(grad_steps / dt, 2)
     if "mfu" in learner:
         extras["mfu"] = learner["mfu"]
+        reg.gauge(tmc.LEARNER_MFU,
+                  "achieved learner FLOP/s over chip bf16 peak",
+                  {"loop": "fused"}).set(learner["mfu"])
+    if grad_steps:
+        reg.gauge(tmc.LEARNER_GRAD_RATE,
+                  "grad steps per second (measured window)",
+                  {"loop": "fused"}).set(grad_steps / dt)
+    # Snapshot LAST so the embedded registry block carries the learner-
+    # utilization gauges set above.
+    extras["telemetry"] = telemetry.snapshot(reg)
     return value, extras
 
 

@@ -347,6 +347,40 @@ class ApexLearnerService:
         else:
             self._train_step = self._shard_train_step(train_step, axis)
 
+        # Replay-ratio engine (ISSUE 6): fold N grad sub-steps into ONE
+        # scanned dispatch (agents/dqn.py make_scan_train) — the apex
+        # learner takes the same scan path the fused loop runs, so on a
+        # round-trip-priced tunnel one dispatch buys N steps. Train-
+        # event batches resolve through the same pow2 bucket rule as
+        # the other runtimes (loop_common.resolve_train_batch).
+        from dist_dqn_tpu import loop_common
+        self.replay_ratio = loop_common.resolve_replay_ratio(cfg)
+        self.train_batch = loop_common.resolve_train_batch(cfg)
+        self._train_scan = None
+        if self.replay_ratio > 1:
+            if self.recurrent or self.distributed or self.n_learners != 1:
+                log_fn("# replay.updates_per_chunk > 1 is not supported "
+                       "on the recurrent / multi-learner / multi-host "
+                       "apex paths yet; running at replay ratio 1")
+                self.replay_ratio = 1
+            else:
+                from dist_dqn_tpu.agents.dqn import make_scan_train
+                self._train_scan = jax.jit(make_scan_train(train_step),
+                                           donate_argnums=0)
+        if self.distributed and self.train_batch != cfg.learner.batch_size:
+            log_fn("# replay.train_batch widening is single-host only "
+                   "(multi-host batches shard from learner.batch_size); "
+                   "ignored")
+            self.train_batch = cfg.learner.batch_size
+        # The apex actors pull the live learner params for acting; the
+        # once-per-chunk bf16 snapshot the fused/host-replay loops cast
+        # has no natural boundary here yet — say so, act in fp32.
+        self.actor_dtype = "float32"
+        if cfg.network.actor_dtype not in ("", "float32"):
+            log_fn("# network.actor_dtype is not applied by the apex "
+                   "service yet (acting uses the live learner params); "
+                   "running actor inference in float32")
+
         self.replay = PrioritizedHostReplay(
             cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
             priority_eps=cfg.replay.priority_eps,
@@ -500,6 +534,18 @@ class ApexLearnerService:
         self._tm_actor_alive: Dict[int, object] = {}
         self._tm_episodes = reg.counter(
             "dqn_episodes_completed_total", "training episodes finished")
+        # Learner-utilization config surface (ISSUE 6): which replay
+        # ratio / batch width / actor dtype shaped this learner's rate.
+        _ll = {"loop": "apex"}
+        reg.gauge(tmc.LEARNER_REPLAY_RATIO,
+                  "grad sub-steps per scanned train dispatch",
+                  _ll).set(self.replay_ratio)
+        reg.gauge(tmc.LEARNER_TRAIN_BATCH,
+                  "effective (bucketed) train batch width",
+                  _ll).set(self.train_batch)
+        reg.gauge(tmc.LEARNER_ACTOR_DTYPE_INFO,
+                  "1 for the active actor inference dtype",
+                  {**_ll, "dtype": self.actor_dtype}).set(1)
         # None until the FIRST mirror exists: construction->first-refresh
         # spans the jit compile and is not mirror staleness — observing
         # it would park a false 60s+ outlier in the triage histogram.
@@ -1111,6 +1157,36 @@ class ApexLearnerService:
             self._stager.stage(self._host_train_args(items, weights),
                                aux=(idx, gen))
 
+    def _sample_scan_args(self, batch_size: int, beta: float):
+        """N independently-drawn batches stacked on a leading sub-step
+        axis for the replay-ratio scan dispatch (ISSUE 6); aux carries
+        the CONCATENATED (idx, gen) in sub-step order, matching the
+        flattened [N*B] priorities the scan returns — chronological,
+        so the batched write-back's last-wins holds across sub-steps."""
+        from dist_dqn_tpu.types import Transition
+        items_l, idx_l, w_l, gen_l = [], [], [], []
+        with self.tracer.span("replay.sample", batch=batch_size,
+                              substeps=self.replay_ratio):
+            for _ in range(self.replay_ratio):
+                items, idx, weights = self.replay.sample(batch_size, beta)
+                items_l.append(items)
+                idx_l.append(idx)
+                w_l.append(np.asarray(weights, np.float32))
+                gen_l.append(self.replay.generation(idx))
+        batch = Transition(*(np.stack([it[k] for it in items_l])
+                             for k in ("obs", "action", "reward",
+                                       "discount", "next_obs")))
+        return ((batch, np.stack(w_l)),
+                (np.concatenate(idx_l), np.concatenate(gen_l)))
+
+    def _stage_scan_batch(self, batch_size: int, beta: float) -> None:
+        """The scan path's ``_stage_batch`` twin: sample N stacked
+        batches and begin their H2D upload behind the stager."""
+        args, aux = self._sample_scan_args(batch_size, beta)
+        with self.tracer.span("h2d.stage", batch=batch_size,
+                              substeps=self.replay_ratio):
+            self._stager.stage(args, aux=aux)
+
     def _min_fill_items(self) -> int:
         """min_fill counts transitions; in sequence mode convert to
         sequences (each loss region covers unroll_length steps)."""
@@ -1135,9 +1211,11 @@ class ApexLearnerService:
             return self._maybe_train_distributed()
         if len(self.replay) < self._min_fill_items():
             return
-        target = self.replay.added // self._inserts_per_grad()
-        self._train_to_target(target, self.env_steps,
-                              self.cfg.learner.batch_size)
+        # The replay ratio multiplies the grad-step/insert cadence: N
+        # sub-steps per collected chunk of inserts (ISSUE 6).
+        target = (self.replay.added * self.replay_ratio
+                  // self._inserts_per_grad())
+        self._train_to_target(target, self.env_steps, self.train_batch)
 
     def _maybe_train_distributed(self):
         """Multi-host cadence (actors/multihost.py): agree on global
@@ -1179,6 +1257,39 @@ class ApexLearnerService:
                    + (1 - cfg.replay.importance_exponent)
                    * progress_steps / max(self.rt.total_env_steps, 1))
         while self.grad_steps < target_grad_steps:
+            if self._train_scan is not None:
+                # Replay-ratio scan path (ISSUE 6): one dispatch runs N
+                # sub-steps over independently-drawn stacked batches.
+                # The per-pass bound may be overshot by up to N-1 steps
+                # (the dispatch is atomic); the cadence debt absorbs it.
+                if self._stager is not None:
+                    if len(self._stager) == 0:
+                        self._stage_scan_batch(batch_size, beta)
+                    args, (idx, gen) = self._stager.pop()
+                    with self.tracer.span("train_step.dispatch",
+                                          substeps=self.replay_ratio):
+                        self.state, metrics = self._train_scan(self.state,
+                                                               *args)
+                    self._count_device_call("train")
+                    if self.grad_steps + self.replay_ratio \
+                            < target_grad_steps:
+                        self._stage_scan_batch(batch_size, beta)
+                else:
+                    args, (idx, gen) = self._sample_scan_args(batch_size,
+                                                              beta)
+                    args = self.jax.tree.map(jnp.asarray, args)
+                    with self.tracer.span("train_step.dispatch",
+                                          substeps=self.replay_ratio):
+                        self.state, metrics = self._train_scan(self.state,
+                                                               *args)
+                    self._count_device_call("train")
+                self.grad_steps += self.replay_ratio
+                self._tm_grad_steps.inc(self.replay_ratio)
+                self._in_flight.append((idx, gen, metrics,
+                                        time.perf_counter()))
+                while len(self._in_flight) > self.rt.pipeline_depth:
+                    self._finalize_train()
+                continue
             if self._stager is not None:
                 # Double-buffered path: batch g comes off the stager
                 # (uploaded while step g-1 trained); batch g+1 is staged
@@ -1565,6 +1676,10 @@ class ApexLearnerService:
             self.tracer.close()
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
+                # Learner-utilization config provenance (ISSUE 6).
+                "replay_ratio": self.replay_ratio,
+                "train_batch": self.train_batch,
+                "actor_dtype": self.actor_dtype,
                 "global_env_steps": self.global_env_steps,
                 "episodes_completed": self.episodes_completed,
                 "episode_return_recent":

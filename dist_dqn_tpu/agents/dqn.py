@@ -311,6 +311,47 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     return init, train_step
 
 
+def make_scan_train(train_step: Callable) -> Callable:
+    """Fold N train sub-steps into ONE dispatched program (ISSUE 6).
+
+    ``scan_train(state, batches, weights)`` scans ``train_step`` over a
+    stacked batch pytree with leading sub-step axis N — the apex
+    service's replay-ratio path: on a round-trip-priced device link one
+    dispatch buys N grad steps, the same lever the fused loop gets from
+    its in-chunk scan. Scanning the SAME train_step the serial path
+    jits keeps the math identical (pinned by tests/test_replay_ratio
+    .py: scan over N == N serial steps, bit-for-bit).
+
+    Returned metrics keep the serial step's contract where the host
+    consumes them: ``priorities`` flatten to [N*B] in sub-step order
+    (chronological — what the batched last-wins write-back expects),
+    ``loss``/``raw_loss``/``mean_q_target_gap`` are sub-step means, and
+    ``grad_norm`` is the LAST sub-step's (the freshest divergence
+    signal for the sentinel).
+    """
+
+    def scan_train(state: LearnerState, batches: Transition,
+                   weights: Array) -> Tuple[LearnerState, dict]:
+        def body(s, xs):
+            batch, w = xs
+            s, m = train_step(s, batch, w)
+            return s, (m["loss"], m["raw_loss"], m["priorities"],
+                       m["grad_norm"], m["mean_q_target_gap"])
+
+        state, (loss, raw, prios, gnorm, gap) = jax.lax.scan(
+            body, state, (batches, weights))
+        metrics = {
+            "loss": jnp.mean(loss),
+            "raw_loss": jnp.mean(raw),
+            "priorities": prios.reshape(-1),
+            "grad_norm": gnorm[-1],
+            "mean_q_target_gap": jnp.mean(gap),
+        }
+        return state, metrics
+
+    return scan_train
+
+
 def make_actor_step(net: nn.Module) -> Callable:
     """Epsilon-greedy acting on scalar Q-values (any head type).
 

@@ -50,6 +50,14 @@ class NetworkConfig:
     # scan iteration; the math is unchanged).
     lstm_dtype: str = "float32"        # "bfloat16" runs cell matmuls on MXU
     lstm_unroll: int = 1
+    # Actor/learner dtype split (ISSUE 6): "bfloat16" casts the params
+    # ONCE per chunk for actor inference (acting reads a bf16 snapshot
+    # of the chunk-entry params — one target-network's worth of extra
+    # staleness, Podracer-style) while the learner keeps fp32 master
+    # params end to end. "float32" (default) acts on the live learner
+    # params exactly as before — bit-identical, pinned by the
+    # param_checksum A/B in tests/test_replay_ratio.py.
+    actor_dtype: str = "float32"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,6 +97,25 @@ class ReplayConfig:
     # ring (replay/device.py) and the R2D2 sequence ring
     # (replay/sequence_device.py _rebuild_seq_stacks).
     frame_dedup: bool = False
+    # On-device replay ratio (ISSUE 6, --replay-ratio): grad sub-steps
+    # per train event, each drawing an INDEPENDENT replay batch from a
+    # fresh RNG split, scanned inside the jitted chunk program (fused
+    # loop) / one scanned device dispatch (apex) / one prefetched run
+    # of batches (host-replay). Multiplies updates_per_train; 1 is
+    # bit-identical to the pre-knob program (the train-event scan has
+    # the same length and key stream), and with UNIFORM replay ratio N
+    # == updates_per_train=N bit-for-bit. Under PER with ratio > 1 the
+    # sub-steps' |TD| write-backs are deferred and flushed ONCE per
+    # event with chronological last-wins semantics (PR 5's discipline),
+    # so sub-steps sample against event-entry priorities — the same lag
+    # contract as the host loops' prio_writeback_batch.
+    updates_per_chunk: int = 1
+    # Wide train batches (ISSUE 6): 0 = learner.batch_size unchanged;
+    # > 0 widens the train-event batch to this many rows, rounded UP to
+    # the next power of two (the ingest bucket discipline — bounded
+    # compile variants, MXU-friendly tiles). Sized empirically with
+    # benchmarks/learner_bench.py --batch-sweep.
+    train_batch: int = 0
     # R2D2 sequence replay (>0 enables sequence mode):
     burn_in: int = 0
     unroll_length: int = 0

@@ -173,10 +173,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
-    ``cfg.train_every`` env iterations, ``cfg.updates_per_train`` grad
-    steps each, batches sampled from the host ring — uniformly, or by
-    sum-tree priority when ``prioritized`` (default:
-    ``cfg.replay.prioritized``) is set.
+    ``cfg.train_every`` env iterations, ``cfg.updates_per_train *
+    cfg.replay.updates_per_chunk`` grad steps each (the ISSUE 6 replay
+    ratio — the prefetcher simply draws that many batches per event),
+    batches sampled from the host ring at the pow2-bucketed
+    ``replay.train_batch`` width — uniformly, or by sum-tree priority
+    when ``prioritized`` (default: ``cfg.replay.prioritized``) is set.
 
     ``pipeline`` selects the three-stage software pipeline (streamed
     sub-chunk evacuation drained by a background worker, trains fenced
@@ -265,6 +267,23 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
     init_learner, train_step = make_learner(net, cfg.learner)
     train_jit = jax.jit(train_step, donate_argnums=0)
+    # Replay-ratio engine (ISSUE 6): multiplies the grad steps each
+    # train event runs — the SamplePrefetcher simply draws that many
+    # batches ahead, so the ratio rides the existing sample pipeline.
+    replay_ratio = loop_common.resolve_replay_ratio(cfg)
+    # Wide bucketed train batches (ISSUE 6): resolved through the same
+    # pow2 rule as the fused loop; default = learner.batch_size exactly.
+    train_batch = loop_common.resolve_train_batch(cfg)
+    # Actor-dtype split (ISSUE 6): collect already acts on chunk-stale
+    # params by construction (the collect-ahead schedule), so the bf16
+    # snapshot costs ONE extra cast dispatch per chunk and no extra
+    # staleness. Learner masters stay fp32 untouched.
+    _cast_actor, _actor_split = loop_common.make_actor_param_cast(
+        cfg.network.actor_dtype)
+    cast_jit = jax.jit(_cast_actor) if _actor_split else None
+
+    def collect_params(state):
+        return cast_jit(state.params) if _actor_split else state.params
 
     ring = HostTimeRing(num_slots, B, stored_shape,
                         np.dtype(env.observation_dtype), frame_stack=stack)
@@ -307,7 +326,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         """Batch k's host-side sample+gather -> (host pytree, aux)."""
         rng_k = _batch_rng(k)
         if per_sampler is not None:
-            hb, aux = per_sampler.sample(rng_k, cfg.learner.batch_size,
+            hb, aux = per_sampler.sample(rng_k, train_batch,
                                          cfg.learner.gamma)
             tr = Transition(obs=hb.obs, action=hb.action,
                             reward=hb.reward, discount=hb.discount,
@@ -315,7 +334,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # IS weights travel WITH the batch through the staging
             # pipeline, so the upload and the bookkeeping stay one unit.
             return (tr, aux.weights), aux
-        hs = ring.sample(rng_k, cfg.learner.batch_size,
+        hs = ring.sample(rng_k, train_batch,
                          cfg.learner.n_step, cfg.learner.gamma)
         hb = hs.batch
         tr = Transition(obs=hb.obs, action=hb.action, reward=hb.reward,
@@ -380,13 +399,27 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     c_d2h = reg.counter(tmc.HOST_REPLAY_D2H_BYTES,
                         "bytes evacuated device->host by the replay "
                         "pipeline", _labels)
+    # Learner-utilization config surface (ISSUE 6): which replay ratio /
+    # batch width / actor dtype produced this process's learner numbers.
+    reg.gauge(tmc.LEARNER_REPLAY_RATIO,
+              "grad sub-steps per train event", _labels).set(replay_ratio)
+    reg.gauge(tmc.LEARNER_TRAIN_BATCH,
+              "effective (bucketed) train batch width",
+              _labels).set(train_batch)
+    reg.gauge(tmc.LEARNER_ACTOR_DTYPE_INFO,
+              "1 for the active actor inference dtype",
+              {**_labels, "dtype": cfg.network.actor_dtype
+               or "float32"}).set(1)
+    g_grad_rate = reg.gauge(tmc.LEARNER_GRAD_RATE,
+                            "grad steps per second (whole loop)",
+                            _labels)
 
     # Train-event cadence carries its remainder across chunks so the
     # average exactly matches the fused loop's one-event-per-train_every
     # iterations (chunk_iters need not divide train_every).
-    updates_per_train = max(cfg.updates_per_train, 1)
+    updates_per_train = max(cfg.updates_per_train, 1) * replay_ratio
     train_debt_iters = 0
-    weights = jnp.ones((cfg.learner.batch_size,), jnp.float32)
+    weights = jnp.ones((train_batch,), jnp.float32)
 
     # Batched priority write-backs (ISSUE 5, PER only): each train
     # step's |TD| plane stays a device array in this pending list (its
@@ -435,8 +468,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         records = stats = handle = None
         if num_chunks:
             # Chunk 0: prologue dispatch + evacuation submit.
-            carry, records, stats = collect_jit(carry, state.params,
-                                                chunk_iters)
+            carry, records, stats = collect_jit(
+                carry, collect_params(state), chunk_iters)
             if pipeline:
                 handle = worker.submit(records)
                 records = None
@@ -453,7 +486,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 # paths stay bit-identical).
                 if g + 1 < num_chunks:
                     carry, next_records, next_stats = collect_jit(
-                        carry, state.params, chunk_iters)
+                        carry, collect_params(state), chunk_iters)
                 hb_collect.beat()
                 t_dispatch = time.perf_counter()
                 # Stage 2 — fence on chunk g's evacuation (submitted
@@ -491,7 +524,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 del host
                 if g + 1 < num_chunks:
                     carry, next_records, next_stats = collect_jit(
-                        carry, state.params, chunk_iters)
+                        carry, collect_params(state), chunk_iters)
                 hb_collect.beat()
             records = next_records
             fr.record("fence", "host_replay.chunk", chunk=g,
@@ -702,10 +735,17 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         tm_watchdog.observe_divergence(param_checksum=param_checksum,
                                        step=grad_steps)
     n = max(len(overlap_fracs), 1)
+    g_grad_rate.set(grad_steps / wall)
     return {
         "env_steps": env_steps, "grad_steps": grad_steps,
         "wall_s": round(wall, 1),
         "env_steps_per_sec": round(env_steps / wall, 1),
+        "grad_steps_per_sec": round(grad_steps / wall, 1),
+        # Learner-utilization config provenance (ISSUE 6): the knobs
+        # that shaped this run's grad-step numbers.
+        "replay_ratio": replay_ratio,
+        "train_batch": train_batch,
+        "actor_dtype": cfg.network.actor_dtype or "float32",
         "ring_transitions": ring.size * B,
         "ring_gb": round(ring.nbytes / 1e9, 3),
         "window_transitions_max": num_slots * B,

@@ -20,15 +20,72 @@ from dist_dqn_tpu.config import ExperimentConfig
 Array = jnp.ndarray
 
 
+def resolve_train_batch(cfg: ExperimentConfig) -> int:
+    """Effective train-event batch width (ISSUE 6).
+
+    ``replay.train_batch == 0`` keeps ``learner.batch_size`` EXACTLY
+    (the bit-identity contract for existing configs); > 0 widens the
+    train batch to that many rows — sequences, on the R2D2 loops —
+    rounded up to the next power of two by the SAME ``pad_pow2`` the
+    ingest act bucketing uses (replay/host.py), so the two bucket
+    policies cannot drift apart. Every runtime's learner resolves
+    through here.
+    """
+    from dist_dqn_tpu.replay.host import pad_pow2
+
+    if cfg.replay.train_batch <= 0:
+        return cfg.learner.batch_size
+    return pad_pow2(cfg.replay.train_batch)
+
+
+def resolve_replay_ratio(cfg: ExperimentConfig) -> int:
+    """Validated on-device replay ratio (``replay.updates_per_chunk``):
+    grad sub-steps per train event, >= 1."""
+    r = cfg.replay.updates_per_chunk
+    if r < 1:
+        raise ValueError(
+            f"replay.updates_per_chunk must be >= 1, got {r}")
+    return r
+
+
+def make_actor_param_cast(actor_dtype: str):
+    """(cast_fn, active) for the actor/learner dtype split (ISSUE 6).
+
+    ``actor_dtype="float32"`` (default) returns an identity and
+    ``active=False`` — acting reads the live learner params, exactly
+    the pre-split program. "bfloat16" returns a tree-cast of float
+    leaves (params only; integer leaves untouched) the loops apply ONCE
+    per chunk, keeping the learner's fp32 masters untouched.
+    """
+    if actor_dtype in ("", "float32"):
+        return (lambda params: params), False
+    if actor_dtype != "bfloat16":
+        raise ValueError(
+            f"network.actor_dtype must be 'float32' or 'bfloat16', got "
+            f"{actor_dtype!r}")
+    dt = jnp.bfloat16
+
+    def cast(params):
+        return jax.tree.map(
+            lambda x: x.astype(dt)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+    return cast, True
+
+
 def shard_sizes(cfg: ExperimentConfig, num_shards: int) -> Tuple[int, int]:
-    """Validate divisibility and return per-shard (num_envs, batch_size)."""
+    """Validate divisibility and return per-shard (num_envs,
+    train_batch) — the batch side resolved through the ISSUE 6 bucket
+    rule (``resolve_train_batch``; identical to learner.batch_size
+    unless replay.train_batch widens it)."""
+    train_batch = resolve_train_batch(cfg)
     for name, total in (("num_envs", cfg.actor.num_envs),
-                        ("batch_size", cfg.learner.batch_size)):
+                        ("train_batch", train_batch)):
         if total % num_shards:
             raise ValueError(f"{name}={total} not divisible by "
                              f"num_shards={num_shards}")
     return (cfg.actor.num_envs // num_shards,
-            cfg.learner.batch_size // num_shards)
+            train_batch // num_shards)
 
 
 FLAT_AUTO_BYTES = 2 << 30

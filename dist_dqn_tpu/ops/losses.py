@@ -14,8 +14,17 @@ Array = jnp.ndarray
 
 
 def huber(x: Array, delta: float = 1.0) -> Array:
-    """Huber loss elementwise; quadratic within ``delta``, linear outside."""
-    abs_x = jnp.abs(x)
+    """Huber loss elementwise; quadratic within ``delta``, linear outside.
+
+    Computed in float32 regardless of the input dtype: the per-example
+    values double as PER priorities and IS-weighted loss terms, and the
+    ISSUE 6 actor/learner dtype split makes bf16-valued TD inputs a
+    config choice rather than an impossibility — a bf16 priority plane
+    would quantize the sum-tree mass. Today's heads already emit f32
+    (models/qnets.py casts at the head), so the upcast is an identity
+    there — bit-identical, no new program for existing configs.
+    """
+    abs_x = jnp.abs(x.astype(jnp.float32))
     quad = jnp.minimum(abs_x, delta)
     return 0.5 * quad * quad + delta * (abs_x - quad)
 

@@ -130,7 +130,18 @@ def make_mesh_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                           mesh: Mesh, axis: str = "dp"):
     """Returns (init, run) on GLOBAL arrays: ``init(key)`` builds the pod-
     wide carry; ``run(carry, num_iters)`` executes a fused chunk across the
-    mesh and reports global metrics."""
+    mesh and reports global metrics.
+
+    The ISSUE 6 learner-utilization knobs ride the per-device body
+    unchanged: the replay-ratio scan and the deferred PER flush run
+    inside each shard's chunk (every device draws its own sub-step
+    batches from its local replay shard; gradients still pmean once per
+    sub-step), and the pow2-bucketed ``replay.train_batch`` resolves
+    through ``loop_common.shard_sizes`` — so the per-shard width, not
+    the global one, must divide evenly. The donated global carry keeps
+    the same aliasing contract the single-chip audit pins
+    (utils/donation.py): ``run`` donates argnum 0 below.
+    """
     ndp = mesh.shape[axis]
     init_local, run_local = make_fused_train(cfg, env, net, axis_name=axis,
                                              num_shards=ndp)

@@ -52,6 +52,18 @@ def make_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
     """Returns (init, run_chunk) — same contract as train_loop.make_fused_train."""
     spmd = axis_name is not None
     rcfg = cfg.replay
+    # Honest-unsupported-surface gate (the host_replay lstm_size
+    # pattern): the ISSUE 6 replay-ratio scan exists only in the
+    # feed-forward loops — a recurrent config setting the knob must
+    # fail loudly, not silently train at ratio 1 (the --replay-ratio
+    # CLI flag is warned-and-stripped by train.py before it gets here;
+    # this catches the --set/config path). replay.train_batch IS
+    # honored: it widens the sequence batch through shard_sizes below.
+    if rcfg.updates_per_chunk != 1:
+        raise ValueError(
+            "replay.updates_per_chunk (the replay-ratio scan) is not "
+            "supported by the recurrent R2D2 loop yet; leave it at 1 "
+            "or use a feed-forward config")
     seq_len = rcfg.burn_in + rcfg.unroll_length + cfg.learner.n_step
     stride = rcfg.sequence_stride or rcfg.unroll_length
     init_learner, train_step = make_r2d2_learner(net, cfg.learner, rcfg,

@@ -176,6 +176,32 @@ def contextful_start_mask(state: TimeRingState, frame_stack: int) -> Array:
     return jnp.logical_and(offset >= extra, offset < state.size)
 
 
+def last_write_wins_scatter(plane: Array, flat_idx: Array, values: Array
+                            ) -> Array:
+    """Scatter ``values`` into flat ``plane`` with DETERMINISTIC
+    chronological last-write-wins on duplicate indices (ISSUE 6).
+
+    XLA scatter leaves the application order of duplicate indices
+    implementation-defined, so a plain ``.at[idx].set(v)`` cannot
+    promise which of N replay-ratio sub-steps' |TD| values a
+    twice-sampled slot ends up with. This routes every non-final
+    writer of a slot out of bounds (``mode='drop'``) after electing
+    the chronologically LAST writer with a scatter-max over write
+    positions — one vectorized pass, no host round trip, and the same
+    last-wins contract the host-side batched write-backs keep
+    (host_ring.RingPrioritySampler / actors/service.py).
+
+    Args: plane [S] flat target; flat_idx [M] int32 write positions in
+    chronological order; values [M]. Returns the updated [S] plane.
+    """
+    order = jnp.arange(1, flat_idx.shape[0] + 1, dtype=jnp.int32)
+    # Last writer per slot: max write position landing on it (0 = none).
+    winner = jnp.zeros(plane.shape[0], jnp.int32).at[flat_idx].max(order)
+    keep = winner[flat_idx] == order
+    safe_idx = jnp.where(keep, flat_idx, plane.shape[0])  # OOB -> dropped
+    return plane.at[safe_idx].set(values, mode="drop")
+
+
 def stack_rebuild_indices(done_at, t_idx: Array, frame_stack: int,
                           num_slots: int):
     """Per-channel ring slots that rebuild a frame stack stored deduped.

@@ -126,3 +126,29 @@ def prioritized_ring_update(state: PrioritizedRingState, t_idx: Array,
     return PrioritizedRingState(
         ring=state.ring, priorities=priorities,
         max_priority=jnp.maximum(state.max_priority, jnp.max(p)))
+
+
+def prioritized_ring_update_batched(state: PrioritizedRingState,
+                                    t_idx: Array, b_idx: Array,
+                                    new_priorities: Array,
+                                    eps: float = 1e-6
+                                    ) -> PrioritizedRingState:
+    """One flush for N sub-steps' write-backs (ISSUE 6 replay ratio).
+
+    The replay-ratio scan defers each sub-step's |TD| plane and lands
+    them all HERE, once per train event, with chronological
+    last-write-wins on slots several sub-steps sampled — the on-device
+    twin of the host loops' ``prio_writeback_batch`` semantics (PR 2/
+    PR 5: vectorized update, later step wins). Inputs are [N, S] (or
+    already flat [M]) in sub-step order; flattening row-major keeps
+    chronology, so ``last_write_wins_scatter``'s election is exact.
+    """
+    T, B = state.priorities.shape
+    t_flat = t_idx.reshape(-1)
+    b_flat = b_idx.reshape(-1)
+    p = jnp.abs(new_priorities.reshape(-1)) + eps
+    flat = ring.last_write_wins_scatter(
+        state.priorities.reshape(-1), t_flat * B + b_flat, p)
+    return PrioritizedRingState(
+        ring=state.ring, priorities=flat.reshape(T, B),
+        max_priority=jnp.maximum(state.max_priority, jnp.max(p)))

@@ -74,6 +74,18 @@ HOST_REPLAY_PRIO_WB_ROWS = "dqn_host_replay_prio_writeback_rows_total"
 HOST_REPLAY_PRIO_WB_DROPPED = \
     "dqn_host_replay_prio_writeback_dropped_total"
 
+# Learner-utilization engine (ISSUE 6): the replay-ratio / batch-width
+# / actor-dtype configuration that produced a process's learner
+# throughput, plus the achieved rate and (where a chip peak is known,
+# bench.py) MFU. Config gauges are labeled {loop=...} like the
+# host-replay families; ACTOR_DTYPE_INFO is a Prometheus info-style
+# gauge — constant 1 with the dtype in the {dtype=...} label.
+LEARNER_REPLAY_RATIO = "dqn_learner_replay_ratio"
+LEARNER_TRAIN_BATCH = "dqn_learner_train_batch_size"
+LEARNER_ACTOR_DTYPE_INFO = "dqn_learner_actor_dtype_info"
+LEARNER_GRAD_RATE = "dqn_learner_grad_steps_per_sec"
+LEARNER_MFU = "dqn_learner_mfu"
+
 # Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
 # heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
 # (the full stage table is in docs/observability.md), divergence trips

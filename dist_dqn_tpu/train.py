@@ -114,7 +114,24 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                                  "training episodes finished"),
         "ep_return": _reg.gauge("dqn_episode_return",
                                 "chunk-mean finished-episode return"),
+        "grad_rate": _reg.gauge(tmc.LEARNER_GRAD_RATE,
+                                "grad steps per second (last chunk)",
+                                {"loop": "fused"}),
     }
+    # Learner-utilization config surface (ISSUE 6): the replay ratio /
+    # bucketed batch width / actor dtype this run's rates were shaped by.
+    from dist_dqn_tpu import loop_common as _lc
+    _fl = {"loop": "fused"}
+    _reg.gauge(tmc.LEARNER_REPLAY_RATIO,
+               "grad sub-steps per train event",
+               _fl).set(_lc.resolve_replay_ratio(cfg))
+    _reg.gauge(tmc.LEARNER_TRAIN_BATCH,
+               "effective (bucketed) train batch width",
+               _fl).set(_lc.resolve_train_batch(cfg))
+    _reg.gauge(tmc.LEARNER_ACTOR_DTYPE_INFO,
+               "1 for the active actor inference dtype",
+               {**_fl, "dtype": cfg.network.actor_dtype
+                or "float32"}).set(1)
     telemetry_server = None
     if telemetry_port is not None and (not multiprocess
                                        or jax.process_index() == 0):
@@ -255,6 +272,7 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             _tm["staleness"].observe(dt)
             if grad_steps_chunk:
                 _tm["grad_latency"].observe(dt / grad_steps_chunk)
+            _tm["grad_rate"].set(grad_steps_chunk / dt)
             _hb_chunk.beat()
             _loss = float(metrics["loss"])
             _flight.record("chunk", "fused.chunk", frames=frames,
@@ -321,6 +339,25 @@ def main():
     parser.add_argument("--total-env-steps", type=int, default=0)
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--chunk-iters", type=int, default=2000)
+    parser.add_argument("--replay-ratio", type=int, default=None,
+                        metavar="N",
+                        help="on-device replay ratio "
+                             "(replay.updates_per_chunk): N grad "
+                             "sub-steps per train event, each drawing "
+                             "an independent replay batch, scanned "
+                             "inside one jitted program. Supported by "
+                             "the fused (feed-forward), host-replay "
+                             "and single-learner apex runtimes; 1 is "
+                             "bit-identical to the pre-knob program")
+    parser.add_argument("--actor-dtype", choices=("float32", "bfloat16"),
+                        default=None,
+                        help="actor-inference dtype split "
+                             "(network.actor_dtype): bfloat16 casts "
+                             "the params once per chunk for acting "
+                             "while the learner keeps fp32 masters. "
+                             "fused + host-replay runtimes; float32 "
+                             "(default) is bit-identical to the "
+                             "pre-knob program")
     parser.add_argument("--no-double-buffer", action="store_true",
                         help="--runtime host-replay only: disable the "
                              "double-buffered H2D staging path "
@@ -535,6 +572,31 @@ def main():
         # truthiness test here silently fell back to the config period.
         import dataclasses as _dc
         cfg = _dc.replace(cfg, eval_every_steps=args.eval_every_steps)
+    # Learner-utilization knobs (ISSUE 6): applied per runtime, with
+    # the standard ignored-flag warnings where a runtime does not
+    # support them yet — BEFORE the manifest so provenance records the
+    # config actually run.
+    import dataclasses as _dc
+    _recurrent_fused = args.runtime == "fused" and cfg.network.lstm_size > 0
+    if args.replay_ratio is not None:
+        if _recurrent_fused:
+            print("# --replay-ratio is not supported by the recurrent "
+                  "(R2D2) fused loop yet (its sequence learner has no "
+                  "scan-ratio path); ignored")
+        else:
+            cfg = _dc.replace(cfg, replay=_dc.replace(
+                cfg.replay, updates_per_chunk=args.replay_ratio))
+    if args.actor_dtype is not None:
+        if args.runtime == "apex":
+            print("# --actor-dtype applies to the fused/host-replay "
+                  "runtimes only; the apex service acts on the live "
+                  "learner params — ignored")
+        elif _recurrent_fused:
+            print("# --actor-dtype is not supported by the recurrent "
+                  "(R2D2) fused loop yet; ignored")
+        else:
+            cfg = _dc.replace(cfg, network=_dc.replace(
+                cfg.network, actor_dtype=args.actor_dtype))
     # Run manifest (ISSUE 4 satellite): one provenance line per run —
     # git sha, versions, config hash, argv — reused verbatim by the
     # forensics bundles and served at /debug/config.

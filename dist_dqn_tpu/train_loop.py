@@ -61,6 +61,20 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
     init_learner, train_step = make_learner(net, cfg.learner,
                                             axis_name=axis_name)
     act = make_actor_step(net)
+    # Replay-ratio engine (ISSUE 6): each train event scans
+    # updates_per_train * updates_per_chunk grad sub-steps over
+    # independently-drawn batches. At ratio 1 the scan length and the
+    # key stream are exactly the pre-knob program's — bit-identical,
+    # pinned by tests/test_replay_ratio.py.
+    replay_ratio = loop_common.resolve_replay_ratio(cfg)
+    updates = cfg.updates_per_train * replay_ratio
+    # PER write-backs defer to ONE last-wins flush per event when the
+    # ratio engine is on (sub-steps sample event-entry priorities; the
+    # host loops' prio_writeback_batch lag contract). Ratio 1 keeps the
+    # in-scan sequential updates — the bit-identity contract.
+    defer_writeback = prioritized and replay_ratio > 1
+    _cast_actor, _actor_split = loop_common.make_actor_param_cast(
+        cfg.network.actor_dtype)
     B, batch_size = loop_common.shard_sizes(cfg, num_shards)
     min_fill = max(cfg.replay.min_fill // num_shards, 1)
     num_slots = max(cfg.replay.capacity // (B * num_shards),
@@ -154,10 +168,16 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                           completed_return=zero, completed_count=zero,
                           loss_sum=zero, train_count=zero)
 
-    def one_iteration(carry: TrainCarry, _) -> Tuple[TrainCarry, None]:
+    def one_iteration(actor_params, carry: TrainCarry, _
+                      ) -> Tuple[TrainCarry, None]:
         rng, (k_act, k_sample) = _split_rng(carry.rng, 2)
         eps = epsilon(carry.iteration)
-        actions = act(carry.learner.params, carry.obs, k_act, eps)
+        # Dtype split (ISSUE 6): with actor_dtype="bfloat16" the actor
+        # reads the bf16 snapshot cast once at chunk entry; otherwise
+        # the live fp32 learner params, exactly the pre-split program.
+        acting_params = (actor_params if actor_params is not None
+                         else carry.learner.params)
+        actions = act(acting_params, carry.obs, k_act, eps)
         env_state, out = env.v_step(carry.env_state, actions)
         add = (pring.prioritized_ring_add if prioritized
                else ring.time_ring_add)
@@ -187,6 +207,12 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                         obs=_decode_batch_obs(s.batch.obs),
                         next_obs=_decode_batch_obs(s.batch.next_obs))
                     l, metrics = train_step(l, batch, s.weights)
+                    if defer_writeback:
+                        # Replay-ratio scan: stack this sub-step's draw
+                        # + |TD| plane as scan outputs; ONE last-wins
+                        # flush lands them after the scan.
+                        return (l, rep), (metrics["loss"], s.t_idx,
+                                          s.b_idx, metrics["priorities"])
                     rep = pring.prioritized_ring_update(
                         rep, s.t_idx, s.b_idx, metrics["priorities"],
                         eps=cfg.replay.priority_eps)
@@ -201,13 +227,19 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                         obs=_decode_batch_obs(batch.obs),
                         next_obs=_decode_batch_obs(batch.next_obs))
                     l, metrics = train_step(l, batch)
-                return (l, rep), metrics["loss"]
+                return (l, rep), (metrics["loss"],)
 
-            keys = jax.random.split(k_sample, cfg.updates_per_train)
-            (learner, rep), losses_u = jax.lax.scan(one_update,
-                                                    (learner, rep), keys)
+            keys = jax.random.split(k_sample, updates)
+            (learner, rep), ys = jax.lax.scan(one_update,
+                                              (learner, rep), keys)
+            if defer_writeback:
+                losses_u, t_i, b_i, prios = ys
+                rep = pring.prioritized_ring_update_batched(
+                    rep, t_i, b_i, prios, eps=cfg.replay.priority_eps)
+            else:
+                (losses_u,) = ys
             return (learner, rep, jnp.sum(losses_u),
-                    jnp.float32(cfg.updates_per_train))
+                    jnp.float32(updates))
 
         def no_train(operand):
             learner, rep = operand
@@ -239,7 +271,14 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         zero = jnp.float32(0.0)
         carry = carry._replace(completed_return=zero, completed_count=zero,
                                loss_sum=zero, train_count=zero)
-        carry, _ = jax.lax.scan(one_iteration, carry, None, length=num_iters)
+        # Actor-dtype split: cast the chunk-entry params ONCE; the cast
+        # tree is scan-invariant (closed over), so XLA keeps a single
+        # bf16 copy for the whole chunk instead of re-casting per step.
+        actor_params = (_cast_actor(carry.learner.params)
+                        if _actor_split else None)
+        carry, _ = jax.lax.scan(
+            lambda c, x: one_iteration(actor_params, c, x),
+            carry, None, length=num_iters)
         metrics, replace = loop_common.reduce_chunk_metrics(
             carry, axis_name, B, num_shards)
         if spmd and prioritized:

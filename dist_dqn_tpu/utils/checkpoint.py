@@ -67,6 +67,7 @@ class TrainCheckpointer:
         )
         self._next_save = 0
         self._meta_mgr = None  # lazy; only eval's restore_params needs it
+        self._pytree_mgr = None  # lazy twin for params-only restores
 
     def maybe_save(self, frames: int, learner: PyTree) -> bool:
         """Save when the frame cursor crosses the next save boundary."""
@@ -174,9 +175,8 @@ class TrainCheckpointer:
         for key in reversed(prefix + ("params",)):
             item = {key: item}
             rargs = {key: rargs}
-        restored = self._mgr.restore(
-            step, args=ocp.args.PyTreeRestore(
-                item, restore_args=rargs, partial_restore=True))
+        restored = self._pytree_restore_mgr().restore(
+            step, args=self._partial_restore_args(item, rargs))
         out = restored
         for key in prefix + ("params",):
             out = out[key]
@@ -190,6 +190,38 @@ class TrainCheckpointer:
                 f"unrestored (first: {bad[0]}) — network architecture "
                 "drift between save and eval.")
         return int(step), out
+
+    def _pytree_restore_mgr(self):
+        """Manager for params-only (PyTreeRestore) reads. The main
+        manager registers its handlers from the save/StandardRestore
+        args it has seen; on orbax 0.7.x its composite handler then
+        REJECTS a PyTreeRestoreArgs restore outright ("does not match
+        any registered handler"), so the partial restore needs its own
+        manager with the PyTree handler registered explicitly — cached,
+        like the metadata manager."""
+        if self._pytree_mgr is None:
+            self._pytree_mgr = ocp.CheckpointManager(
+                self.directory,
+                item_handlers=ocp.PyTreeCheckpointHandler())
+        return self._pytree_mgr
+
+    @staticmethod
+    def _partial_restore_args(item, rargs):
+        """Version-adaptive partial-restore args: orbax >= 0.11 spells
+        it ``partial_restore=True``; 0.7.x (this container) only has
+        the legacy transforms API, where an EMPTY ``transforms`` dict
+        with an item tree that is a subset of the saved tree restores
+        exactly that subset (verified against 0.7.0 — the deprecation
+        warning it logs is the API's own, not a misuse)."""
+        import inspect
+
+        params = inspect.signature(
+            ocp.args.PyTreeRestore.__init__).parameters
+        if "partial_restore" in params:
+            return ocp.args.PyTreeRestore(
+                item, restore_args=rargs, partial_restore=True)
+        return ocp.args.PyTreeRestore(
+            item, restore_args=rargs, transforms={})
 
     def _check_params_match(self, step: int, live_abs: PyTree,
                             prefix: Tuple[str, ...]) -> None:
@@ -241,6 +273,9 @@ class TrainCheckpointer:
         if self._meta_mgr is not None:
             self._meta_mgr.close()
             self._meta_mgr = None
+        if self._pytree_mgr is not None:
+            self._pytree_mgr.close()
+            self._pytree_mgr = None
 
 
 _KIND_FILE = "CHECKPOINT_KIND"
