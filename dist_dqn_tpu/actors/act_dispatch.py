@@ -1,0 +1,71 @@
+"""Shared batched-act dispatch packing: ONE pow2 bucket rule for every
+caller that coalesces per-request observation rows into a single jitted
+device call.
+
+Extracted from the Ape-X service's ingest fast path (ISSUE 2,
+``actors/service.py _flush_act_queue``) so the serving tier's dynamic
+micro-batcher (``dist_dqn_tpu/serving/batcher.py``, ISSUE 7) dispatches
+through the EXACT same packing: rows from concurrent requests
+concatenate into one ``[R, ...]`` batch, padded up to the next
+power-of-two row bucket (``replay/host.py pad_pow2`` — also the
+``replay.train_batch`` widening rule, ``loop_common.resolve_train_batch``)
+so XLA compiles O(log max-fan-in) program variants instead of one per
+burst size. Padding rows are ZEROS with epsilon 0 — row-independent
+networks cannot let them perturb real rows, which is what the serving
+equivalence pin asserts (tests/test_serving.py).
+
+``tests/test_pow2_buckets.py`` pins all three call sites (ingest act
+batching, train-batch resolution, serving micro-batcher) to one bucket
+function so they cannot drift apart.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from dist_dqn_tpu.replay.host import pad_pow2
+
+
+def bucket_rows(n: int) -> int:
+    """The dispatch row bucket for ``n`` queued rows: smallest power of
+    two >= n. THE one bucket rule (``replay/host.py pad_pow2``)."""
+    return pad_pow2(n)
+
+
+def pack_act_rows(obs_list: Sequence[np.ndarray],
+                  eps_list: Sequence[float]
+                  ) -> Tuple[np.ndarray, np.ndarray, List[int], int]:
+    """Pack per-request observation batches into one padded dispatch.
+
+    ``obs_list[i]`` is request i's ``[r_i, ...]`` observation rows,
+    ``eps_list[i]`` its per-row exploration epsilon (the Ape-X actor
+    ladder on the ingest path; the tenant/request knob on the serving
+    path). Returns ``(obs_cat, eps, rows, total)`` where ``obs_cat`` is
+    ``[bucket_rows(total), ...]`` (zero rows past ``total``), ``eps``
+    the matching per-row epsilon plane (zero on padding), ``rows`` the
+    per-request row counts and ``total`` their sum. One concatenate into
+    a preallocated buffer — no per-request copies.
+    """
+    rows = [int(o.shape[0]) for o in obs_list]
+    total = sum(rows)
+    padded = bucket_rows(total)
+    first = obs_list[0]
+    obs_cat = np.zeros((padded,) + first.shape[1:], first.dtype)
+    np.concatenate(obs_list, out=obs_cat[:total])
+    eps = np.zeros((padded,), np.float32)
+    off = 0
+    for e, r in zip(eps_list, rows):
+        eps[off:off + r] = e
+        off += r
+    return obs_cat, eps, rows, total
+
+
+def split_rows(values: np.ndarray, rows: Sequence[int]) -> List[np.ndarray]:
+    """Split a dispatched result plane back into per-request slices
+    (padding rows past ``sum(rows)`` are dropped)."""
+    out, off = [], 0
+    for r in rows:
+        out.append(values[off:off + r])
+        off += r
+    return out

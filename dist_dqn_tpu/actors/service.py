@@ -38,7 +38,7 @@ from dist_dqn_tpu.actors.assembler import NStepAssembler
 from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing, shm_dir,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.config import ExperimentConfig
-from dist_dqn_tpu.replay.host import pad_pow2
+from dist_dqn_tpu.actors.act_dispatch import pack_act_rows
 from dist_dqn_tpu.telemetry import collectors as tmc, get_registry
 from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
 from dist_dqn_tpu.utils.metrics import MetricLogger
@@ -173,6 +173,10 @@ class ApexRuntimeConfig:
     # telemetry_port). None disables. Same surface as the fused
     # runtime's --telemetry-port.
     telemetry_port: Optional[int] = None
+    # Bind address for the scrape endpoint: loopback by default (the
+    # metric/debug surface is unauthenticated); "0.0.0.0" exposes it to
+    # scrapers outside the container/VM (--telemetry-host).
+    telemetry_host: str = "127.0.0.1"
 
 
 class ApexLearnerService:
@@ -458,7 +462,8 @@ class ApexLearnerService:
         self.telemetry_server = None
         if rt.telemetry_port is not None:
             from dist_dqn_tpu.telemetry import start_server
-            self.telemetry_server = start_server(rt.telemetry_port)
+            self.telemetry_server = start_server(rt.telemetry_port,
+                                                 host=rt.telemetry_host)
             self.log.log_fn(json.dumps(
                 {"telemetry_port": self.telemetry_server.port}))
         self.global_env_steps = 0
@@ -791,17 +796,12 @@ class ApexLearnerService:
         jax, jnp = self.jax, self.jnp
         burst = self._act_queue
         self._act_queue = []
-        rows = [obs.shape[0] for _, obs, _ in burst]
-        total = sum(rows)
-        padded = pad_pow2(total)
-        first = burst[0][1]
-        obs_cat = np.zeros((padded,) + first.shape[1:], first.dtype)
-        np.concatenate([obs for _, obs, _ in burst], out=obs_cat[:total])
-        eps = np.zeros((padded,), np.float32)
-        off = 0
-        for (actor, _, _), r in zip(burst, rows):
-            eps[off:off + r] = self.actor_eps[actor]
-            off += r
+        # Shared pow2 packing (actors/act_dispatch.py): the same bucket
+        # rule + zero-padding the serving micro-batcher dispatches with.
+        obs_cat, eps, rows, total = pack_act_rows(
+            [obs for _, obs, _ in burst],
+            [self.actor_eps[actor] for actor, _, _ in burst])
+        padded = obs_cat.shape[0]
         self._rng, k = jax.random.split(self._rng)
         # Fused fast path (ISSUE 2): when a bootstrap batch is pending,
         # ride it along with this burst's act in ONE dispatched program

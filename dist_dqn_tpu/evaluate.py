@@ -16,14 +16,7 @@ import os
 import jax
 
 from dist_dqn_tpu.config import CONFIGS, ExperimentConfig, apply_overrides
-
-
-class CheckpointMissingError(FileNotFoundError):
-    """The requested checkpoint (dir or step) is absent. A distinct type
-    so --all-steps walks can skip a step deleted mid-walk by a live
-    training run's retention WITHOUT catching unrelated
-    FileNotFoundErrors (missing ROM/asset) from the evaluation itself
-    (ADVICE round 3)."""
+from dist_dqn_tpu.utils.checkpoint import CheckpointMissingError
 
 
 def _ckpt_prefix(checkpoint_dir: str):
@@ -166,7 +159,11 @@ def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
     try:
         steps = ckpt.all_steps()
         if not steps:
-            raise FileNotFoundError(
+            # The dir exists but holds no complete step yet — the
+            # live-run-before-first-save shape, distinct from a missing
+            # dir so --wait-for-checkpoint can retry it (still a
+            # FileNotFoundError subclass for fail-fast callers).
+            raise CheckpointMissingError(
                 f"no checkpoint found under {checkpoint_dir!r}")
         # Build (env, net, jitted evaluator) only once a step list
         # exists — an empty dir errors without paying the build.
@@ -291,6 +288,13 @@ def main():
                              "(params only, no optimizer state — the "
                              "deploy artifact; JAX-env surface, newest/"
                              "single step)")
+    parser.add_argument("--wait-for-checkpoint", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="retry a missing checkpoint (absent dir or "
+                             "a live run dir that has not saved yet) for "
+                             "up to this many seconds instead of failing "
+                             "immediately — for evals launched alongside "
+                             "training (default 0: fail fast as before)")
     parser.add_argument("--telemetry-port", type=int, default=None,
                         help="serve this process's telemetry registry "
                              "(/metrics, /metrics.json, /healthz, "
@@ -299,6 +303,10 @@ def main():
                              "telemetry_port log line) — eval runs are "
                              "scrapable exactly like train runs "
                              "(docs/observability.md)")
+    parser.add_argument("--telemetry-host", default="127.0.0.1",
+                        help="bind address for --telemetry-port "
+                             "(loopback by default; 0.0.0.0 exposes the "
+                             "scrape surface outside the container/VM)")
     parser.add_argument("--telemetry-snapshot", default=None,
                         help="dump a JSON snapshot of the telemetry "
                              "registry to this path at exit (offline "
@@ -317,7 +325,8 @@ def main():
     if args.telemetry_port is not None:
         from dist_dqn_tpu import telemetry
 
-        _srv = telemetry.start_server(args.telemetry_port)
+        _srv = telemetry.start_server(args.telemetry_port,
+                                      host=args.telemetry_host)
         print(json.dumps({"telemetry_port": _srv.port}))
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -345,34 +354,63 @@ def main():
                 export_params=args.export_params)
         tag_and_print(out)
 
-    if args.all_steps and not args.host_env:
-        # One build + one compile + one manager serve the whole curve.
-        evaluate_checkpoint_curve(
-            cfg, args.checkpoint_dir, episodes=args.episodes,
-            seed=args.seed,
-            log_fn=tag_and_print)
-    elif args.all_steps:
-        # Host envs: per-step restores through the single-point surface
-        # (episode stepping dominates; no scan-evaluator recompile).
-        from dist_dqn_tpu.utils.checkpoint import list_checkpoint_steps
+    def dispatch():
+        # Cheap presence gate BEFORE any env/network build: without it,
+        # every --wait-for-checkpoint retry rebuilds the whole eval
+        # stack (env + net + jit, seconds on CPU) just to find the dir
+        # still empty — and the --all-steps listing paths raise plain
+        # FileNotFoundError on an absent dir, which the retry loop
+        # deliberately does not catch. One probe makes the absent-dir
+        # and empty-live-dir shapes retryable on every mode.
+        from dist_dqn_tpu.utils.checkpoint import checkpoint_present
 
-        steps = list_checkpoint_steps(args.checkpoint_dir)
-        if not steps:
-            raise FileNotFoundError(
+        if not checkpoint_present(args.checkpoint_dir):
+            raise CheckpointMissingError(
                 f"no checkpoint found under {args.checkpoint_dir!r}")
-        for step in steps:
-            # A step deleted mid-walk by a live run's retention raises
-            # the DISTINCT CheckpointMissingError from the restore —
-            # skip it and keep walking. Any other error (missing ROM/
-            # asset, plain FileNotFoundError included) propagates
-            # loudly; no per-step re-listing, no TOCTOU window
-            # (ADVICE round 3).
-            try:
-                run_one(step)
-            except CheckpointMissingError:
-                tag_and_print(_skip_row(step))
-    else:
-        run_one()
+        if args.all_steps and not args.host_env:
+            # One build + one compile + one manager serve the whole curve.
+            evaluate_checkpoint_curve(
+                cfg, args.checkpoint_dir, episodes=args.episodes,
+                seed=args.seed,
+                log_fn=tag_and_print)
+        elif args.all_steps:
+            # Host envs: per-step restores through the single-point
+            # surface (episode stepping dominates; no scan-evaluator
+            # recompile).
+            from dist_dqn_tpu.utils.checkpoint import list_checkpoint_steps
+
+            steps = list_checkpoint_steps(args.checkpoint_dir)
+            if not steps:
+                # Existing-but-empty run dir: CheckpointMissingError so
+                # --wait-for-checkpoint retries (a missing dir raised
+                # FileNotFoundError from the listing already).
+                raise CheckpointMissingError(
+                    f"no checkpoint found under {args.checkpoint_dir!r}")
+            for step in steps:
+                # A step deleted mid-walk by a live run's retention
+                # raises the DISTINCT CheckpointMissingError from the
+                # restore — skip it and keep walking. Any other error
+                # (missing ROM/asset, plain FileNotFoundError included)
+                # propagates loudly; no per-step re-listing, no TOCTOU
+                # window (ADVICE round 3).
+                try:
+                    run_one(step)
+                except CheckpointMissingError:
+                    tag_and_print(_skip_row(step))
+        else:
+            run_one()
+
+    # --wait-for-checkpoint (ISSUE 7 satellite): an eval launched beside
+    # a fresh training run sees the run dir before its first save lands
+    # (the manager mkdirs at construction) — bounded retry instead of an
+    # immediate crash. ONLY the distinct CheckpointMissingError retries
+    # (utils/checkpoint.py wait_for_checkpoint, shared with the serving
+    # CLI); any other failure (missing ROM/asset, corrupt step) stays
+    # loud on the first attempt, and the default 0s budget keeps today's
+    # fail-fast behavior.
+    from dist_dqn_tpu.utils.checkpoint import wait_for_checkpoint
+
+    wait_for_checkpoint(dispatch, args.wait_for_checkpoint)
 
 
 if __name__ == "__main__":

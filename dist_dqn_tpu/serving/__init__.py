@@ -1,0 +1,32 @@
+"""Production policy-inference service (ISSUE 7, ROADMAP item 3).
+
+The acting tier of the north star: a standalone low-latency policy
+server over trained checkpoints —
+
+  * :class:`~dist_dqn_tpu.serving.batcher.MicroBatcher` — dynamic
+    micro-batching of concurrent act requests into pow2-bucketed jitted
+    dispatches (the ingest fast path's bucket rule) with a max-wait
+    deadline bounding p99 at low load;
+  * :class:`~dist_dqn_tpu.serving.model_store.ModelStore` — resident
+    multi-tenant checkpoints with hot-reload off the serving path and
+    atomic snapshot swaps (version header echoed per response);
+  * :class:`~dist_dqn_tpu.serving.router.Router` — per-request policy
+    routing + per-tenant epsilon/greedy knobs;
+  * :class:`~dist_dqn_tpu.serving.server.PolicyServer` — the HTTP
+    surface with SLO-backed /healthz and queue-full shedding
+    (429 + Retry-After);
+  * :class:`~dist_dqn_tpu.serving.client.ServingClient` — the jax-free
+    blocking client the load generator drives.
+
+CLI: ``python -m dist_dqn_tpu.serving --config cartpole
+--checkpoint-dir RUNDIR`` (docs/serving.md). Load generator:
+``benchmarks/serving_bench.py``.
+"""
+from dist_dqn_tpu.serving.batcher import MicroBatcher, SloTracker  # noqa: F401
+from dist_dqn_tpu.serving.client import ServingClient  # noqa: F401
+from dist_dqn_tpu.serving.model_store import ModelStore  # noqa: F401
+from dist_dqn_tpu.serving.router import Router  # noqa: F401
+from dist_dqn_tpu.serving.server import PolicyServer, build_server  # noqa: F401
+from dist_dqn_tpu.serving.types import (ActResult,  # noqa: F401
+                                        PolicySnapshot, QueueFullError,
+                                        ServingError, UnknownPolicyError)

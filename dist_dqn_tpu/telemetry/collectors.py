@@ -86,6 +86,25 @@ LEARNER_ACTOR_DTYPE_INFO = "dqn_learner_actor_dtype_info"
 LEARNER_GRAD_RATE = "dqn_learner_grad_steps_per_sec"
 LEARNER_MFU = "dqn_learner_mfu"
 
+# Serving tier (ISSUE 7): the standalone policy-inference service
+# (dist_dqn_tpu/serving/). REQUESTS/LATENCY are per accepted request
+# (LATENCY spans admission -> response split, the client-visible
+# service time minus transport); BATCH_FANIN observes real (unpadded)
+# ROWS per dispatched act program — the count-histogram exception,
+# like DISPATCH_FANIN above; SHED counts admissions refused by the
+# bounded queue (HTTP 429 + retry-after); RELOADS/POLICY_VERSION track
+# the ModelStore's checkpoint hot-reload per {policy}; SLO_BREACHES
+# counts /healthz flips per {slo="p99_latency"|"queue_depth"}.
+SERVING_REQUESTS = "dqn_serving_requests_total"
+SERVING_SHED = "dqn_serving_shed_total"
+SERVING_QUEUE_DEPTH = "dqn_serving_queue_depth"
+SERVING_LATENCY = "dqn_serving_latency_seconds"
+SERVING_BATCH_FANIN = "dqn_serving_batch_fanin_rows"
+SERVING_DISPATCHES = "dqn_serving_dispatches_total"
+SERVING_RELOADS = "dqn_serving_reloads_total"
+SERVING_POLICY_VERSION = "dqn_serving_policy_version"
+SERVING_SLO_BREACHES = "dqn_serving_slo_breaches_total"
+
 # Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
 # heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
 # (the full stage table is in docs/observability.md), divergence trips

@@ -38,10 +38,13 @@ from dist_dqn_tpu.telemetry.exposition import (CONTENT_TYPE,
 from dist_dqn_tpu.telemetry.registry import Registry, get_registry
 
 
-def _healthz_body():
+def healthz_body():
     """(status, body): 200 ``ok`` when nothing armed reports trouble;
-    503 + JSON naming stale stages and/or latched divergence signals
-    otherwise (telemetry/watchdog.py ``health_state``)."""
+    503 + JSON naming stale stages, latched divergence signals and/or
+    failing health probes otherwise (telemetry/watchdog.py
+    ``health_state``). Shared with the serving tier's HTTP surface
+    (dist_dqn_tpu/serving/server.py) so /healthz means the same thing
+    on every endpoint of a process."""
     ok, detail = watchdog_mod.health_state()
     if ok:
         return 200, b"ok\n"
@@ -66,7 +69,7 @@ class TelemetryServer:
                             + "\n").encode()
                     ctype = "application/json"
                 elif path == "/healthz":
-                    status, body = _healthz_body()
+                    status, body = healthz_body()
                     ctype = ("text/plain" if status == 200
                              else "application/json")
                 elif path == "/debug/stacks":

@@ -512,6 +512,13 @@ class DivergenceSentinel:
 _global_lock = threading.RLock()
 _watchdog: Optional[Watchdog] = None
 _sentinel = DivergenceSentinel()
+#: Extra /healthz contributors (ISSUE 7): name -> probe(). A probe
+#: returns None while healthy, or a JSON-able detail dict to flip
+#: /healthz to 503 with that detail under its name — how the serving
+#: tier's SLO tracker (p99 latency / queue depth) joins the SAME health
+#: surface the stall watchdog and divergence sentinel feed, on every
+#: process's /healthz endpoint at once.
+_health_probes: Dict[str, object] = {}
 
 
 def install_watchdog(forensics_dir: Optional[str] = None,
@@ -588,7 +595,30 @@ def health_state():
         if trips:
             ok = False
             detail["diverged"] = trips
+    with _global_lock:
+        probes = list(_health_probes.items())
+    for name, probe in probes:
+        try:
+            extra = probe()
+        except Exception as e:  # a broken probe is itself unhealthy
+            extra = {"probe_error": f"{type(e).__name__}: {e}"}
+        if extra:
+            ok = False
+            detail[name] = extra
     return ok, detail
+
+
+def register_health_probe(name: str, probe) -> None:
+    """Add a /healthz contributor: ``probe()`` -> None (healthy) or a
+    detail dict (unhealthy; served as 503 JSON under ``name``).
+    Re-registering a name replaces its probe."""
+    with _global_lock:
+        _health_probes[name] = probe
+
+
+def unregister_health_probe(name: str) -> None:
+    with _global_lock:
+        _health_probes.pop(name, None)
 
 
 def maybe_install_from_env() -> Optional[str]:
@@ -617,3 +647,4 @@ def _reset_for_tests() -> None:
             _watchdog.stop()
             _watchdog = None
         _sentinel = DivergenceSentinel()
+        _health_probes.clear()

@@ -45,7 +45,8 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
           chunk_iters: int = 2000, log_fn=print,
           checkpoint_dir: str = None, save_every_frames: int = 0,
           profile_dir: str = None, num_devices: int = 1, stop_fn=None,
-          checkpoint_replay: bool = False, telemetry_port: int = None):
+          checkpoint_replay: bool = False, telemetry_port: int = None,
+          telemetry_host: str = "127.0.0.1"):
     """Run training; returns (final_carry, history list of metric dicts).
 
     With ``checkpoint_replay`` the checkpoint holds the WHOLE fused
@@ -135,7 +136,8 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     telemetry_server = None
     if telemetry_port is not None and (not multiprocess
                                        or jax.process_index() == 0):
-        telemetry_server = telemetry.start_server(telemetry_port)
+        telemetry_server = telemetry.start_server(telemetry_port,
+                                                  host=telemetry_host)
         log_fn(json.dumps({"telemetry_port": telemetry_server.port}))
     seed = cfg.seed if seed is None else seed
     total = total_env_steps or cfg.total_env_steps
@@ -439,6 +441,12 @@ def main():
                              "(reported as a telemetry_port log line). "
                              "Works on both runtimes; see "
                              "docs/observability.md")
+    parser.add_argument("--telemetry-host", default="127.0.0.1",
+                        help="bind address for --telemetry-port: loopback "
+                             "by default (the metric/debug surface is "
+                             "unauthenticated); 0.0.0.0 makes /metrics "
+                             "and /healthz scrapeable from outside the "
+                             "container/VM. All runtimes")
     parser.add_argument("--telemetry-snapshot", default=None,
                         help="dump a JSON snapshot of the telemetry "
                              "registry to this path at exit (offline "
@@ -644,7 +652,8 @@ def main():
             # The host ring and chunk loops record into the process
             # registry regardless; this just exposes the scrape surface.
             from dist_dqn_tpu import telemetry as _telemetry
-            _srv = _telemetry.start_server(args.telemetry_port)
+            _srv = _telemetry.start_server(args.telemetry_port,
+                                           host=args.telemetry_host)
             print(json.dumps({"telemetry_port": _srv.port}))
         out = run_host_replay(
             cfg, total_env_steps=args.total_env_steps or cfg.total_env_steps,
@@ -712,7 +721,8 @@ def main():
             learner_devices=args.learner_devices,
             trace_path=args.trace_path,
             device_sampling=args.device_sampling,
-            telemetry_port=args.telemetry_port)
+            telemetry_port=args.telemetry_port,
+            telemetry_host=args.telemetry_host)
         print(json.dumps(run_apex(cfg, rt)))
         return
     if args.no_double_buffer:
@@ -783,7 +793,8 @@ def main():
           save_every_frames=args.save_every_frames,
           profile_dir=args.profile_dir, num_devices=args.mesh_devices,
           stop_fn=stop_fn, checkpoint_replay=args.checkpoint_replay,
-          telemetry_port=args.telemetry_port)
+          telemetry_port=args.telemetry_port,
+          telemetry_host=args.telemetry_host)
 
 
 if __name__ == "__main__":

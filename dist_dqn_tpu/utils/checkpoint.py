@@ -78,15 +78,87 @@ class TrainCheckpointer:
         return True
 
     def save(self, frames: int, learner: PyTree) -> None:
+        """Save + stamp the atomic ``LATEST`` pointer (ISSUE 7).
+
+        The pointer (step + param checksum + manifest hash) is written
+        only after the save LANDED, so any reader that trusts it — the
+        serving ModelStore watcher, evaluate's restores — addresses a
+        complete checkpoint. The stamp rides a small background thread
+        that blocks on ``wait_until_finished`` so the training loop
+        keeps orbax's async-save overlap (ring-sized --checkpoint-replay
+        carries would otherwise stall the loop for the full write);
+        ``wait()``/the next ``save`` join it. A crash between commit
+        and stamp leaves a stale pointer — ``latest_step`` guards by
+        also consulting the orbax listing.
+
+        Orbax surfaces an async save's failure exactly ONCE, from the
+        first ``wait_until_finished`` — which is now the stamp thread's.
+        The thread therefore captures any failure and the next join
+        point (``save``/``wait``/``close``) re-raises it on the caller's
+        thread, so a failed commit still fails the run instead of dying
+        silently in a daemon thread.
+        """
+        import threading
+
+        self._join_pointer_stamp()
         self._mgr.save(frames, args=ocp.args.StandardSave(learner))
+        # Checksum on the caller's thread: orbax has already snapshotted
+        # the tree, and device-backed arrays stay off the side thread.
+        checksum = _pointer_checksum(learner)
+
+        def _stamp():
+            try:
+                self._mgr.wait_until_finished()
+                write_latest_pointer(self.directory, frames,
+                                     param_checksum=checksum)
+            except BaseException as e:  # re-raised at the next join
+                self._ptr_error = e
+
+        self._ptr_thread = threading.Thread(
+            target=_stamp, name="checkpoint-latest-pointer", daemon=True)
+        self._ptr_thread.start()
+
+    def _join_pointer_stamp(self) -> None:
+        t = getattr(self, "_ptr_thread", None)
+        if t is not None:
+            t.join()
+            self._ptr_thread = None
+        err = getattr(self, "_ptr_error", None)
+        if err is not None:
+            self._ptr_error = None  # surfaced once, like orbax's own
+            raise err
 
     def wait(self) -> None:
         """Block until any async save landed (call before process exit)."""
+        self._join_pointer_stamp()
         self._mgr.wait_until_finished()
 
     def all_steps(self) -> Tuple[int, ...]:
         """Retained checkpoint steps (frame cursors), oldest first."""
         return tuple(sorted(self._mgr.all_steps()))
+
+    def latest_step(self) -> Optional[int]:
+        """Newest COMPLETE checkpoint step: the max of the ``LATEST``
+        pointer (when present and its step dir still exists) and orbax's
+        directory listing. The pointer is what makes an in-progress save
+        invisible (a complete-by-construction step id); the listing
+        guards against a pointer left stale by a crash between a save's
+        commit and its stamp — preferring a stale pointer outright would
+        silently resume/serve older params than the newest complete
+        checkpoint.
+        """
+        import os
+
+        steps = []
+        ptr = read_latest_pointer(self.directory)
+        if ptr is not None:
+            step = int(ptr["step"])
+            if os.path.isdir(os.path.join(self.directory, str(step))):
+                steps.append(step)
+        mgr_step = self._mgr.latest_step()
+        if mgr_step is not None:
+            steps.append(int(mgr_step))
+        return max(steps) if steps else None
 
     def restore_latest(self, example: PyTree, step: Optional[int] = None
                        ) -> Optional[Tuple[int, PyTree]]:
@@ -103,7 +175,7 @@ class TrainCheckpointer:
         # newer retained steps (ADVICE round 3).
         advance_schedule = step is None
         if step is None:
-            step = self._mgr.latest_step()
+            step = self.latest_step()
         if step is None:
             return None
         abstract = jax.tree.map(
@@ -153,7 +225,7 @@ class TrainCheckpointer:
         advances the save schedule.
         """
         if step is None:
-            step = self._mgr.latest_step()
+            step = self.latest_step()
         if step is None:
             return None
         default_dev = jax.local_devices()[0]
@@ -268,14 +340,157 @@ class TrainCheckpointer:
                 f"shape/dtype drift: {shape_drift}")
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
-        if self._meta_mgr is not None:
-            self._meta_mgr.close()
-            self._meta_mgr = None
-        if self._pytree_mgr is not None:
-            self._pytree_mgr.close()
-            self._pytree_mgr = None
+        try:
+            # Re-raises a captured stamp/async-save failure — keep it
+            # loud, but never at the cost of leaking the managers.
+            self._join_pointer_stamp()
+            self._mgr.wait_until_finished()
+        finally:
+            self._mgr.close()
+            if self._meta_mgr is not None:
+                self._meta_mgr.close()
+                self._meta_mgr = None
+            if self._pytree_mgr is not None:
+                self._pytree_mgr.close()
+                self._pytree_mgr = None
+
+
+class CheckpointMissingError(FileNotFoundError):
+    """The requested checkpoint (dir or step) is absent. A distinct type
+    so bounded-retry launchers (evaluate/serving --wait-for-checkpoint)
+    and --all-steps walks can catch EXACTLY this condition without
+    swallowing unrelated FileNotFoundErrors (missing ROM/asset) from
+    the work itself (ADVICE round 3)."""
+
+
+def wait_for_checkpoint(fn, wait_s: float, stop=None):
+    """Run ``fn()``, bounded-retrying :class:`CheckpointMissingError`
+    for up to ``wait_s`` seconds — the launched-alongside-training
+    startup window shared by evaluate.py and the serving CLI. A 0
+    budget keeps fail-fast single-attempt behavior; any other error
+    stays loud on the first attempt. ``stop`` (a ``threading.Event``)
+    aborts the wait early by re-raising the pending
+    CheckpointMissingError — how the serving CLI's SIGTERM handler
+    stays honored during a long startup wait instead of being ignored
+    until the budget runs out."""
+    import time
+
+    deadline = time.monotonic() + max(wait_s, 0.0)
+    while True:
+        try:
+            return fn()
+        except CheckpointMissingError as e:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or (stop is not None and stop.is_set()):
+                raise
+            print(f"# waiting for checkpoint ({e}); "
+                  f"{remaining:.0f}s left", flush=True)
+            nap = min(2.0, remaining)
+            if stop is not None:
+                if stop.wait(nap):
+                    raise
+            else:
+                time.sleep(nap)
+
+
+_LATEST_FILE = "LATEST"
+
+
+def _pointer_checksum(tree: PyTree):
+    """Cheap params digest for the ``LATEST`` pointer: the float64 fold
+    of the policy-params subtree (the SAME rule as the loops'
+    ``param_checksum`` pin anchors), or None when the saved tree has no
+    recognizable params (custom pytrees). Carry-kind trees digest their
+    nested learner's params — never the ring."""
+    obj = tree
+    if isinstance(obj, dict) and "learner" in obj:
+        obj = obj["learner"]
+    obj = getattr(obj, "learner", obj)
+    params = getattr(obj, "params", None)
+    if params is None and isinstance(obj, dict):
+        params = obj.get("params")
+    if params is None:
+        return None
+    try:
+        return float(sum(
+            np.float64(np.sum(np.asarray(jax.device_get(leaf),
+                                         np.float64)))
+            for leaf in jax.tree.leaves(params)))
+    except Exception:
+        # Provenance only — a params tree the host cannot materialize
+        # (e.g. non-fully-addressable global arrays on a pod) must not
+        # break the save; the pointer just carries no digest.
+        return None
+
+
+def write_latest_pointer(directory: str, step: int,
+                         param_checksum=None) -> None:
+    """Atomically (tmp + rename) stamp ``<directory>/LATEST`` with the
+    newest COMPLETE checkpoint step, its param checksum and the run's
+    manifest config hash — so readers (serving ModelStore watcher,
+    evaluate) address the newest checkpoint without globbing step dirs
+    and racing an in-progress save (ISSUE 7 satellite)."""
+    import json
+    import os
+    import time
+
+    from dist_dqn_tpu.telemetry.manifest import get_run_manifest
+
+    man = get_run_manifest()
+    payload = {
+        "step": int(step),
+        "param_checksum": param_checksum,
+        "manifest_hash": man.get("config_hash") if man else None,
+        "saved_unix": time.time(),
+    }
+    path = os.path.join(directory, _LATEST_FILE)
+    # Per-process tmp name: on multihost runs every process stamps the
+    # shared dir after its save; a fixed tmp would let writers truncate
+    # each other mid-write and rename a torn JSON into place. Distinct
+    # tmps keep each os.replace atomic (last writer wins whole-file).
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def checkpoint_present(directory: str) -> bool:
+    """Cheap committed-checkpoint presence probe: the ``LATEST`` pointer
+    or any committed digit-named step dir. No orbax manager (which would
+    mkdir a typo'd path), no restore — the gate --wait-for-checkpoint
+    loops poll so a retry never pays an env/network build just to find
+    the directory still empty. In-progress orbax saves live under
+    ``*.orbax-checkpoint-tmp-*`` names, so a digit-named dir is a
+    committed step."""
+    import os
+
+    if not os.path.isdir(directory):
+        return False
+    if read_latest_pointer(directory) is not None:
+        return True
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return False
+    return any(e.isdigit() and os.path.isdir(os.path.join(directory, e))
+               for e in entries)
+
+
+def read_latest_pointer(directory: str):
+    """The parsed ``LATEST`` pointer dict, or None (absent — pre-pointer
+    directory — or torn/corrupt, in which case readers fall back to the
+    orbax directory listing)."""
+    import json
+    import os
+
+    try:
+        with open(os.path.join(directory, _LATEST_FILE)) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or "step" not in payload:
+        return None
+    return payload
 
 
 _KIND_FILE = "CHECKPOINT_KIND"

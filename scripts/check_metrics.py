@@ -65,6 +65,11 @@ ALLOWLIST = {
     "benchmarks/r2d2_pixel_learning.py": 1,
     "benchmarks/roofline_inscan.py": 1,
     "benchmarks/sampler_bench.py": 2,
+    # ISSUE 7: the per-arm BENCH row line (the contract line goes
+    # through bench.ContractEmitter, counted under bench.py) — CLI
+    # output contracts; the serving metrics themselves go through the
+    # registry (dqn_serving_*).
+    "benchmarks/serving_bench.py": 1,
     "benchmarks/tpu_battery.py": 5,
     "dist_dqn_tpu/actors/remote.py": 1,
     "dist_dqn_tpu/actors/service.py": 3,
@@ -74,6 +79,10 @@ ALLOWLIST = {
     # through the registry the flag exposes).
     "dist_dqn_tpu/evaluate.py": 2,
     "dist_dqn_tpu/host_replay_loop.py": 1,
+    # ISSUE 7: the serving CLI's startup announcements (serving_port +
+    # optional telemetry_port) — output contracts like train.py's; act
+    # metrics go through the registry.
+    "dist_dqn_tpu/serving/__main__.py": 2,
     # +1 at ISSUE 4: the one-per-run {"manifest": ...} provenance line
     # (telemetry/manifest.py) — run identity, not a metric stream.
     "dist_dqn_tpu/train.py": 11,

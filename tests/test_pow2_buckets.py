@@ -1,0 +1,73 @@
+"""ONE bucket rule, three call sites (ISSUE 7 satellite).
+
+The ingest act batching (actors/service.py via actors/act_dispatch.py),
+the ``replay.train_batch`` widening (loop_common.resolve_train_batch)
+and the serving micro-batcher (serving/batcher.py) all pad row counts
+through ``replay/host.py pad_pow2``. This test pins all three to
+identical bucket sizes for the same n — a drift in any one call site
+(a different rounding rule, an off-by-one cap) fails here before it
+ships three subtly different compile ladders.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.actors import act_dispatch
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.loop_common import resolve_train_batch
+from dist_dqn_tpu.replay.host import pad_pow2
+
+
+@pytest.mark.parametrize(
+    "n", [1, 2, 3, 4, 5, 7, 8, 9, 15, 16, 17, 63, 100, 255, 256, 1000])
+def test_one_bucket_rule(n):
+    expect = pad_pow2(n)
+    # Ingest act batching + serving micro-batcher: both pack through
+    # act_dispatch.pack_act_rows -> bucket_rows.
+    assert act_dispatch.bucket_rows(n) == expect
+    obs_cat, eps, rows, total = act_dispatch.pack_act_rows(
+        [np.zeros((n, 3), np.float32)], [0.25])
+    assert obs_cat.shape[0] == expect
+    assert eps.shape[0] == expect
+    assert total == n and rows == [n]
+    # train-batch widening resolves the SAME rule.
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg, replay=dataclasses.replace(cfg.replay, train_batch=n))
+    assert resolve_train_batch(cfg) == expect
+
+
+def test_call_sites_share_the_function():
+    """The three call sites must not grow private copies of the
+    packing: the service and the serving batcher import THE act_dispatch
+    functions, and resolve_train_batch imports THE pad_pow2."""
+    from dist_dqn_tpu.actors import service
+    from dist_dqn_tpu.serving import batcher
+
+    assert service.pack_act_rows is act_dispatch.pack_act_rows
+    assert batcher.pack_act_rows is act_dispatch.pack_act_rows
+    assert batcher.bucket_rows is act_dispatch.bucket_rows
+
+
+def test_pack_pads_with_zero_rows_and_zero_epsilon():
+    """Padding rows are zeros with epsilon 0 — the property the serving
+    equivalence pin relies on (row-independent networks cannot let the
+    pad perturb real rows)."""
+    obs_cat, eps, rows, total = act_dispatch.pack_act_rows(
+        [np.ones((2, 4), np.float32), np.full((1, 4), 3.0, np.float32)],
+        [0.5, 0.125])
+    assert obs_cat.shape == (4, 4) and total == 3
+    np.testing.assert_array_equal(obs_cat[3], np.zeros(4))
+    np.testing.assert_array_equal(eps, [0.5, 0.5, 0.125, 0.0])
+    # Split round-trips the per-request rows.
+    parts = act_dispatch.split_rows(np.arange(4), rows)
+    assert [p.tolist() for p in parts] == [[0, 1], [2]]
+
+
+def test_batcher_max_rows_is_bucketed():
+    """The micro-batcher's row cap itself lands on a bucket boundary,
+    so a full batch compiles zero padding."""
+    assert act_dispatch.bucket_rows(48) == 64

@@ -1,0 +1,485 @@
+"""Serving-tier smoke + pins (ISSUE 7, tier-1).
+
+Covers the acceptance surface end to end over real HTTP on an ephemeral
+port: the serving equivalence pin (greedy actions bit-identical to
+evaluate.py's policy across fan-ins 1, 3 and a full bucket — padding
+rows must not perturb real rows), batched-dispatch fan-in > 1 under
+concurrent clients, atomic hot-reload under load (per-response version
+headers, no mixed-version batch), queue-full shedding with retry-after,
+503-on-SLO-breach on every /healthz surface, the LATEST checkpoint
+pointer, and the serving_bench closed-loop A/B (batched must beat
+--no-batching).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.agents.dqn import make_actor_step, make_learner
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.envs import make_jax_env
+from dist_dqn_tpu.models import build_network
+from dist_dqn_tpu.serving import (QueueFullError, ServingClient,
+                                  ServingError, UnknownPolicyError,
+                                  build_server)
+from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                           read_latest_pointer)
+
+CFG = CONFIGS["cartpole"]
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _obs(rows: int, seed: int = 1) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((rows, 4)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """One net + two param sets + a step-100 checkpoint of params1."""
+    env = make_jax_env(CFG.env_name)
+    net = build_network(CFG.network, env.num_actions)
+    init, _ = make_learner(net, CFG.learner)
+    obs_example = jnp.zeros(env.observation_shape, env.observation_dtype)
+    state1 = init(jax.random.PRNGKey(0), obs_example)
+    state2 = init(jax.random.PRNGKey(7), obs_example)
+    directory = str(tmp_path_factory.mktemp("serving_ckpt"))
+    ckpt = TrainCheckpointer(directory, save_every_frames=1)
+    ckpt.save(100, state1)
+    ckpt.wait()   # save is async; the server below restores at startup
+    act = jax.jit(make_actor_step(net))
+
+    def greedy(params, obs):
+        """The evaluate.py-side reference policy: same act program,
+        epsilon 0 per row."""
+        return np.asarray(
+            act(params, jnp.asarray(obs), jax.random.PRNGKey(123),
+                jnp.zeros((obs.shape[0],), np.float32)), np.int32)
+
+    yield SimpleNamespace(env=env, net=net, init=init,
+                          obs_example=obs_example, state1=state1,
+                          state2=state2, dir=directory, ckpt=ckpt,
+                          greedy=greedy)
+    ckpt.close()
+
+
+@pytest.fixture(scope="module")
+def server(stack):
+    srv = build_server(CFG, {"default": stack.dir}, max_rows=8,
+                       max_wait_ms=25.0, queue_limit=64,
+                       poll_interval_s=3600.0, log_fn=lambda *_: None)
+    yield srv
+    srv.close()
+
+
+def test_equivalence_pin(stack, server):
+    """Greedy serving == evaluate.py's policy on the restored params,
+    bit for bit, across fan-ins 1, 3 and a full bucket."""
+    from dist_dqn_tpu.evaluate import _restore_latest
+
+    frames, params = _restore_latest(stack.dir,
+                                     stack.state1.params)
+    assert frames == 100
+    obs = _obs(8)
+    ref = stack.greedy(params, obs)
+
+    cl = ServingClient(server.address)
+    try:
+        # Fan-in 1, partial bucket (5 rows -> bucket 8, 3 pad rows).
+        r = cl.act(obs[:5], greedy=True)
+        assert r.version == 1 and r.step == 100
+        np.testing.assert_array_equal(r.actions, ref[:5])
+
+        # Full bucket: 8 rows == max_rows, zero padding, immediate
+        # dispatch.
+        r = cl.act(obs, greedy=True)
+        assert r.fanin_rows == 8
+        np.testing.assert_array_equal(r.actions, ref)
+    finally:
+        cl.close()
+
+    # Fan-in 3: three concurrent 1-row requests coalesce into ONE
+    # dispatch (25ms max-wait window); every row must still match the
+    # reference — the padded/coalesced program cannot perturb rows.
+    clients = [ServingClient(server.address) for _ in range(3)]
+    barrier = threading.Barrier(3)
+    results = [None] * 3
+
+    def one(i):
+        barrier.wait()
+        results[i] = clients[i].act(obs[i:i + 1], greedy=True)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for c in clients:
+        c.close()
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r.actions, ref[i:i + 1])
+    # Batched-dispatch fan-in > 1: the three requests rode one program.
+    assert max(r.fanin_requests for r in results) == 3
+    assert all(r.version == 1 for r in results)
+
+
+def test_routing_and_validation(server):
+    cl = ServingClient(server.address)
+    try:
+        with pytest.raises(UnknownPolicyError):
+            cl.act(_obs(1), policy="nope", greedy=True)
+        with pytest.raises(ServingError):  # HTTP 400
+            cl.act(_obs(1), epsilon=2.0)
+        with pytest.raises(ServingError):  # obs spec drift -> 400
+            cl.act(np.zeros((1, 5), np.float32), greedy=True)
+        pols = cl.policies()
+        assert pols["default"]["step"] == 100
+        status, body = cl.healthz()
+        assert status == 200 and body == b"ok\n"
+    finally:
+        cl.close()
+
+
+def test_latest_pointer(stack):
+    """TrainCheckpointer.save stamps the atomic LATEST pointer; readers
+    prefer it and survive a torn one."""
+    ptr = read_latest_pointer(stack.dir)
+    assert ptr is not None and ptr["step"] == 100
+    assert isinstance(ptr["param_checksum"], float)
+    assert stack.ckpt.latest_step() == 100
+    # Torn/corrupt pointer -> fall back to the orbax listing.
+    path = os.path.join(stack.dir, "LATEST")
+    with open(path) as fh:
+        good = fh.read()
+    try:
+        with open(path, "w") as fh:
+            fh.write("{torn")
+        assert read_latest_pointer(stack.dir) is None
+        assert stack.ckpt.latest_step() == 100
+    finally:
+        with open(path, "w") as fh:
+            fh.write(good)
+
+
+def test_save_failure_surfaces_at_join(stack, tmp_path):
+    """An async save failure raises on the CALLER's thread at the next
+    join point (wait/close/next save), exactly once — the stamp thread
+    consumes orbax's raise-once wait_until_finished, so without the
+    capture/re-raise a failed commit would die silently in a daemon
+    thread and the run would exit rc=0 with no checkpoint."""
+    ckpt = TrainCheckpointer(str(tmp_path / "failing"),
+                             save_every_frames=1)
+    try:
+        real_wait = ckpt._mgr.wait_until_finished
+        calls = {"n": 0}
+
+        def boom():
+            # Orbax surfaces an async failure once, from the FIRST
+            # post-commit wait — the stamp thread's (manager.save also
+            # calls wait_until_finished internally, before the commit).
+            if (threading.current_thread().name
+                    == "checkpoint-latest-pointer" and calls["n"] == 0):
+                calls["n"] += 1
+                raise RuntimeError("disk full")
+            return real_wait()
+
+        ckpt._mgr.wait_until_finished = boom
+        ckpt.save(100, stack.state1)
+        with pytest.raises(RuntimeError, match="disk full"):
+            ckpt.wait()
+        # The failed stamp never wrote a pointer...
+        assert read_latest_pointer(str(tmp_path / "failing")) is None
+        # ...and the error surfaced exactly once: the next wait is clean.
+        ckpt.wait()
+    finally:
+        ckpt.close()
+
+
+def test_checkpoint_present_probe(tmp_path):
+    """The cheap presence gate --wait-for-checkpoint loops poll: no
+    manager construction (a typo'd path must not be mkdir'd), committed
+    steps only (orbax tmp dirs are in-progress saves)."""
+    from dist_dqn_tpu.utils.checkpoint import (checkpoint_present,
+                                               write_latest_pointer)
+
+    missing = tmp_path / "nope"
+    assert not checkpoint_present(str(missing))
+    assert not missing.exists()
+    live = tmp_path / "live"
+    live.mkdir()
+    assert not checkpoint_present(str(live))          # empty live dir
+    (live / "100.orbax-checkpoint-tmp-9").mkdir()
+    assert not checkpoint_present(str(live))          # in-progress save
+    (live / "100").mkdir()
+    assert checkpoint_present(str(live))              # committed step
+    stamped = tmp_path / "stamped"
+    stamped.mkdir()
+    write_latest_pointer(str(stamped), 40)
+    assert checkpoint_present(str(stamped))           # pointer alone
+
+
+def test_hot_reload_atomic_under_load(stack, tmp_path):
+    """A reload under concurrent load: every response carries a
+    consistent (version, step) header AND actions that bit-match that
+    version's params — a mixed-version batch would produce rows from
+    the other param set where the two policies disagree."""
+    directory = str(tmp_path / "reload_ckpt")
+    ckpt = TrainCheckpointer(directory, save_every_frames=1)
+    ckpt.save(100, stack.state1)
+    ckpt.wait()   # the build_server below restores v1 at startup
+
+    # Obs rows where the two param sets disagree, so a cross-version
+    # action CANNOT masquerade as the right one.
+    obs = None
+    for seed in range(100):
+        cand = _obs(3, seed=seed)
+        if not np.array_equal(stack.greedy(stack.state1.params, cand),
+                              stack.greedy(stack.state2.params, cand)):
+            obs = cand
+            break
+    assert obs is not None
+    ref = {1: stack.greedy(stack.state1.params, obs),
+           2: stack.greedy(stack.state2.params, obs)}
+
+    srv = build_server(CFG, {"default": directory}, max_rows=8,
+                       max_wait_ms=2.0, queue_limit=64,
+                       poll_interval_s=0.1, log_fn=lambda *_: None)
+    seen, errors = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer():
+        cl = ServingClient(srv.address)
+        try:
+            while not stop.is_set():
+                r = cl.act(obs, greedy=True)
+                with lock:
+                    seen.append((r.version, r.step, r.actions.tolist()))
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+        finally:
+            cl.close()
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.4)               # v1 traffic
+        ckpt.save(200, stack.state2)  # hot-reload source
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            with lock:
+                if any(v == 2 for v, _, _ in seen):
+                    break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        srv.close()
+        ckpt.close()
+    assert not errors, errors
+    versions = {v for v, _, _ in seen}
+    assert versions == {1, 2}, f"expected both versions, saw {versions}"
+    for version, step, actions in seen:
+        assert step == {1: 100, 2: 200}[version]
+        assert actions == ref[version].tolist(), \
+            "response actions do not match its version header's params"
+
+
+def test_queue_full_shedding(stack):
+    """Past queue_limit queued requests, admission sheds with 429 +
+    retry-after instead of queueing unboundedly."""
+    srv = build_server(CFG, {"default": stack.dir}, max_rows=16,
+                       max_wait_ms=400.0, queue_limit=2,
+                       poll_interval_s=3600.0, log_fn=lambda *_: None)
+    oks, sheds, retry_afters = [], [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(10)
+
+    def one():
+        cl = ServingClient(srv.address)
+        barrier.wait()
+        try:
+            r = cl.act(_obs(1), greedy=True)
+            with lock:
+                oks.append(r)
+        except QueueFullError as e:
+            with lock:
+                sheds.append(e)
+                retry_afters.append(e.retry_after_s)
+        finally:
+            cl.close()
+
+    threads = [threading.Thread(target=one) for _ in range(10)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        srv.close()
+    assert len(oks) >= 2, "admitted requests must still be answered"
+    assert sheds, "overload must shed, not queue unboundedly"
+    assert all(ra > 0 for ra in retry_afters)
+
+
+def test_slo_breach_flips_healthz(stack):
+    """An impossible p99 SLO breaches after min_samples requests and
+    flips /healthz to 503 on BOTH surfaces (serving + watchdog
+    health_state); closing the server unregisters the probe."""
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
+
+    srv = build_server(CFG, {"default": stack.dir}, max_rows=4,
+                       max_wait_ms=1.0, queue_limit=64,
+                       slo_p99_ms=0.0001,  # 100ns: unmeetable
+                       poll_interval_s=3600.0, log_fn=lambda *_: None)
+    cl = ServingClient(srv.address)
+    try:
+        for _ in range(25):  # past the tracker's min_samples window
+            cl.act(_obs(1), greedy=True)
+        status, body = cl.healthz()
+        assert status == 503
+        detail = json.loads(body.decode())
+        # Probe names are per-instance ("serving_slo.<n>") so two
+        # servers in one process can't clobber each other's probe.
+        slo_keys = [k for k in detail if k.startswith("serving_slo")]
+        assert slo_keys
+        assert "p99_latency_s" in detail[slo_keys[0]]
+        ok, state = tm_watchdog.health_state()
+        assert not ok and any(k.startswith("serving_slo")
+                              for k in state)
+    finally:
+        cl.close()
+        srv.close()
+    ok, _ = tm_watchdog.health_state()
+    assert ok, "closing the server must unregister the SLO probe"
+
+
+def test_slo_queue_depth_probe_unit():
+    """Queue-depth SLO dimension + transition-counted breaches."""
+    from dist_dqn_tpu.serving import SloTracker
+
+    tracker = SloTracker(queue_depth=3)
+    depth = [0]
+    tracker.attach_queue_depth(lambda: depth[0])
+    assert tracker.probe() is None
+    depth[0] = 5
+    detail = tracker.probe()
+    assert detail == {"queue_depth": 5, "slo_queue_depth": 3}
+    assert tracker.probe() is not None  # still breached; counted once
+    depth[0] = 1
+    assert tracker.probe() is None
+
+
+@pytest.mark.parametrize("runner", ["cli"])
+def test_cli_end_to_end(stack, runner):
+    """python -m dist_dqn_tpu.serving serves a run dir on an ephemeral
+    port and shuts down cleanly on SIGTERM."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dist_dqn_tpu.serving",
+         "--config", "cartpole", "--checkpoint-dir", stack.dir,
+         "--port", "0", "--max-batch-rows", "2", "--max-wait-ms", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+    port = None
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if "serving_port" in row:
+                port = row["serving_port"]
+                assert row["policies"]["default"]["step"] == 100
+                break
+        assert port, "CLI never announced serving_port"
+        cl = ServingClient(f"127.0.0.1:{port}")
+        try:
+            r = cl.act(_obs(2), greedy=True)
+            assert r.actions.shape == (2,) and r.version == 1
+            assert cl.healthz()[0] == 200
+        finally:
+            cl.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    assert rc == 0
+
+
+def test_evaluate_wait_for_checkpoint(stack, tmp_path):
+    """evaluate.py --wait-for-checkpoint: a live run dir (exists, no
+    save yet) retries instead of crashing, and succeeds once the first
+    checkpoint lands (ISSUE 7 satellite)."""
+    directory = str(tmp_path / "live_run")
+    os.makedirs(directory)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dist_dqn_tpu.evaluate",
+         "--config", "cartpole", "--checkpoint-dir", directory,
+         "--episodes", "1", "--wait-for-checkpoint", "120"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+    try:
+        time.sleep(1.0)  # eval is up and retrying before the save
+        ckpt = TrainCheckpointer(directory, save_every_frames=1)
+        ckpt.save(100, stack.state1)
+        ckpt.close()
+        out, _ = proc.communicate(timeout=300)
+    except BaseException:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, out
+    rows = [json.loads(ln) for ln in out.splitlines()
+            if ln.startswith("{")]
+    evals = [r for r in rows if "eval_return" in r]
+    assert evals and evals[0]["frames"] == 100, out
+
+
+def test_serving_bench_ab_smoke(tmp_path):
+    """The closed-loop load generator's A/B: batched mode must beat the
+    --no-batching serialized baseline on acts/sec, and the BENCH rows
+    must carry the contract fields."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "serving_bench.py"),
+         "--ab", "--clients", "16", "--duration-s", "1.2",
+         "--warmup-s", "0.5", "--max-batch-rows", "16",
+         # inproc isolates the dispatch economics batching amortizes;
+         # the http arms measure socket throughput, which on a 2-core
+         # box is the same GIL-bound cost in both modes (see the
+         # run_arm docstring).
+         "--transport", "inproc"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    by_mode = {r["mode"]: r for r in rows if r.get("bench") == "serving"}
+    assert set(by_mode) == {"batched", "serial"}
+    for row in by_mode.values():
+        for field in ("acts_per_sec", "p50_ms", "p99_ms",
+                      "mean_fanin_rows", "requests_shed"):
+            assert field in row
+    assert by_mode["batched"]["acts_per_sec"] \
+        > by_mode["serial"]["acts_per_sec"], by_mode
+    contract = [r for r in rows if r.get("metric") == "serving_acts_per_sec"]
+    assert contract and "speedup_vs_serial" in contract[0]
+    assert contract[0]["telemetry"], "contract row must embed telemetry"
