@@ -18,7 +18,9 @@ import time
 
 import numpy as np
 
-from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.actors.transport import (CORRUPT_FRAME_NACK_KIND,
+                                           ShmMailbox, ShmRing,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.envs.gym_adapter import make_host_env
 from dist_dqn_tpu.telemetry import (get_registry,
@@ -41,6 +43,10 @@ def _actor_telemetry(actor_id: int, tag: str):
     reg = get_registry()
     maybe_install_snapshot_from_env(tag=f"{tag}{actor_id}")
     watchdog.maybe_install_from_env()
+    # Chaos (ISSUE 8): spawned workers arm their slice of the parent's
+    # fault plan from DQN_CHAOS_PLAN, like the watchdog/snapshot env
+    # twins above — a game day reaches into every process of the fleet.
+    chaos.maybe_install_from_env()
     labels = {"actor": str(actor_id)}
     return (reg.gauge("dqn_actor_heartbeat_timestamp",
                       "unix time of the last step-loop pass", labels),
@@ -52,6 +58,21 @@ def _actor_telemetry(actor_id: int, tag: str):
             watchdog.heartbeat(
                 "actor.loop",
                 startup_grace_s=watchdog.STARTUP_GRACE_S))
+
+
+def _chaos_step_seam() -> None:
+    """The per-pass ``actor.step`` seam: wedge (sleep through heartbeat
+    deadlines — the watchdog's prey), crash (kill -9 semantics: no
+    cleanup, no snapshot flush — supervision must restart us), or
+    slow_start (spawn-time stagger). Interpreted here so the local and
+    remote step loops cannot drift."""
+    ev = chaos.fire("actor.step")
+    if ev is None:
+        return
+    if ev.fault == "crash":
+        os._exit(137)           # SIGKILL's exit code: die WITHOUT cleanup
+    chaos.sleep_for(ev)         # wedge / slow_start
+    chaos.mark_recovered("actor.step")
 
 
 def _step_and_encode(env, actions, actor_id: int, t: int,
@@ -99,6 +120,7 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             time.sleep(0.0002)
             continue
         arrays, _ = decode_arrays(data)
+        _chaos_step_seam()
         obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
                                            t)
         steps += num_envs
@@ -129,11 +151,22 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     Termination: remote hosts cannot see the service's local stop file, so
     the worker exits cleanly after ``max_consecutive_failures`` consecutive
     failed reconnect attempts (the learner is gone, not flaky) — a service
-    restart within ~max_consecutive_failures x backoff seconds is survived.
+    restart within the backoff horizon is survived.
+
+    Reconnects back off EXPONENTIALLY with deterministic jitter (ISSUE 8
+    hardening): at fleet scale a learner restart would otherwise see
+    every worker retry in lockstep on a fixed period — a reconnect
+    thundering herd into a service still compiling its first act
+    program. Base doubles per consecutive failure (capped at
+    ``max_reconnect_backoff_s``); the jitter stream is seeded from the
+    worker seed, so a chaos replay sees the same retry schedule.
     """
     from dist_dqn_tpu.actors.transport import TcpRecordClient
 
     env = make_host_env(env_name, num_envs, seed=seed)
+    max_reconnect_backoff_s = 10.0
+    jitter_rng = np.random.default_rng(
+        np.random.SeedSequence(seed, spawn_key=(0x6A17,)))
 
     def connect_and_hello(obs, t):
         client = TcpRecordClient(tuple(address))
@@ -161,16 +194,36 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
                 client = connect_and_hello(obs, t)
                 failures = 0
                 reconnects.inc()
+                # A re-established, re-hello'd connection IS the
+                # recovery proof for send-side faults (disconnect,
+                # truncate, drop) — close any open transport.send trip.
+                chaos.mark_recovered("transport.send")
             except OSError:
                 failures += 1
-                time.sleep(reconnect_backoff_s)
+                backoff = min(reconnect_backoff_s
+                              * (2.0 ** min(failures - 1, 6)),
+                              max_reconnect_backoff_s)
+                # Jitter BELOW the cap (0.5-1.0x): the cap stays a true
+                # bound on every sleep — the survival horizon the
+                # max_consecutive_failures contract is stated against —
+                # while capped lanes still spread over a 2x window.
+                time.sleep(backoff * jitter_rng.uniform(0.5, 1.0))
             continue
         reply = client.read_reply(keep_waiting)
         if reply is None:            # connection lost: reconnect + re-hello
             client.close()
             client = None
             continue
-        arrays, _ = decode_arrays(reply)
+        arrays, meta = decode_arrays(reply)
+        if meta.get("kind") == CORRUPT_FRAME_NACK_KIND:
+            # The service dropped our last frame at its integrity gate:
+            # the action this lane is waiting on will never come.
+            # Reconnect + re-hello NOW (one assembly window lost)
+            # instead of waiting out the full stall bound.
+            client.close()
+            client = None
+            continue
+        _chaos_step_seam()
         obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
                                            t, compress="auto")
         steps += num_envs

@@ -145,6 +145,9 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
     # --forensics-dir) and beats a per-process stage heartbeat on the
     # same cadence as the liveness gauge below.
     tm_watchdog.maybe_install_from_env()
+    # Chaos (ISSUE 8): feeders join a game day like actors do.
+    from dist_dqn_tpu import chaos
+    chaos.maybe_install_from_env()
     # Startup grace: the first beat waits on the service's hello reply,
     # which waits on its first act-program compile.
     hb = tm_watchdog.heartbeat(

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from dist_dqn_tpu import chaos
 from dist_dqn_tpu.actors.assembler import NStepAssembler
 from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing, shm_dir,
                                            decode_arrays, encode_arrays)
@@ -536,6 +537,11 @@ class ApexLearnerService:
         self._tm_actor_restarts = reg.counter(
             "dqn_actor_restarts_total",
             "dead actor processes restarted by supervision")
+        self._tm_degraded = reg.gauge(
+            tmc.INGEST_DEGRADED,
+            "1 while supervision sees at least half the actor fleet "
+            "dead (degraded, not wedged — ISSUE 8)")
+        self._degraded = False
         self._tm_actor_alive: Dict[int, object] = {}
         self._tm_episodes = reg.counter(
             "dqn_episodes_completed_total", "training episodes finished")
@@ -680,14 +686,37 @@ class ApexLearnerService:
         """Failure handling for actor churn (SURVEY.md §5): actors are
         stateless workers, so a dead process is simply restarted — its
         fresh hello resets the assembly lanes and recurrent carry, and the
-        learner never notices beyond a briefly idle lane."""
+        learner never notices beyond a briefly idle lane.
+
+        Fleet-decimation alarm (ISSUE 8): restarts handle ONE dead
+        actor; half the fleet dead at once (bad image rollout, host
+        OOM-killer sweep, preemption wave) is a different animal — the
+        run degrades (ingest rate collapses, the learner idles at its
+        cadence target) rather than wedging, and this alarm is what
+        says so: ``dqn_ingest_degraded`` = 1 plus one log line per
+        degradation episode, cleared when the fleet recovers."""
+        dead = 0
         for actor_id, p in list(self.procs.items()):
             alive = p.is_alive()
             self._actor_alive_gauge(actor_id).set(float(alive))
             if not alive:
+                dead += 1
                 self.actor_restarts += 1
                 self._tm_actor_restarts.inc()
                 self.procs[actor_id] = self._spawn_one(actor_id)
+        fleet = max(len(self.procs), 1)
+        decimated = fleet > 1 and dead * 2 >= fleet
+        self._tm_degraded.set(float(decimated))
+        if decimated and not self._degraded:
+            self._degraded = True
+            self.log.log_fn(json.dumps(
+                {"ingest_degraded": True, "dead_actors": dead,
+                 "fleet": fleet, "env_steps": self.env_steps}))
+            self.tracer.instant("ingest_degraded", dead=dead, fleet=fleet)
+        elif not decimated and self._degraded:
+            self._degraded = False
+            self.log.log_fn(json.dumps(
+                {"ingest_degraded": False, "env_steps": self.env_steps}))
 
     def shutdown(self):
         with open(self.stop_path, "w") as f:
@@ -1594,8 +1623,35 @@ class ApexLearnerService:
             "apex.ingest", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
         hb_learner = tm_watchdog.heartbeat(
             "apex.learner", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+
+        # Emergency checkpoint on watchdog abort (ISSUE 8): save the
+        # live learner state before the SIGTERM — the state reference
+        # swap is atomic and device arrays immutable, so the side
+        # thread reads a consistent post-step snapshot. To a SIDE
+        # location via its own one-shot checkpointer: the canonical
+        # wedge is the main thread stuck INSIDE the shared manager's
+        # save (slow storage), and a concurrent save on that manager
+        # would tear the in-flight commit instead of preserving state.
+        def _emergency_save():
+            if self.rt.checkpoint_dir and self.state is not None:
+                from dist_dqn_tpu.utils.checkpoint import save_pytree
+                save_pytree(os.path.join(self.rt.checkpoint_dir,
+                                         "emergency_learner"),
+                            {"learner": self.state})
+
+        tm_watchdog.register_emergency_hook("apex.checkpoint",
+                                            _emergency_save)
         try:
             while self._progress() < self.rt.total_env_steps:
+                # Chaos seam (ISSUE 8): the learner-process kill for
+                # game days — die with SIGKILL semantics (no cleanup,
+                # no stop file) at a plan-determined loop pass, so the
+                # learner-restart invariant (actors re-attach via
+                # re-hello, trajectory resumes from the checkpoint) is
+                # exercised at a reproducible dataflow position.
+                cev = chaos.fire("service.loop")
+                if cev is not None and cev.fault == "crash":
+                    os._exit(137)
                 drained = self._drain_transports()
                 self._flush_act_queue()
                 self._flush_pending()
@@ -1671,6 +1727,7 @@ class ApexLearnerService:
                 self._ckpt.close()
                 self._save_replay_snapshot()
         finally:
+            tm_watchdog.unregister_emergency_hook("apex.checkpoint")
             hb_ingest.close()
             hb_learner.close()
             self.tracer.close()

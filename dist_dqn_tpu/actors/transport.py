@@ -20,12 +20,16 @@ import struct
 import subprocess
 import threading
 import time
+import zlib
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dist_dqn_tpu import chaos
 from dist_dqn_tpu.telemetry import get_registry
+from dist_dqn_tpu.telemetry.collectors import (TRANSPORT_CORRUPT,
+                                               TRANSPORT_SHED)
 
 _NATIVE_DIR = Path(__file__).parent / "_native"
 _LIB_PATH = _NATIVE_DIR / "libdqntransport.so"
@@ -286,6 +290,53 @@ def decode_arrays(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict]:
 # TCP record transport (cross-host DCN path)
 # ---------------------------------------------------------------------------
 
+# Wire frame integrity (ISSUE 8 tentpole hardening): every TCP frame is
+#
+#     magic(4) | length(4, LE) | crc32(4, LE, over payload) | payload
+#
+# Before this header existed a single flipped bit on the wire (or a
+# framing slip after a partial write) flowed straight into the array
+# codec as training data — json.loads of a corrupt header at best,
+# silently garbage pixels at worst. Now:
+#   * bad magic / out-of-bound length  -> the stream is desynced; the
+#     connection is dropped and the peer reconnects (counted under
+#     {reason="bad_magic"|"length"});
+#   * CRC mismatch -> the frame BOUNDARY is still trustworthy (length
+#     was verified), so only the frame is dropped ({reason="crc"}) and
+#     the server NACKs down the reply channel so the lock-step actor
+#     reconnects immediately instead of waiting out its stall bound.
+# CRC32 runs ~1-3 GB/s/core — noise next to any DCN link this path can
+# see — so frame integrity is ALWAYS on (unlike the optional payload
+# CRC above, which guards intra-host shm reads under tests only).
+FRAME_MAGIC = b"DQF1"
+_FRAME_HDR = struct.Struct("<4sII")
+#: Far above any sane record (a 256-lane pixel step is ~15 MB), far
+#: below a memory-exhaustion length from a corrupt/hostile header.
+MAX_FRAME_BYTES = 256 << 20
+
+#: Reply-channel control record: the server could not use the actor's
+#: last frame (CRC drop) — reconnect and re-hello rather than waiting
+#: out the stall bound for an action that will never come.
+CORRUPT_FRAME_NACK_KIND = "corrupt_frame"
+
+
+def frame_encode(payload: bytes) -> bytes:
+    """One integrity-framed wire record."""
+    return _FRAME_HDR.pack(FRAME_MAGIC, len(payload),
+                           zlib.crc32(payload)) + payload
+
+
+def _frame_check(payload: bytes, want_crc: int) -> bool:
+    return zlib.crc32(payload) == want_crc
+
+
+def _corrupt_frame_counter(reason: str, side: str):
+    return get_registry().counter(
+        TRANSPORT_CORRUPT,
+        "TCP frames failing the magic/length/CRC32 integrity check",
+        labels={"reason": reason, "side": side})
+
+
 class TcpRecordServer:
     """Full-duplex record endpoint for actors on OTHER hosts (the DCN path).
 
@@ -297,7 +348,9 @@ class TcpRecordServer:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_backlog: int = 4096):
+                 max_backlog: int = 4096,
+                 max_backpressure_wait_s: float = 30.0):
+        # socket: accept loop below sets a 0.2s timeout before use.
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -305,11 +358,27 @@ class TcpRecordServer:
         self.address = self._sock.getsockname()
         self._records: List[Tuple[int, bytes]] = []
         self._conns: Dict[int, socket.socket] = {}
+        # Per-connection WRITE locks: replies come from the service
+        # thread while corrupt-frame NACKs (ISSUE 8) come from that
+        # connection's serve thread — two concurrent sendall()s on one
+        # socket could interleave mid-frame and desync the reply
+        # stream the integrity header would then reject.
+        self._send_locks: Dict[int, threading.Lock] = {}
         self._next_conn = 0
         self._lock = threading.Lock()
         self._max_backlog = max_backlog
-        self.dropped = 0              # always 0: full backlog backpressures
+        # Degrade-don't-wedge bound (ISSUE 8): backpressure is still the
+        # first response to a full backlog (TCP flow control throttles
+        # the sender), but a drain that has stopped ENTIRELY — learner
+        # wedged, loop dead — must not pin every serve thread in the
+        # wait loop forever. Past this wait the record is shed, counted
+        # (dqn_transport_tcp_shed_total) and alarmed once per episode.
+        self._max_backpressure_wait_s = float(max_backpressure_wait_s)
+        self.dropped = 0              # shm-ring-style producer overruns: n/a
         self.backpressure_events = 0  # records that had to wait for space
+        self.shed_records = 0         # records dropped after the wait bound
+        self.corrupt_frames = 0       # frames failing the integrity check
+        self._shed_alarmed = False
         # Telemetry (ISSUE 1): the DCN ingress queue. Backlog depth is
         # THE learner-behind signal on this path (full backlog = TCP
         # flow control throttling every remote actor).
@@ -321,6 +390,10 @@ class TcpRecordServer:
         self._c_backpressure = reg.counter(
             "dqn_transport_tcp_backpressure_total",
             "records that had to wait for backlog space")
+        self._c_shed = reg.counter(
+            TRANSPORT_SHED,
+            "records shed after the bounded backpressure wait (drain "
+            "stopped entirely — degrade instead of wedging)")
         self._g_conns = reg.gauge("dqn_transport_tcp_connections",
                                   "live remote-actor connections")
         self._stop = threading.Event()
@@ -341,6 +414,7 @@ class TcpRecordServer:
                 conn_id = self._next_conn
                 self._next_conn += 1
                 self._conns[conn_id] = conn
+                self._send_locks[conn_id] = threading.Lock()
                 self._g_conns.set(len(self._conns))
             threading.Thread(target=self._serve, args=(conn_id, conn),
                              name=f"tcp-serve-{conn_id}",
@@ -350,35 +424,101 @@ class TcpRecordServer:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while not self._stop.is_set():
-                hdr = self._recv_exact(conn, 4)
+                hdr = self._recv_exact(conn, _FRAME_HDR.size)
                 if hdr is None:
                     return
-                (n,) = struct.unpack("<I", hdr)
+                magic, n, crc = _FRAME_HDR.unpack(hdr)
+                if magic != FRAME_MAGIC:
+                    # The byte stream is desynced (corrupt length on an
+                    # earlier frame, a peer speaking the old unframed
+                    # protocol, or garbage): there is no trustworthy
+                    # boundary to resume at — drop the connection; the
+                    # actor's reconnect + re-hello path recovers it.
+                    self._count_corrupt("bad_magic")
+                    return
+                if n > MAX_FRAME_BYTES:
+                    self._count_corrupt("length")
+                    return
                 payload = self._recv_exact(conn, n)
                 if payload is None:
+                    self._count_corrupt("truncated")
                     return
+                ev = chaos.fire("transport.recv")
+                if ev is not None:
+                    if ev.fault == "bit_flip":
+                        # Corrupt BEFORE verification: the CRC gate
+                        # below must catch it — the e2e corrupt-frame
+                        # invariant (a flipped bit never reaches the
+                        # array codec).
+                        payload = chaos.corrupt_bytes(payload, ev)
+                    elif ev.fault == "drop":
+                        continue
+                    elif ev.fault == "delay":
+                        chaos.sleep_for(ev)
+                    elif ev.fault == "disconnect":
+                        return
+                if not _frame_check(payload, crc):
+                    # Frame boundary verified (length matched), payload
+                    # did not: drop JUST this frame, keep the stream,
+                    # and NACK so the lock-step sender re-hellos now
+                    # instead of waiting out its stall bound for an
+                    # action that will never come.
+                    self._count_corrupt("crc")
+                    self.send(conn_id, encode_arrays(
+                        {}, {"kind": CORRUPT_FRAME_NACK_KIND}))
+                    continue
+                chaos.mark_recovered("transport.recv")
                 # Backpressure, not drops: pausing this connection's reads
                 # fills the kernel socket buffers and TCP flow control
                 # throttles the sender — a dropped record would stall its
-                # lock-step actor for a full reply timeout instead.
+                # lock-step actor for a full reply timeout instead. Only
+                # once the wait bound says the drain is DEAD (not slow)
+                # does the record shed.
                 waited = False
+                wait_start = None
                 while not self._stop.is_set():
                     with self._lock:
                         if len(self._records) < self._max_backlog:
                             self._records.append((conn_id, payload))
                             self._g_backlog.set(len(self._records))
                             self._c_records.inc()
+                            # The drain is alive again: close the shed
+                            # episode so the NEXT one alarms too.
+                            self._shed_alarmed = False
                             break
                         if not waited:
                             waited = True
+                            wait_start = time.monotonic()
                             self.backpressure_events += 1
                             self._c_backpressure.inc()
+                    if (wait_start is not None and time.monotonic()
+                            - wait_start > self._max_backpressure_wait_s):
+                        self._shed(conn_id)
+                        break
                     time.sleep(0.001)
         finally:
             with self._lock:
                 self._conns.pop(conn_id, None)
+                self._send_locks.pop(conn_id, None)
                 self._g_conns.set(len(self._conns))
             conn.close()
+
+    def _count_corrupt(self, reason: str) -> None:
+        self.corrupt_frames += 1
+        _corrupt_frame_counter(reason, side="server").inc()
+
+    def _shed(self, conn_id: int) -> None:
+        self.shed_records += 1
+        self._c_shed.inc()
+        if not self._shed_alarmed:
+            # One alarm per shed episode, not one per record: the
+            # signal is "the drain is dead", already screamed by the
+            # backlog gauge; per-record lines would swamp the log.
+            self._shed_alarmed = True
+            print(json.dumps({
+                "transport_shedding": True, "conn_id": conn_id,
+                "backlog": self._max_backlog,
+                "waited_s": self._max_backpressure_wait_s}), flush=True)
 
     @staticmethod
     def _recv_exact(conn, n) -> Optional[bytes]:
@@ -403,13 +543,17 @@ class TcpRecordServer:
             return rec
 
     def send(self, conn_id: int, payload: bytes) -> bool:
-        """Reply down a connection (False if it is gone — actor churn)."""
+        """Reply down a connection (False if it is gone — actor churn).
+        Thread-safe per connection: the write lock serializes service
+        replies against serve-thread NACKs so frames never interleave."""
         with self._lock:
             conn = self._conns.get(conn_id)
-        if conn is None:
+            send_lock = self._send_locks.get(conn_id)
+        if conn is None or send_lock is None:
             return False
         try:
-            conn.sendall(struct.pack("<I", len(payload)) + payload)
+            with send_lock:
+                conn.sendall(frame_encode(payload))
             return True
         except OSError:
             return False
@@ -454,6 +598,7 @@ class TcpRecordClient:
 
     def __init__(self, address: Tuple[str, int], timeout_s: float = 5.0,
                  max_stall_s: float = 300.0):
+        # socket: create_connection sets the connect+recv timeout.
         self._sock = socket.create_connection(address, timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Dead-peer floor below the app-level stall bound: a silent
@@ -463,13 +608,38 @@ class TcpRecordClient:
         self._max_stall_s = max_stall_s
 
     def push(self, payload: bytes) -> bool:
+        frame = frame_encode(payload)
+        ev = chaos.fire("transport.send")
+        if ev is not None:
+            if ev.fault == "drop":
+                # Simulated wire loss: report success, send nothing —
+                # the reply never comes and the stall/reconnect path
+                # must recover the lane.
+                return True
+            if ev.fault == "delay":
+                chaos.sleep_for(ev)
+            elif ev.fault == "bit_flip":
+                # Corrupt AFTER the CRC was computed: genuine wire
+                # corruption — the server's integrity gate must drop
+                # and NACK it.
+                frame = chaos.corrupt_bytes(frame, ev)
+            elif ev.fault == "truncate":
+                frame = chaos.truncate_bytes(frame, ev)
+                try:
+                    self._sock.sendall(frame)
+                finally:
+                    self.close()   # a half frame can never resync
+                return False
+            elif ev.fault == "disconnect":
+                self.close()
+                return False
         # sendall's partial progress cannot be resumed after a timeout, so
         # sends get the full stall bound: server-side backpressure pauses
         # reads during learner stalls, and a large (pixel) record can
         # legitimately sit mid-send well past the short recv timeout.
         try:
             self._sock.settimeout(self._max_stall_s)
-            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+            self._sock.sendall(frame)
             return True
         except OSError:
             return False
@@ -502,13 +672,26 @@ class TcpRecordClient:
         return b"".join(chunks)
 
     def read_reply(self, keep_waiting=lambda: True) -> Optional[bytes]:
-        """Block for the next reply record; None = connection dead, stalled
-        past ``max_stall_s``, or ``keep_waiting`` said stop."""
-        hdr = self._recv_exact(4, keep_waiting)
+        """Block for the next reply record; None = connection dead,
+        stalled past ``max_stall_s``, ``keep_waiting`` said stop, or
+        the reply failed the frame integrity check (a corrupt reply is
+        indistinguishable from a desynced stream — reconnect)."""
+        hdr = self._recv_exact(_FRAME_HDR.size, keep_waiting)
         if hdr is None:
             return None
-        (n,) = struct.unpack("<I", hdr)
-        return self._recv_exact(n, keep_waiting)
+        magic, n, crc = _FRAME_HDR.unpack(hdr)
+        if magic != FRAME_MAGIC or n > MAX_FRAME_BYTES:
+            _corrupt_frame_counter(
+                "bad_magic" if magic != FRAME_MAGIC else "length",
+                side="client").inc()
+            return None
+        payload = self._recv_exact(n, keep_waiting)
+        if payload is None:
+            return None
+        if not _frame_check(payload, crc):
+            _corrupt_frame_counter("crc", side="client").inc()
+            return None
+        return payload
 
     def close(self):
         try:

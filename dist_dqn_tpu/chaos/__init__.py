@@ -1,0 +1,28 @@
+"""Deterministic chaos harness (ISSUE 8): seeded fault injection with
+named seams threaded through the real code paths.
+
+Public surface::
+
+    from dist_dqn_tpu import chaos
+
+    plan = chaos.FaultPlan.generate(seed=7, seams=["transport.recv"])
+    with chaos.installed(plan) as inj:
+        ...                       # run the system under test
+    assert not inj.open_trips()   # every injection recovered
+
+Seam call sites use ``chaos.fire("seam.name")`` (a no-op global read
+while nothing is armed) and prove recovery with
+``chaos.mark_recovered``. The process-level game-day runner is
+``scripts/chaos_run.py``; the failure-mode matrix lives in
+docs/fault_tolerance.md.
+"""
+from dist_dqn_tpu.chaos.injector import (CHAOS_PLAN_ENV,  # noqa: F401
+                                         ChaosInjectedError,
+                                         ChaosInjector, corrupt_bytes,
+                                         fire, get_injector, install,
+                                         installed,
+                                         maybe_install_from_env,
+                                         mark_recovered, sleep_for,
+                                         truncate_bytes, uninstall)
+from dist_dqn_tpu.chaos.plan import (SEAMS, FaultEvent,  # noqa: F401
+                                     FaultPlan)

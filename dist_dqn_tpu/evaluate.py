@@ -21,11 +21,14 @@ from dist_dqn_tpu.utils.checkpoint import CheckpointMissingError
 
 def _ckpt_prefix(checkpoint_dir: str):
     """Where the params live inside this directory's checkpoints:
-    learner-kind saves the learner at the root, --checkpoint-replay
-    (carry-kind) nests it one level down."""
+    learner-kind saves the learner at the root; --checkpoint-replay
+    (carry-kind) and the host-replay whole-state checkpoints
+    (host_loop-kind, ISSUE 8) nest it one level down."""
     from dist_dqn_tpu.utils.checkpoint import read_checkpoint_kind
 
-    return (("learner",) if read_checkpoint_kind(checkpoint_dir) == "carry"
+    return (("learner",)
+            if read_checkpoint_kind(checkpoint_dir) in ("carry",
+                                                        "host_loop")
             else ())
 
 

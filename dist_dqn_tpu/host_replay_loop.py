@@ -69,7 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dist_dqn_tpu import loop_common
+from dist_dqn_tpu import chaos, loop_common
 from dist_dqn_tpu.agents.dqn import make_actor_step, make_learner
 from dist_dqn_tpu.config import ExperimentConfig
 from dist_dqn_tpu.envs.base import JaxEnv
@@ -160,6 +160,18 @@ def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
     return init, collect
 
 
+class _ResumedEvacHandle:
+    """Completion-handle stand-in installed on resume: the chunk it
+    fences was already appended to the ring INSIDE the checkpoint, so
+    the fence is a no-op and the evacuation accounting reads zero."""
+
+    stats = {"evac_s": 0.0, "bytes": 0, "slices": 0}
+    done = True
+
+    def wait(self, timeout=None) -> bool:
+        return True
+
+
 def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     chunk_iters: int = 200, log_fn=print,
                     env: Optional[JaxEnv] = None,
@@ -169,7 +181,9 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     prefetch: bool = True,
                     prefetch_depth: int = 2,
                     prioritized: Optional[bool] = None,
-                    prio_writeback_batch: int = 8):
+                    prio_writeback_batch: int = 8,
+                    checkpoint_dir: Optional[str] = None,
+                    save_every_frames: int = 0):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
@@ -210,6 +224,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     ``prio_writeback_batch`` batches that many train steps' |TD|
     write-backs into one vectorized sum-tree update (PER only; 1 =
     per-step flush), mirroring the apex service's knob.
+
+    ``checkpoint_dir`` (ISSUE 8) enables WHOLE-STATE checkpoint/resume
+    every ``save_every_frames`` env frames (0 = default cadence:
+    ``max(cfg.eval_every_steps, one chunk)`` — each save copies the
+    whole ring window, so the default never pays that per chunk):
+    learner state + collect
+    carry (orbax) plus the host ring window, pending chunk, episode
+    stats and every loop cursor (sidecar npz). Saves land at a
+    QUIESCED end-of-chunk boundary (the in-flight evacuation is fenced
+    first — idempotent, the next chunk's body re-fences for free), so
+    a run killed at chunk k and resumed continues BIT-IDENTICALLY to
+    an uninterrupted one in uniform-replay mode — the resume pin
+    tests/test_chaos.py holds against a mid-run kill. PER mode raises:
+    its sum-tree is rebuilt from appends, not checkpointed, so resume
+    could not be honest about priorities yet.
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
@@ -232,6 +261,13 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                          f"{prio_writeback_batch}")
     per_enabled = (cfg.replay.prioritized if prioritized is None
                    else prioritized)
+    if checkpoint_dir and per_enabled:
+        raise ValueError(
+            "--checkpoint-dir with prioritized host-replay sampling is "
+            "not supported yet: the sum-tree rebuilds from appends, not "
+            "from the checkpoint, so a resumed run's priorities would "
+            "silently differ. Checkpoint uniform runs (--no-per), or "
+            "use the apex runtime's --checkpoint-replay")
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -456,6 +492,83 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     env_steps = 0
     grad_steps = 0
     sample_k = 0          # global batch index — the RNG-stream cursor
+
+    # -- whole-state checkpoint/resume (ISSUE 8) ---------------------------
+    ckpt = None
+    next_save = float("inf")
+    start_chunk = 0
+    resumed = False
+    resume_stats = resume_pending = None
+    if checkpoint_dir:
+        import os
+
+        from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                                   record_checkpoint_kind)
+        # Default cadence mirrors the fused loop's eval-period rhythm,
+        # never finer than one chunk: each save copies the WHOLE ring
+        # window (DRAM-sized at real configs) into the sidecar, so a
+        # per-chunk default would put a multi-GB memcpy + npz write on
+        # every chunk boundary.
+        save_period = save_every_frames or max(cfg.eval_every_steps,
+                                               chunk_iters * B)
+        ckpt = TrainCheckpointer(checkpoint_dir,
+                                 save_every_frames=save_period)
+        record_checkpoint_kind(checkpoint_dir, "host_loop")
+        next_save = save_period
+
+        def _sidecar_path(step: int) -> str:
+            return os.path.join(checkpoint_dir, f"host_loop_{step}.npz")
+
+        example_tree = {"learner": state, "carry": carry}
+        restored = ckpt.restore_latest(example_tree)
+        if restored is not None:
+            step, tree = restored
+            with np.load(_sidecar_path(step)) as f:
+                side = {k: f[k] for k in f.files}
+            if int(side["chunk_iters"]) != chunk_iters:
+                # next_chunk/env_steps cursors are in chunk units; a
+                # different --chunk-iters would silently misinterpret
+                # them and break the bit-identical resume contract.
+                raise ValueError(
+                    f"checkpoint at {checkpoint_dir!r} was written with "
+                    f"--chunk-iters {int(side['chunk_iters'])}, this "
+                    f"run uses {chunk_iters} — resume with the same "
+                    "loop shape (the ring/env config is already "
+                    "validated by the snapshot shapes)")
+            state, carry = tree["learner"], tree["carry"]
+            ring.load_state_dict(
+                {k[len("ring_"):]: v for k, v in side.items()
+                 if k.startswith("ring_")})
+            env_steps = int(side["env_steps"])
+            grad_steps = int(side["grad_steps"])
+            sample_k = int(side["sample_k"])
+            if prefetcher is not None:
+                # Per-index batch RNG: the prefetcher must continue the
+                # killed run's index sequence, not restart at 0.
+                prefetcher.seek(sample_k)
+            train_debt_iters = int(side["train_debt_iters"])
+            start_chunk = int(side["next_chunk"])
+            next_save = env_steps + save_period
+            resumed = True
+            if bool(side["has_stats"]):
+                # Episode-stat scalars of the already-dispatched next
+                # chunk: host floats; jax.device_get at the loop's
+                # fetch point is a no-op on them.
+                resume_stats = (np.float32(side["stats_cr"]),
+                                np.float32(side["stats_cc"]))
+            if bool(side["has_pending"]):
+                # Serial path: the next chunk's collected records were
+                # materialized into the checkpoint; the body's
+                # monolithic fetch reads host arrays identically.
+                resume_pending = {
+                    k[len("pending_"):]: v for k, v in side.items()
+                    if k.startswith("pending_")}
+            log_fn(json.dumps({"resumed_at_frames": env_steps,
+                               "resumed_at_chunk": start_chunk}))
+            # Resuming from the checkpoint IS the recovery proof for an
+            # injected mid-run crash (in-process chaos replay).
+            chaos.mark_recovered("host_replay.chunk")
+
     d2h_bytes_total = 0
     fence_wait_total = 0.0
     sample_s_total = 0.0
@@ -464,16 +577,111 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     history = []
     metrics = None
     t_start = time.perf_counter()
+    records = stats = handle = None
+    # The restored step already exists on disk: the save guard below
+    # must treat it as saved, or resuming a COMPLETED run would re-save
+    # its final step (orbax raises StepAlreadyExists) instead of
+    # passing straight to the summary.
+    last_saved = env_steps if resumed else -1
+
+    def _save_checkpoint(g: int) -> None:
+        """Quiesced whole-state save at the end of chunk ``g``'s body.
+        The in-flight evacuation is fenced first (idempotent — the next
+        body re-waits for free) so the ring snapshot is the complete
+        window; the serial path's un-appended next-chunk records and
+        the dispatched episode-stat scalars are materialized INTO the
+        checkpoint instead of being perturbed — reads only, so the
+        continuing run stays bit-identical to an unsaved one."""
+        nonlocal last_saved
+        if env_steps <= last_saved:
+            return
+        if pipeline and handle is not None:
+            handle.wait()
+        side = {f"ring_{k}": v for k, v in ring.state_dict().items()}
+        side.update(
+            env_steps=np.int64(env_steps),
+            grad_steps=np.int64(grad_steps),
+            sample_k=np.int64(sample_k),
+            train_debt_iters=np.int64(train_debt_iters),
+            next_chunk=np.int64(g + 1),
+            chunk_iters=np.int64(chunk_iters),
+            has_stats=np.bool_(stats is not None),
+            has_pending=np.bool_(records is not None))
+        if stats is not None:
+            s_cr, s_cc = jax.device_get(stats)
+            side.update(stats_cr=np.float32(s_cr),
+                        stats_cc=np.float32(s_cc))
+        if records is not None:
+            side.update({f"pending_{k}": np.asarray(jax.device_get(v))
+                         for k, v in records.items()})
+        # Sidecar BEFORE the orbax commit (atomic tmp+rename): any
+        # committed step implies its sidecar exists, so a crash between
+        # the two leaves the previous step as the resume point.
+        path = _sidecar_path(env_steps)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **side)
+        os.replace(tmp, path)
+        t_save = time.perf_counter()
+        ckpt.save(env_steps, {"learner": state, "carry": carry})
+        ckpt.wait()
+        last_saved = env_steps
+        # Prune sidecars in lockstep with orbax's max_to_keep: each one
+        # holds a full ring-window copy, so orphans from pruned steps
+        # would leak window-sized files every save period.
+        import glob as _glob
+        keep = set(ckpt.all_steps())
+        for old in _glob.glob(os.path.join(checkpoint_dir,
+                                           "host_loop_*.npz")):
+            try:
+                step = int(os.path.basename(old)[len("host_loop_"):-4])
+            except ValueError:
+                continue
+            if step not in keep:
+                os.remove(old)
+        fr.record("checkpoint", "host_replay.save", frames=env_steps,
+                  wall_s=round(time.perf_counter() - t_save, 3))
+        log_fn(json.dumps({"host_replay_checkpoint": env_steps,
+                           "save_s": round(
+                               time.perf_counter() - t_save, 3)}))
+
+    if ckpt is not None:
+        # Emergency checkpoint on watchdog abort (ISSUE 8): the
+        # quiesced whole-state save needs main-thread fencing, so the
+        # abort path saves a LEARNER-ONLY snapshot to a side location
+        # instead — enough to redeploy/serve from, honestly not a
+        # bit-identical resume point (docs/fault_tolerance.md).
+        from dist_dqn_tpu.utils.checkpoint import save_pytree
+
+        _emerg_state = {"state": state}
+
+        def _emergency_save():
+            import os as _os
+            save_pytree(_os.path.join(checkpoint_dir, "emergency_learner"),
+                        {"learner": _emerg_state["state"]})
+
+        tm_watchdog.register_emergency_hook("host_replay.checkpoint",
+                                            _emergency_save)
+
     try:
-        records = stats = handle = None
-        if num_chunks:
+        if num_chunks and not resumed:
             # Chunk 0: prologue dispatch + evacuation submit.
             carry, records, stats = collect_jit(
                 carry, collect_params(state), chunk_iters)
             if pipeline:
                 handle = worker.submit(records)
                 records = None
-        for g in range(num_chunks):
+        elif resumed:
+            # Re-establish the loop invariants at the top of body
+            # ``start_chunk`` exactly as the killed run held them: the
+            # fenced chunk is already inside the checkpointed ring
+            # (pipeline) or rides along as pending records (serial).
+            stats = resume_stats
+            if pipeline:
+                handle = _ResumedEvacHandle()
+            else:
+                records = resume_pending
+        for g in range(start_chunk, num_chunks):
             t0 = time.perf_counter()
             next_records = next_stats = None
             if pipeline:
@@ -642,6 +850,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 records = None
             if did:
                 jax.block_until_ready(state.params)
+            if ckpt is not None:
+                _emerg_state["state"] = state
             hb_train.beat()
             t_train = time.perf_counter()
             fr.record("train", "host_replay.train_event", chunk=g,
@@ -707,11 +917,32 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                                                step=grad_steps)
             history.append(row)
             log_fn(json.dumps(row))
+            if ckpt is not None and env_steps >= next_save:
+                next_save = env_steps + save_period
+                _save_checkpoint(g)
+            # Chaos seam (ISSUE 8): the deliberate mid-run kill the
+            # resume-bit-identical pin uses — fired AFTER the save so
+            # "killed at chunk k" means "with a checkpoint at k".
+            cev = chaos.fire("host_replay.chunk")
+            if cev is not None and cev.fault == "crash":
+                raise chaos.ChaosInjectedError("host_replay.chunk",
+                                               cev.fault)
+        if ckpt is not None and num_chunks:
+            # Final whole-state save: resuming a completed run is a
+            # no-op pass straight to the summary.
+            _save_checkpoint(num_chunks - 1)
     finally:
         if worker is not None:
             worker.close()
         if prefetcher is not None:
             prefetcher.close()
+        if ckpt is not None:
+            tm_watchdog.unregister_emergency_hook("host_replay.checkpoint")
+            try:
+                ckpt.close()
+            except Exception as e:  # noqa: BLE001 — surfaced already
+                log_fn(f"# host-replay checkpoint close failed: "
+                       f"{type(e).__name__}: {e}")
         hb_collect.close()
         hb_train.close()
 

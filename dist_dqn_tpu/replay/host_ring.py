@@ -182,6 +182,51 @@ class HostTimeRing:
         with self._fence:
             self._publish_hooks.append(hook)
 
+    def state_dict(self) -> dict:
+        """Whole-window snapshot for checkpoint/resume (ISSUE 8): the
+        storage arrays plus the cursor/fence scalars. Taken under the
+        fence so a concurrent append can never tear it. ``slot_gen``
+        and ``generation`` ride along so a resumed run's generation
+        fencing continues exactly where the killed run's stopped —
+        required for the bit-identical resume pin (stale-batch
+        semantics must not differ across the kill)."""
+        with self._fence:
+            return {
+                "obs": self.obs.copy(), "action": self.action.copy(),
+                "reward": self.reward.copy(),
+                "terminated": self.terminated.copy(),
+                "truncated": self.truncated.copy(),
+                "slot_gen": self.slot_gen.copy(),
+                "pos": np.int64(self.pos), "size": np.int64(self.size),
+                "generation": np.int64(self.generation),
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` snapshot. Shapes/dtypes must
+        match the ring's construction (same config); publish hooks are
+        NOT replayed — a prioritized sampler must be rebuilt against
+        the restored window by its owner."""
+        if state["obs"].shape != self.obs.shape \
+                or state["obs"].dtype != self.obs.dtype:
+            raise ValueError(
+                f"ring snapshot {state['obs'].shape}/{state['obs'].dtype} "
+                f"does not match this ring "
+                f"{self.obs.shape}/{self.obs.dtype} — the checkpoint was "
+                "written under a different replay/env config")
+        with self._fence:
+            np.copyto(self.obs, state["obs"])
+            np.copyto(self.action, state["action"])
+            np.copyto(self.reward, state["reward"])
+            np.copyto(self.terminated, state["terminated"])
+            np.copyto(self.truncated, state["truncated"])
+            np.copyto(self.slot_gen, state["slot_gen"])
+            self.pos = int(state["pos"])
+            self.size = int(state["size"])
+            self.generation = int(state["generation"])
+            self._fence.notify_all()
+        self._g_size.set(self.size * self.num_envs)
+        self._g_occ.set(self.size / self.num_slots)
+
     def wait_generation(self, target: int,
                         timeout: Optional[float] = None) -> bool:
         """Block until ``generation >= target`` (slice-level publication

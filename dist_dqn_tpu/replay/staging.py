@@ -67,6 +67,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
+from dist_dqn_tpu import chaos
 from dist_dqn_tpu.telemetry import collectors as tm, get_registry
 from dist_dqn_tpu.telemetry import flight as tm_flight
 from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
@@ -430,6 +431,18 @@ class EvacuationWorker:
                 self._hb.close()
                 return
             try:
+                # Chaos seam (ISSUE 8): exception exercises the
+                # tombstone + fence-poisoning contract below with a
+                # provenance-typed error; stall exercises the watchdog
+                # (a sleep past the deadline = one bundle + 503, beats
+                # resume = recovery) — both against the REAL drain path.
+                ev = chaos.fire("evac.drain")
+                if ev is not None:
+                    if ev.fault == "exception":
+                        raise chaos.ChaosInjectedError("evac.drain",
+                                                       ev.fault)
+                    chaos.sleep_for(ev)
+                    chaos.mark_recovered("evac.drain")
                 t0 = job.submitted_at
 
                 def _lag(_i):
@@ -571,6 +584,17 @@ class SamplePrefetcher:
         RNG-stream cursor."""
         return self._next_k
 
+    def seek(self, k: int) -> None:
+        """Fast-forward the batch-index cursor (checkpoint resume,
+        ISSUE 8): batch RNG streams are per-index, so a resumed run
+        must continue the killed run's index sequence, not restart at
+        0. Only valid while idle — requested-but-unpopped work would
+        make the cursor jump ambiguous."""
+        if self._work.qsize() or len(self._stager):
+            raise RuntimeError("seek() on a prefetcher with work in "
+                               "flight")
+        self._next_k = int(k)
+
     @property
     def bytes_staged(self) -> int:
         """Host bytes copied through the internal staging buffers."""
@@ -680,6 +704,17 @@ class SamplePrefetcher:
                     if self._closing:
                         self._hb.close()
                         return
+                # Chaos seam (ISSUE 8): the prefetcher's failure
+                # contract (exception re-raises from pop()/request(),
+                # tombstone drains, close() never hangs) and its stall
+                # behavior, driven on the real worker thread.
+                cev = chaos.fire("prefetch.sample")
+                if cev is not None:
+                    if cev.fault == "exception":
+                        raise chaos.ChaosInjectedError("prefetch.sample",
+                                                       cev.fault)
+                    chaos.sleep_for(cev)
+                    chaos.mark_recovered("prefetch.sample")
                 t0 = time.perf_counter()
                 host_batch, aux = self._sample_fn(k)
                 dt = time.perf_counter() - t0

@@ -29,4 +29,5 @@ from dist_dqn_tpu.serving.router import Router  # noqa: F401
 from dist_dqn_tpu.serving.server import PolicyServer, build_server  # noqa: F401
 from dist_dqn_tpu.serving.types import (ActResult,  # noqa: F401
                                         PolicySnapshot, QueueFullError,
-                                        ServingError, UnknownPolicyError)
+                                        ServerClosedError, ServingError,
+                                        UnknownPolicyError)

@@ -69,6 +69,13 @@ def main():
     parser.add_argument("--slo-queue-depth", type=int, default=0,
                         help="flip /healthz to 503 while the admission "
                              "queue is deeper than this (0 disables)")
+    parser.add_argument("--drain-timeout-s", type=float, default=5.0,
+                        help="graceful-shutdown budget: on SIGTERM/"
+                             "SIGINT the server stops accepting (new "
+                             "requests answer 503), completes every "
+                             "already-admitted request within this "
+                             "window, then exits 0; stragglers past it "
+                             "are failed at teardown")
     parser.add_argument("--poll-interval-s", type=float, default=10.0,
                         help="checkpoint hot-reload watcher period (reads "
                              "the run dir's atomic LATEST pointer)")
@@ -140,6 +147,12 @@ def main():
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
 
+    # Chaos (ISSUE 8): serving game days (reload-under-load, slow
+    # dispatch) arm their fault plan via DQN_CHAOS_PLAN like the
+    # training CLIs and spawned workers do.
+    from dist_dqn_tpu import chaos
+    chaos.maybe_install_from_env()
+
     from dist_dqn_tpu.serving.server import build_server
 
     # Serving-side counterpart of evaluate.py's --wait-for-checkpoint:
@@ -192,7 +205,13 @@ def main():
         while not stop.wait(1.0):
             pass
     finally:
-        server.close()
+        # Graceful drain (ISSUE 8): complete what was admitted, refuse
+        # what was not, exit 0 — in-flight requests no longer race the
+        # teardown.
+        drained = server.drain(args.drain_timeout_s)
+        print(json.dumps({"serving_drained": bool(drained),
+                          "drain_timeout_s": args.drain_timeout_s}),
+              flush=True)
         if telemetry_server is not None:
             telemetry_server.close()
 

@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dist_dqn_tpu import chaos
 from dist_dqn_tpu.actors.act_dispatch import (bucket_rows, pack_act_rows,
                                               split_rows)
 from dist_dqn_tpu.serving.router import Router
@@ -175,6 +176,8 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._serial_lock = threading.Lock()
         self._stopped = False
+        self._draining = False
+        self._dispatching = 0   # batches currently inside _dispatch
         # Drain-rate EWMA for the shed signal's retry-after estimate.
         self._ewma_batch_s = self.max_wait_s + 0.005
         self._ewma_fanin = 1.0
@@ -267,23 +270,43 @@ class MicroBatcher:
         Called from HTTP handler threads (and directly by tests/bench).
         """
         obs = self._validate_obs(obs)
+        if self._draining:
+            # Graceful drain (ISSUE 8): already-admitted requests
+            # complete; NEW admissions are refused up front (503) so
+            # the in-flight queue can only shrink. (Early fast-path
+            # refusal; the authoritative check is re-taken under the
+            # admission lock below, atomically with the enqueue, so a
+            # begin_drain + wait_idle pair can never miss a request
+            # admitted in between.)
+            raise ServerClosedError("server draining for shutdown")
         # Route BEFORE admission: unknown policy / bad epsilon must not
         # consume a queue slot or ride a dispatched batch.
         snap, eps = self.router.resolve(policy_id, epsilon, greedy)
         pending = _Pending(snap.policy_id, obs, eps)
         if not self.batching:
-            if self._stopped:
-                raise ServerClosedError("server shutting down")
+            with self._cond:
+                if self._stopped or self._draining:
+                    raise ServerClosedError("server shutting down")
+                # Claim atomically with the drain check (the batching
+                # path's queue-append twin): from this instant
+                # wait_idle counts the request as in-flight, so a
+                # begin_drain + wait_idle pair can never close the
+                # server under a serial request that already passed
+                # the check.
+                self._dispatching += 1
             # Serialized dispatches compound: N concurrent handlers
             # wait N x dispatch-wall on this lock, so honor timeout_s
             # here like the batching path does (the dispatch itself is
             # one bounded device call).
             if not self._serial_lock.acquire(timeout=timeout_s):
+                with self._cond:
+                    self._dispatching -= 1
+                    self._cond.notify_all()
                 raise ServingError(
                     f"request timed out after {timeout_s}s waiting for "
                     "the serial dispatch lock")
             try:
-                self._dispatch([pending])
+                self._dispatch([pending], claimed=True)
             finally:
                 self._serial_lock.release()
             if pending.error is not None:
@@ -292,6 +315,8 @@ class MicroBatcher:
         with self._cond:
             if self._stopped:
                 raise ServerClosedError("server shutting down")
+            if self._draining:
+                raise ServerClosedError("server draining for shutdown")
             if len(self._queue) >= self.queue_limit:
                 self._tm_shed.inc()
                 raise QueueFullError(
@@ -340,7 +365,7 @@ class MicroBatcher:
                     # client timeout and the next head is another
                     # policy's — nothing assembled; take again.
                     continue
-                self._dispatch(batch)
+                self._dispatch(batch, claimed=True)
                 hb.beat()
         finally:
             hb.close()
@@ -399,13 +424,45 @@ class MicroBatcher:
                 if rows >= self.max_rows:
                     break
             self._tm_depth.set(len(self._queue))
+            if batch:
+                # Claim under THIS lock hold: from wait_idle's view the
+                # batch moves queue -> in-flight atomically.
+                self._dispatching += 1
             return batch
 
-    def _dispatch(self, batch: List[_Pending]) -> None:
+    def _dispatch(self, batch: List[_Pending],
+                  claimed: bool = False) -> None:
+        """``claimed``: the worker path already counted this batch in
+        ``_dispatching`` under the SAME lock hold that popped it from
+        the queue — otherwise wait_idle could observe the instant
+        between the pop and this increment and report an idle batcher
+        while admitted requests still await dispatch."""
+        if not claimed:
+            with self._cond:
+                self._dispatching += 1
+        try:
+            self._dispatch_inner(batch)
+        finally:
+            with self._cond:
+                self._dispatching -= 1
+                self._cond.notify_all()
+
+    def _dispatch_inner(self, batch: List[_Pending]) -> None:
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
         try:
+            # Chaos seam (ISSUE 8): slow_model exercises the SLO/
+            # backpressure degradation path (p99 breach -> 503, queue
+            # growth -> 429) under a genuinely slow dispatch; exception
+            # exercises the fan-out of a dispatch failure to every
+            # rider as a structured 500, not a connection reset.
+            ev = chaos.fire("serving.dispatch")
+            if ev is not None:
+                if ev.fault == "exception":
+                    raise chaos.ChaosInjectedError("serving.dispatch",
+                                                   ev.fault)
+                chaos.sleep_for(ev)
             # ONE snapshot per batch: every row acts on the same params
             # and every response echoes the same version header — the
             # hot-reload atomicity contract.
@@ -421,6 +478,9 @@ class MicroBatcher:
                 p.error = e
                 p.event.set()
             return
+        # A completed dispatch proves recovery from an injected slow/
+        # failed one (the chaos recovery metric's serving anchor).
+        chaos.mark_recovered("serving.dispatch")
         self._tm_dispatches.inc()
         # Counted at DISPATCH, not admission: docs derive the mean
         # request fan-in as requests_total / dispatches_total, so a
@@ -454,6 +514,29 @@ class MicroBatcher:
         for p in stuck:
             p.error = err
             p.event.set()
+
+    def begin_drain(self) -> None:
+        """Stop admitting; keep dispatching what is already queued.
+        Step one of the SIGTERM graceful-drain contract (ISSUE 8):
+        after this, ``submit`` answers ServerClosedError (503) while
+        every request admitted before the drain still gets its real
+        response."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until the queue is empty and no dispatch is in flight
+        (True), or ``timeout_s`` elapsed (False). Meaningful after
+        ``begin_drain`` — an admitting batcher may never go idle."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        with self._cond:
+            while self._queue or self._dispatching:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+            return True
 
     def close(self) -> None:
         with self._cond:

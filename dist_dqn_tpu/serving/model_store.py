@@ -82,8 +82,12 @@ class ModelStore:
             raise CheckpointMissingError(
                 f"policy {policy_id!r}: no checkpoint found under "
                 f"{checkpoint_dir!r}")
+        # carry (fused --checkpoint-replay) and host_loop (host-replay
+        # whole-state, ISSUE 8) checkpoints nest the learner one level
+        # down; plain learner checkpoints hold params at the top.
         prefix = (("learner",)
-                  if read_checkpoint_kind(checkpoint_dir) == "carry"
+                  if read_checkpoint_kind(checkpoint_dir)
+                  in ("carry", "host_loop")
                   else ())
         ckpt = TrainCheckpointer(checkpoint_dir)
         entry = _PolicyEntry(policy_id, checkpoint_dir, ckpt, prefix,
@@ -145,8 +149,18 @@ class ModelStore:
         """Restore ``step`` (None = newest) into a fresh snapshot.
         Blocking I/O — called at startup and from the watcher thread,
         NEVER from the act path."""
+        from dist_dqn_tpu import chaos
         from dist_dqn_tpu.utils.checkpoint import read_latest_pointer
 
+        # Chaos seam (ISSUE 8): slow_reload holds the restore mid-flight
+        # (reload-during-load — the act path must keep serving the
+        # resident snapshot, version headers must never tear); fail
+        # exercises poll_once's keep-resident-and-retry contract.
+        ev = chaos.fire("serving.reload")
+        if ev is not None:
+            if ev.fault == "fail":
+                raise chaos.ChaosInjectedError("serving.reload", ev.fault)
+            chaos.sleep_for(ev)
         restored = entry.ckpt.restore_params(self.example_params,
                                              step=step,
                                              prefix=entry.prefix)
@@ -189,6 +203,8 @@ class ModelStore:
                 continue
             with self._lock:
                 entry.snapshot = snap  # THE atomic swap
+            from dist_dqn_tpu import chaos
+            chaos.mark_recovered("serving.reload")
             reloaded.append(entry.policy_id)
             self._reload_counter(entry.policy_id).inc()
             self._version_gauge(entry.policy_id).set(snap.version)

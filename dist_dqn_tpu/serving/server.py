@@ -271,6 +271,26 @@ class PolicyServer:
 
         return Handler
 
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful drain (ISSUE 8 satellite): stop ADMITTING (new
+        submits answer 503 ServerClosedError), let every already-
+        admitted request complete within ``timeout_s``, then tear
+        down. Returns True when the queue drained fully; False when
+        the timeout expired and the stragglers were failed by
+        ``close`` — either way the server is closed on return. Before
+        this existed a SIGTERM raced in-flight requests against the
+        teardown: the batcher's fail-queue answered them with errors
+        mid-protocol."""
+        self.batcher.begin_drain()
+        drained = self.batcher.wait_idle(timeout_s)
+        # One beat for handler threads to WRITE the final responses
+        # the dispatch just completed — wait_idle proves dispatch
+        # completion, not that the bytes left the socket.
+        import time as _time
+        _time.sleep(0.05)
+        self.close()
+        return drained
+
     def close(self) -> None:
         if self.slo is not None:
             tm_watchdog.unregister_health_probe(self._slo_probe)

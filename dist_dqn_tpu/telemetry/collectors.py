@@ -105,6 +105,21 @@ SERVING_RELOADS = "dqn_serving_reloads_total"
 SERVING_POLICY_VERSION = "dqn_serving_policy_version"
 SERVING_SLO_BREACHES = "dqn_serving_slo_breaches_total"
 
+# Chaos harness + proven graceful degradation (ISSUE 8): injections are
+# labeled {seam, fault} (the seam registry is chaos/plan.py SEAMS);
+# RECOVERY_SECONDS measures injection -> recovery-proof per {seam}
+# (which call site proves which fault: docs/fault_tolerance.md).
+# TRANSPORT_CORRUPT counts frames failing the wire integrity check
+# (magic/length/CRC32) per {reason}; TRANSPORT_SHED counts records the
+# TCP listener dropped after the bounded backpressure wait (shed +
+# alarm instead of wedging the serve thread); INGEST_DEGRADED is 1
+# while supervision sees at least half the actor fleet dead.
+CHAOS_INJECTED = "dqn_chaos_injected_total"
+CHAOS_RECOVERY_SECONDS = "dqn_recovery_seconds"
+TRANSPORT_CORRUPT = "dqn_transport_corrupt_frames_total"
+TRANSPORT_SHED = "dqn_transport_tcp_shed_total"
+INGEST_DEGRADED = "dqn_ingest_degraded"
+
 # Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
 # heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
 # (the full stage table is in docs/observability.md), divergence trips

@@ -113,6 +113,19 @@ def get_run_manifest() -> Optional[Dict]:
         return None if _run_manifest is None else dict(_run_manifest)
 
 
+def annotate_manifest(key: str, value) -> None:
+    """Fold one key into the run manifest (ISSUE 8: an armed chaos
+    plan records itself here, so forensics bundles and /debug/config
+    say which faults were scheduled). Installs a fresh cfg-less
+    manifest when none exists yet — processes that never built one
+    (spawned actors, bare tests) still get the annotation recorded."""
+    global _run_manifest
+    with _lock:
+        if _run_manifest is None:
+            _run_manifest = build_manifest()
+        _run_manifest[key] = value
+
+
 def _reset_for_tests() -> None:
     global _run_manifest
     with _lock:

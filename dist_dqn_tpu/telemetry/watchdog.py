@@ -373,9 +373,10 @@ class Watchdog:
         return stale
 
     def _abort(self) -> None:
-        """SIGTERM ourselves (graceful: chains the lifecycle flush and
-        the device-grant release), then hard-exit if still alive past
-        the grace window. Runs on the watchdog thread."""
+        """Emergency checkpoint hooks first, then SIGTERM ourselves
+        (graceful: chains the lifecycle flush and the device-grant
+        release), then hard-exit if still alive past the grace window.
+        Runs on the watchdog thread."""
         if self._aborting:
             return
         self._aborting = True
@@ -383,6 +384,14 @@ class Watchdog:
             self.log_fn(json.dumps(
                 {"watchdog_abort": True,
                  "grace_s": self.abort_grace_s}))
+        # Emergency checkpoints (ISSUE 8 hardening): an aborting run's
+        # newest learner state would otherwise be lost to whatever the
+        # periodic save cadence left behind. Hooks are registered by
+        # the loops that own checkpointers and run best-effort — a
+        # hook that itself wedges must not block the abort past the
+        # grace window, so they ride a bounded side thread.
+        run_emergency_hooks(timeout_s=self.abort_grace_s,
+                            log_fn=self.log_fn)
         os.kill(os.getpid(), signal.SIGTERM)
         time.sleep(self.abort_grace_s)
         os._exit(70)
@@ -608,6 +617,57 @@ def health_state():
     return ok, detail
 
 
+#: Emergency-checkpoint hooks (ISSUE 8): name -> zero-arg callable run
+#: by a watchdog abort BEFORE the SIGTERM, so the newest learner state
+#: survives the kill. Registered by the loops that own checkpointers
+#: (train.py fused loop, host_replay_loop, the apex service) and
+#: deregistered in their finally blocks.
+_emergency_hooks: Dict[str, object] = {}
+
+
+def register_emergency_hook(name: str, hook) -> None:
+    """Register a best-effort pre-abort hook (re-registering a name
+    replaces it). The hook must tolerate running on a side thread
+    while the main loop is wedged — save immutable snapshots, don't
+    take loop locks."""
+    with _global_lock:
+        _emergency_hooks[name] = hook
+
+
+def unregister_emergency_hook(name: str) -> None:
+    with _global_lock:
+        _emergency_hooks.pop(name, None)
+
+
+def run_emergency_hooks(timeout_s: float = 10.0, log_fn=print) -> None:
+    """Run every registered hook on a bounded side thread; a hook that
+    hangs past ``timeout_s`` is abandoned (daemon thread) rather than
+    blocking the abort."""
+    with _global_lock:
+        hooks = list(_emergency_hooks.items())
+    for name, hook in hooks:
+        done = threading.Event()
+        err: list = []
+
+        def _run(hook=hook):
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001 — best effort
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run,
+                             name=f"emergency-hook-{name}", daemon=True)
+        t.start()
+        finished = done.wait(timeout_s)
+        if log_fn is not None:
+            log_fn(json.dumps({"emergency_hook": name,
+                               "completed": bool(finished and not err),
+                               "error": (f"{type(err[0]).__name__}: "
+                                         f"{err[0]}") if err else None}))
+
+
 def register_health_probe(name: str, probe) -> None:
     """Add a /healthz contributor: ``probe()`` -> None (healthy) or a
     detail dict (unhealthy; served as 503 JSON under ``name``).
@@ -648,3 +708,4 @@ def _reset_for_tests() -> None:
             _watchdog = None
         _sentinel = DivergenceSentinel()
         _health_probes.clear()
+        _emergency_hooks.clear()

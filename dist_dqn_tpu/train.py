@@ -232,6 +232,29 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             else:
                 carry = carry._replace(learner=tree)
 
+    # Emergency checkpoint on watchdog abort (ISSUE 8): the abort path
+    # saves the NEWEST chunk-boundary state before SIGTERM, so a wedged
+    # run loses at most one chunk instead of a whole save period. The
+    # holder is refreshed each chunk; device arrays are immutable, so
+    # the side-thread save reads a consistent snapshot. Saved to a SIDE
+    # location with a one-shot checkpointer — the shared manager may be
+    # the very thing the main thread is wedged inside (slow storage),
+    # and a concurrent save on it would tear the in-flight commit.
+    _emerg = {"frames": resumed_frames, "carry": carry}
+    if ckpt is not None:
+        from dist_dqn_tpu.utils.checkpoint import save_pytree as _save_pt
+
+        def _emergency_save():
+            import os
+
+            tree = (_emerg["carry"] if checkpoint_replay
+                    else _emerg["carry"].learner)
+            _save_pt(os.path.join(checkpoint_dir, "emergency_learner"),
+                     {"learner": tree})
+
+        tm_watchdog.register_emergency_hook("fused.checkpoint",
+                                            _emergency_save)
+
     B = cfg.actor.num_envs
     history = []
     frames = resumed_frames
@@ -307,6 +330,7 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             history.append(row)
             log_fn(json.dumps({k: round(v, 3) if isinstance(v, float) else v
                                for k, v in row.items()}))
+            _emerg["frames"], _emerg["carry"] = frames, carry
             if ckpt is not None:
                 ckpt.maybe_save(frames,
                                 carry if checkpoint_replay else carry.learner)
@@ -321,6 +345,7 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
         # heartbeat would read as a permanent stall in a
         # process that caught the exception and lived on.
         _hb_chunk.close()
+        tm_watchdog.unregister_emergency_hook("fused.checkpoint")
     if ckpt is not None:
         ckpt.save(frames, carry if checkpoint_replay else carry.learner)
         ckpt.close()
@@ -612,24 +637,35 @@ def main():
     _man = _manifest.build_manifest(cfg, argv=_sys.argv)
     _manifest.set_run_manifest(_man)
     print(json.dumps({"manifest": _man}))
+    # Chaos (ISSUE 8): game-day runs arm a fault plan via DQN_CHAOS_PLAN
+    # — AFTER the manifest is set so the armed plan annotates it (the
+    # provenance line above already printed; /debug/config and the
+    # forensics bundles read the annotated copy).
+    from dist_dqn_tpu import chaos as _chaos
+    _chaos.maybe_install_from_env()
     if args.runtime == "host-replay":
         # Hybrid fused loop with the replay window in host DRAM
         # (host_replay_loop.py): device env chunks stream transitions
         # down once, sampled batches stream back double-buffered. The
         # window is DRAM-priced — set replay.capacity accordingly
         # (e.g. --set replay.capacity=8000000 with frame_dedup).
-        for val, name in ((args.checkpoint_dir, "--checkpoint-dir"),
-                          (args.profile_dir, "--profile-dir"),
+        for val, name in ((args.profile_dir, "--profile-dir"),
                           (args.stop_at_return, "--stop-at-return")):
             if val is not None:
                 print(f"# {name} is not supported by --runtime "
                       "host-replay (prototype surface); ignored")
-        for val, name in ((args.mesh_devices != 1, "--mesh-devices"),
-                          (args.save_every_frames, "--save-every-frames"),
-                          (args.checkpoint_replay, "--checkpoint-replay")):
+        for val, name in ((args.mesh_devices != 1, "--mesh-devices"),):
             if val:
                 print(f"# {name} is not supported by --runtime "
                       "host-replay (prototype surface); ignored")
+        if args.checkpoint_replay:
+            print("# --checkpoint-replay is implied by --runtime "
+                  "host-replay --checkpoint-dir: its checkpoints are "
+                  "always whole-state (ring + carry + learner) so "
+                  "resume is bit-identical; flag ignored")
+        if args.save_every_frames and not args.checkpoint_dir:
+            print("# --save-every-frames does nothing without "
+                  "--checkpoint-dir; ignored")
         if args.eval_every_steps:
             print("# periodic eval is not supported by --runtime "
                   "host-replay; ignored")
@@ -664,7 +700,9 @@ def main():
             prefetch=not args.no_prefetch,
             prefetch_depth=args.prefetch_depth,
             # None = follow cfg.replay.prioritized; --per forces it on.
-            prioritized=True if args.per else None)
+            prioritized=True if args.per else None,
+            checkpoint_dir=args.checkpoint_dir,
+            save_every_frames=args.save_every_frames)
         out.pop("history", None)
         print(json.dumps(out))
         return

@@ -41,6 +41,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from dist_dqn_tpu import chaos
 from dist_dqn_tpu.types import PyTree
 
 
@@ -98,19 +99,34 @@ class TrainCheckpointer:
         thread, so a failed commit still fails the run instead of dying
         silently in a daemon thread.
         """
+        import errno
         import threading
 
         self._join_pointer_stamp()
+        # Chaos seam (ISSUE 8): "fail" is a disk-full save (the caller
+        # must surface it, not train on silently); "crash_before_stamp"
+        # commits the orbax step but never stamps LATEST — exactly the
+        # crash window latest_step()'s listing fallback exists for.
+        ev = chaos.fire("checkpoint.save")
+        if ev is not None and ev.fault == "fail":
+            raise OSError(errno.ENOSPC,
+                          "chaos: injected disk-full on checkpoint save")
         self._mgr.save(frames, args=ocp.args.StandardSave(learner))
         # Checksum on the caller's thread: orbax has already snapshotted
         # the tree, and device-backed arrays stay off the side thread.
         checksum = _pointer_checksum(learner)
+        if ev is not None and ev.fault == "crash_before_stamp":
+            self._mgr.wait_until_finished()
+            return
 
         def _stamp():
             try:
                 self._mgr.wait_until_finished()
                 write_latest_pointer(self.directory, frames,
                                      param_checksum=checksum)
+                # A completed save + stamp proves recovery from any
+                # earlier injected save/stamp fault.
+                chaos.mark_recovered("checkpoint.save")
             except BaseException as e:  # re-raised at the next join
                 self._ptr_error = e
 
@@ -444,6 +460,15 @@ def write_latest_pointer(directory: str, step: int,
         "saved_unix": time.time(),
     }
     path = os.path.join(directory, _LATEST_FILE)
+    ev = chaos.fire("latest.write")
+    if ev is not None and ev.fault == "torn":
+        # A torn stamp: half a JSON object lands as the final file
+        # (crash mid-write on a filesystem without atomic rename
+        # semantics). read_latest_pointer must reject it and every
+        # reader must fall back to the orbax listing.
+        with open(path, "w") as fh:
+            fh.write(json.dumps(payload)[: max(4, len(str(step)))])
+        return
     # Per-process tmp name: on multihost runs every process stamps the
     # shared dir after its save; a fixed tmp would let writers truncate
     # each other mid-write and rename a torn JSON into place. Distinct
@@ -452,6 +477,9 @@ def write_latest_pointer(directory: str, step: int,
     with open(tmp, "w") as fh:
         json.dump(payload, fh, sort_keys=True)
     os.replace(tmp, path)
+    # A committed, well-formed stamp proves recovery from an earlier
+    # injected torn write.
+    chaos.mark_recovered("latest.write")
 
 
 def checkpoint_present(directory: str) -> bool:
@@ -498,10 +526,12 @@ _KIND_FILE = "CHECKPOINT_KIND"
 
 def record_checkpoint_kind(directory: str, kind: str) -> None:
     """Stamp what a checkpoint directory's items contain — ``learner``
-    (the default recovery point) or ``carry`` (--checkpoint-replay's
-    whole fused carry). Restore paths read this to template correctly
-    and to say THE ACTUAL CAUSE when the flavors mismatch, instead of
-    orbax's structure error being rewrapped as a config drift."""
+    (the default recovery point), ``carry`` (--checkpoint-replay's
+    whole fused carry) or ``host_loop`` (the host-replay runtime's
+    whole-state {learner, carry} + npz sidecar, ISSUE 8). Restore
+    paths read this to template correctly and to say THE ACTUAL CAUSE
+    when the flavors mismatch, instead of orbax's structure error
+    being rewrapped as a config drift."""
     import os
 
     path = os.path.join(directory, _KIND_FILE)

@@ -1,0 +1,506 @@
+#!/usr/bin/env python
+"""Game-day runner (ISSUE 8): execute REAL CartPole runs of the apex,
+host-replay and serving stacks under a seeded fault schedule and assert
+the survival invariants the chaos harness exists to prove.
+
+Scenarios (each armed with a FaultPlan derived from ``--seed``; the
+same seed derives the same schedule — ``--print-plan`` emits it without
+running, so replayability is checkable byte-for-byte):
+
+  apex_fleet      actor kill -9 (every actor dies and is restarted by
+                  supervision, repeatedly), transport bit-flip (the
+                  corrupt frame is CRC-dropped + counted server-side
+                  and the actor NACK-reconnects) and transport
+                  disconnect — training must reach its target anyway.
+  pipeline_wedge  evac + prefetch worker stalls past a short watchdog
+                  deadline — each stall must produce exactly one
+                  forensics bundle and a /healthz 503 -> 200 round
+                  trip, and the run must finish with correct numerics.
+  ckpt_crash      commit-without-stamp checkpoint crash, torn LATEST
+                  pointer, then a hard kill at chunk k — the resumed
+                  run must be BIT-IDENTICAL to an uninterrupted,
+                  never-checkpointed reference, with every injected
+                  trip recovered.
+  serving_reload  hot-reload under live load with a slowed restore and
+                  a slowed + failed dispatch — every request answers
+                  (the one injected failure as a structured error),
+                  versions never tear or regress per client, and the
+                  SIGTERM drain completes with admissions refused.
+
+Run from the repo root (CPU is fine)::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_run.py --seed 7
+    python scripts/chaos_run.py --seed 7 --print-plan   # schedule only
+    python scripts/chaos_run.py --scenario ckpt_crash
+
+Exit 0 = every invariant held. Each scenario prints one JSON line of
+evidence; the failure-mode matrix in docs/fault_tolerance.md says
+which invariant pins which fault.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dist_dqn_tpu import chaos  # noqa: E402
+from dist_dqn_tpu.chaos.plan import FaultEvent, FaultPlan  # noqa: E402
+
+
+class InvariantError(AssertionError):
+    pass
+
+
+def _check(cond, msg):
+    if not cond:
+        raise InvariantError(msg)
+
+
+def _counter_total(name, **labels):
+    """Sum a family's counters matching the given labels."""
+    from dist_dqn_tpu.telemetry import get_registry
+
+    total = 0.0
+    for inst in get_registry().collect().get(name, []):
+        if all(inst.labels.get(k) == v for k, v in labels.items()):
+            total += inst.value
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Seeded schedules: same seed -> same plan, per scenario
+# ---------------------------------------------------------------------------
+
+def plan_apex_fleet(seed: int) -> FaultPlan:
+    rng = random.Random(f"{seed}:apex_fleet")
+    return FaultPlan(seed=seed, events=(
+        # Every actor process arms this slice: each dies (SIGKILL
+        # semantics) once per ~this many step passes and supervision
+        # must restart it — repeated fleet churn, not a one-off.
+        FaultEvent("actor.step", "crash", at_hit=100 + rng.randrange(40)),
+        # Remote actors only (the seam sits on the TCP client): one
+        # frame's payload corrupted on the wire, one hard disconnect.
+        FaultEvent("transport.send", "bit_flip",
+                   at_hit=40 + rng.randrange(20),
+                   args={"bit": 200 + rng.randrange(4000)}),
+        FaultEvent("transport.send", "disconnect",
+                   at_hit=70 + rng.randrange(20)),
+    ))
+
+
+def plan_pipeline_wedge(seed: int, stall_s: float) -> FaultPlan:
+    rng = random.Random(f"{seed}:pipeline_wedge")
+    return FaultPlan(seed=seed, events=(
+        FaultEvent("evac.drain", "stall", at_hit=2 + rng.randrange(2),
+                   args={"delay_s": stall_s}),
+        # Far later in the batch stream than the evac stall so the two
+        # wedges are distinct episodes (=> one bundle EACH).
+        FaultEvent("prefetch.sample", "stall",
+                   at_hit=30 + rng.randrange(8),
+                   args={"delay_s": stall_s}),
+    ))
+
+
+def plan_ckpt_crash(seed: int) -> FaultPlan:
+    rng = random.Random(f"{seed}:ckpt_crash")
+    return FaultPlan(seed=seed, events=(
+        # Save 2 commits its orbax step but dies before stamping LATEST.
+        FaultEvent("checkpoint.save", "crash_before_stamp", at_hit=2),
+        # Save 3's stamp lands torn (crash mid-write without rename).
+        FaultEvent("latest.write", "torn", at_hit=3),
+        # Then the run itself is killed right after chunk k's save.
+        FaultEvent("host_replay.chunk", "crash",
+                   at_hit=4 + rng.randrange(2)),
+    ))
+
+
+def plan_serving_reload(seed: int) -> FaultPlan:
+    rng = random.Random(f"{seed}:serving_reload")
+    return FaultPlan(seed=seed, events=(
+        # Hit 1 is the startup restore; the slowed one is the watcher's
+        # reload-under-load.
+        FaultEvent("serving.reload", "slow_reload", at_hit=2,
+                   args={"delay_s": 0.5}),
+        FaultEvent("serving.dispatch", "slow_model",
+                   at_hit=3 + rng.randrange(3),
+                   args={"delay_s": 0.3}),
+        FaultEvent("serving.dispatch", "exception",
+                   at_hit=10 + rng.randrange(5)),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_apex_fleet(seed: int, workdir: str) -> dict:
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+    from dist_dqn_tpu.config import CONFIGS
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=150),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2))
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=4, total_env_steps=4000,
+                           inserts_per_grad_step=32,
+                           num_remote_actors=2, log_every_s=2.0)
+    plan = plan_apex_fleet(seed)
+    corrupt_before = _counter_total("dqn_transport_corrupt_frames_total",
+                                    reason="crc", side="server")
+    # export_env: spawned actor processes arm their own copy of the
+    # plan (hit counters are per process — every actor lives the same
+    # schedule, which is what decimates the fleet).
+    inj = chaos.install(plan, export_env=True, log_fn=None)
+    try:
+        out = run_apex(cfg, rt, log_fn=lambda s: None)
+    finally:
+        chaos.uninstall()
+        os.environ.pop(chaos.CHAOS_PLAN_ENV, None)
+    corrupt = _counter_total("dqn_transport_corrupt_frames_total",
+                             reason="crc", side="server") - corrupt_before
+    # Survival: progress to target with actors dying under us.
+    _check(out["env_steps"] >= rt.total_env_steps,
+           f"apex run stalled at {out['env_steps']} env steps")
+    _check(out["grad_steps"] > 0, "no training happened")
+    _check(out["actor_restarts"] >= 1,
+           "no actor was killed+restarted — the crash seam never fired")
+    # The flipped bit was dropped at the CRC gate, counted, and the
+    # run STILL finished: it never reached the codec or the learner.
+    _check(corrupt >= 1, "no corrupt frame was counted server-side")
+    _check(out["bad_records"] == 0,
+           "a corrupt frame leaked past the integrity gate")
+    return {"scenario": "apex_fleet", "plan": plan.to_dict(),
+            "env_steps": out["env_steps"],
+            "grad_steps": out["grad_steps"],
+            "actor_restarts": out["actor_restarts"],
+            "corrupt_frames_dropped": int(corrupt),
+            "parent_injections": inj.injected}
+
+
+def scenario_pipeline_wedge(seed: int, workdir: str) -> dict:
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=16))
+    stall_s, deadline_s = 4.0, 1.5
+    plan = plan_pipeline_wedge(seed, stall_s)
+    forensics = os.path.join(workdir, "forensics")
+    bundles_before = len(os.listdir(forensics)) \
+        if os.path.isdir(forensics) else 0
+    tm_watchdog.install_watchdog(forensics_dir=forensics,
+                                 deadline_s=deadline_s, poll_s=0.25,
+                                 log_fn=None)
+
+    # /healthz sampler: the wedge must flip health to 503 and the
+    # recovery back to 200 — sampled while the run executes.
+    health_samples, stop = [], threading.Event()
+
+    def poll_health():
+        while not stop.is_set():
+            ok, detail = tm_watchdog.health_state()
+            health_samples.append(bool(ok))
+            time.sleep(0.1)
+
+    poller = threading.Thread(target=poll_health,
+                              name="chaos-health-poller", daemon=True)
+    poller.start()
+    try:
+        with chaos.installed(plan, log_fn=None) as inj:
+            out = run_host_replay(cfg, total_env_steps=6400,
+                                  chunk_iters=50, log_fn=lambda s: None)
+            injected = list(inj.injected)
+            open_trips = inj.open_trips()
+    finally:
+        stop.set()
+        poller.join(5)
+        # Relax the deadline so later scenarios / idle time can't trip.
+        tm_watchdog.install_watchdog(forensics_dir=None, deadline_s=600.0,
+                                     log_fn=None)
+    bundles = sorted(os.listdir(forensics)) if os.path.isdir(forensics) \
+        else []
+    n_bundles = len(bundles) - bundles_before
+    stalls = [e for e in injected if e["fault"] == "stall"]
+    _check(len(stalls) == 2, f"expected 2 stall injections, got {stalls}")
+    _check(out["env_steps"] >= 6400, "wedged run did not finish")
+    # Exactly one bundle per injected stall: the watchdog latches a
+    # stale stage until it recovers, so each wedged WORKER stage shows
+    # up newly-stale in exactly one bundle — no bundle storm. (A wedge
+    # can additionally stall the main-loop stages blocked on its fence;
+    # those cascade bundles name OTHER stages, never the same wedge
+    # twice.)
+    named = []
+    for b in bundles:
+        with open(os.path.join(forensics, b, "reason.json")) as fh:
+            named.append(json.load(fh)["detail"]["newly_stale"])
+    for stage in ("evac.host_replay", "prefetch.host_replay"):
+        hits = sum(1 for stages in named if stage in stages)
+        _check(hits == 1,
+               f"wedged stage {stage} appears newly-stale in {hits} "
+               f"bundles (want exactly 1): {named}")
+        _check(_counter_total("dqn_watchdog_stalls_total",
+                              stage=stage) == 1,
+               f"stall episodes for {stage} != 1")
+    _check(n_bundles >= 2, f"missing bundles: {named}")
+    _check(not all(health_samples),
+           "healthz never went 503 during a 4s wedge")
+    _check(health_samples and health_samples[-1],
+           "healthz did not recover to 200 after the wedges")
+    _check(open_trips == [],
+           f"stall trips never marked recovered: {open_trips}")
+    return {"scenario": "pipeline_wedge", "plan": plan.to_dict(),
+            "env_steps": out["env_steps"], "bundles": n_bundles,
+            "healthz_ever_503": not all(health_samples),
+            "healthz_final_200": bool(health_samples[-1]),
+            "injections": injected}
+
+
+def scenario_ckpt_crash(seed: int, workdir: str) -> dict:
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=16))
+    kw = dict(total_env_steps=3200, chunk_iters=50,
+              log_fn=lambda s: None)
+    ref = run_host_replay(cfg, **kw)
+
+    plan = plan_ckpt_crash(seed)
+    ckpt_dir = os.path.join(workdir, "ckpt_crash")
+    killed = False
+    with chaos.installed(plan, log_fn=None) as inj:
+        try:
+            run_host_replay(cfg, **kw, checkpoint_dir=ckpt_dir,
+                            save_every_frames=400)
+        except chaos.ChaosInjectedError:
+            killed = True
+        _check(killed, "the injected chunk crash never fired")
+        # Resume under the SAME armed injector: resuming IS the
+        # recovery proof for the crash, and the resumed run's first
+        # completed save+stamp proves the checkpoint seams recovered.
+        out = run_host_replay(cfg, **kw, checkpoint_dir=ckpt_dir,
+                              save_every_frames=400)
+        injected = sorted((e["seam"], e["fault"], e["hit"])
+                          for e in inj.injected)
+        open_trips = inj.open_trips()
+    expected = sorted((e.seam, e.fault, e.at_hit) for e in plan.events)
+    _check(injected == expected,
+           f"injection sequence diverged from the plan: {injected} != "
+           f"{expected}")
+    _check(out["param_checksum"] == ref["param_checksum"],
+           "resumed run is NOT bit-identical to the uninterrupted one: "
+           f"{out['param_checksum']} != {ref['param_checksum']}")
+    _check(out["grad_steps"] == ref["grad_steps"],
+           "resumed run trained a different number of steps")
+    _check(open_trips == [],
+           f"unrecovered trips after resume: {open_trips}")
+    return {"scenario": "ckpt_crash", "plan": plan.to_dict(),
+            "param_checksum": out["param_checksum"],
+            "reference_checksum": ref["param_checksum"],
+            "bit_identical": True, "injections": injected}
+
+
+def scenario_serving_reload(seed: int, workdir: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.serving import ServerClosedError, build_server
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    cfg = CONFIGS["cartpole"]
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, _ = make_learner(net, cfg.learner)
+    obs0 = jnp.zeros(env.observation_shape, env.observation_dtype)
+    directory = os.path.join(workdir, "serving_ckpt")
+    ckpt = TrainCheckpointer(directory, save_every_frames=1)
+    ckpt.save(100, init(jax.random.PRNGKey(0), obs0))
+    ckpt.wait()
+
+    plan = plan_serving_reload(seed)
+    reloads_before = _counter_total("dqn_serving_reloads_total")
+    srv = build_server(cfg, {"default": directory}, max_rows=8,
+                       max_wait_ms=1.0, queue_limit=64,
+                       poll_interval_s=0.2, log_fn=lambda *_: None)
+    results, errors = [], []
+    try:
+        with chaos.installed(plan, log_fn=None) as inj:
+            def client(tid):
+                rng = np.random.default_rng(tid)
+                for _ in range(20):
+                    obs = rng.standard_normal((2, 4)).astype(np.float32)
+                    try:
+                        r = srv.batcher.submit(obs, greedy=True)
+                        results.append((tid, r.version, r.step))
+                    except chaos.ChaosInjectedError as e:
+                        errors.append((tid, repr(e)))
+                    time.sleep(0.01)
+
+            threads = [threading.Thread(target=client, args=(t,),
+                                        name=f"chaos-client-{t}",
+                                        daemon=True) for t in range(4)]
+            for t in threads:
+                t.start()
+            # Reload under load: two version bumps while clients hammer
+            # and the injected slow_reload holds a restore mid-flight.
+            time.sleep(0.2)
+            ckpt.save(200, init(jax.random.PRNGKey(1), obs0))
+            ckpt.wait()
+            time.sleep(0.4)
+            ckpt.save(300, init(jax.random.PRNGKey(2), obs0))
+            ckpt.wait()
+            for t in threads:
+                t.join(60)
+                _check(not t.is_alive(), "a serving client hung")
+            # Keep serving until the second reload demonstrably landed:
+            # the act path must pick up step 300 while never tearing.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                r = srv.batcher.submit(
+                    np.zeros((1, 4), np.float32), greedy=True)
+                results.append((0, r.version, r.step))
+                if r.step == 300:
+                    break
+                time.sleep(0.05)
+            injected = list(inj.injected)
+            open_trips = inj.open_trips()
+        # Every request answered: results + the structured errors of
+        # the ONE injected dispatch failure (each rider coalesced into
+        # that batch gets it).
+        _check(len(results) + len(errors) >= 80,
+               f"lost requests: {len(results)} ok + {len(errors)} err")
+        _check(1 <= len(errors) <= 4,
+               f"expected 1 failed dispatch (<=4 riders), got {errors}")
+        # No version tears or regressions per client: a later request
+        # rides the same or a newer snapshot, never an older one.
+        for tid in range(4):
+            seq = [v for t, v, _ in results if t == tid]
+            _check(seq == sorted(seq),
+                   f"client {tid} saw a version regression: {seq}")
+        steps_seen = {s for _, _, s in results}
+        _check(max(steps_seen) == 300,
+               f"hot reload never landed while serving: {steps_seen}")
+        reloads = _counter_total("dqn_serving_reloads_total") \
+            - reloads_before
+        _check(reloads >= 2, f"expected >=2 reloads, got {reloads}")
+        _check(open_trips == [],
+               f"unrecovered serving trips: {open_trips}")
+        # Graceful drain: admitted work completes, new work is refused,
+        # the server closes clean — the SIGTERM path minus the signal.
+        drained = srv.drain(5.0)
+        _check(drained, "drain timed out with requests in flight")
+        refused = False
+        try:
+            srv.batcher.submit(np.zeros((1, 4), np.float32), greedy=True)
+        except ServerClosedError:
+            refused = True
+        _check(refused, "a post-drain admission was not refused")
+    finally:
+        srv.close()
+        ckpt.close()
+    return {"scenario": "serving_reload", "plan": plan.to_dict(),
+            "answered": len(results), "injected_failures": len(errors),
+            "steps_seen": sorted(steps_seen), "reloads": int(reloads),
+            "drained": True, "injections": injected}
+
+
+SCENARIOS = {
+    "apex_fleet": scenario_apex_fleet,
+    "pipeline_wedge": scenario_pipeline_wedge,
+    "ckpt_crash": scenario_ckpt_crash,
+    "serving_reload": scenario_serving_reload,
+}
+
+PLANS = {
+    "apex_fleet": plan_apex_fleet,
+    "pipeline_wedge": lambda seed: plan_pipeline_wedge(seed, 4.0),
+    "ckpt_crash": plan_ckpt_crash,
+    "serving_reload": plan_serving_reload,
+}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0,
+                        help="schedule seed: the same seed derives the "
+                             "same fault plan for every scenario")
+    parser.add_argument("--scenario", action="append", default=[],
+                        choices=sorted(SCENARIOS),
+                        help="run only these (repeatable; default all)")
+    parser.add_argument("--print-plan", action="store_true",
+                        help="emit every scenario's derived schedule "
+                             "as JSON and exit — diff two invocations "
+                             "to verify seed replayability")
+    parser.add_argument("--workdir", default=None,
+                        help="scratch directory (default: a fresh "
+                             "tempdir)")
+    args = parser.parse_args()
+
+    names = args.scenario or sorted(SCENARIOS)
+    if args.print_plan:
+        print(json.dumps({name: PLANS[name](args.seed).to_dict()
+                          for name in names}, sort_keys=True, indent=2))
+        return 0
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_run_")
+    failures = []
+    t_all = time.perf_counter()
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            result = SCENARIOS[name](args.seed, workdir)
+            result["wall_s"] = round(time.perf_counter() - t0, 1)
+            result["ok"] = True
+        except InvariantError as e:
+            failures.append(name)
+            result = {"scenario": name, "ok": False,
+                      "invariant_failed": str(e),
+                      "wall_s": round(time.perf_counter() - t0, 1)}
+        print(json.dumps(result), flush=True)
+    print(json.dumps({
+        "chaos_run": {"seed": args.seed, "scenarios": names,
+                      "failures": failures,
+                      "wall_s": round(time.perf_counter() - t_all, 1)}}),
+        flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

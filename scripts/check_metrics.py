@@ -72,17 +72,28 @@ ALLOWLIST = {
     "benchmarks/serving_bench.py": 1,
     "benchmarks/tpu_battery.py": 5,
     "dist_dqn_tpu/actors/remote.py": 1,
-    "dist_dqn_tpu/actors/service.py": 3,
+    # +2 at ISSUE 8: the ingest_degraded alarm transitions (one line
+    # per episode edge, state changes — the continuous signal is the
+    # dqn_ingest_degraded gauge).
+    "dist_dqn_tpu/actors/service.py": 5,
+    # ISSUE 8: the one-per-episode transport shedding alarm (the
+    # per-record stream is dqn_transport_tcp_shed_total).
+    "dist_dqn_tpu/actors/transport.py": 1,
     "dist_dqn_tpu/atari57.py": 7,
     # +1 at ISSUE 4: the telemetry_port announcement line (a CLI output
     # contract like train.py's, not a metric — the metrics themselves go
     # through the registry the flag exposes).
     "dist_dqn_tpu/evaluate.py": 2,
-    "dist_dqn_tpu/host_replay_loop.py": 1,
+    # +2 at ISSUE 8: the resumed_at_frames and per-save checkpoint
+    # announcement lines (run-lifecycle output contracts, mirroring
+    # train.py's resume line; the chaos/crash metrics go through the
+    # registry).
+    "dist_dqn_tpu/host_replay_loop.py": 3,
     # ISSUE 7: the serving CLI's startup announcements (serving_port +
     # optional telemetry_port) — output contracts like train.py's; act
-    # metrics go through the registry.
-    "dist_dqn_tpu/serving/__main__.py": 2,
+    # metrics go through the registry. +1 at ISSUE 8: the shutdown
+    # serving_drained line (graceful-drain outcome contract).
+    "dist_dqn_tpu/serving/__main__.py": 3,
     # +1 at ISSUE 4: the one-per-run {"manifest": ...} provenance line
     # (telemetry/manifest.py) — run identity, not a metric stream.
     "dist_dqn_tpu/train.py": 11,

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Lint: every socket acquisition site in dist_dqn_tpu/ must bound its
+blocking behavior — set a timeout nearby or carry a rationale comment.
+
+ISSUE 8: the chaos harness's whole disconnect/partition fault class
+turns into a silent process wedge the moment one socket blocks forever
+(the round-1 tunnel incident was exactly an unbounded wait nobody knew
+existed). This lint makes the policy mechanical: wherever a socket is
+CREATED or ACCEPTED (``socket.socket(``, ``socket.create_connection(``,
+``.accept()``), one of the following must hold within
+``CONTEXT_LINES`` lines of the call:
+
+  * a ``settimeout(`` / ``timeout=`` appears (the socket is bounded), or
+  * a ``# socket:`` rationale comment explains why unbounded blocking
+    is safe here (e.g. a daemon thread whose close() path shuts the fd
+    down out from under it).
+
+Stdlib ``http.server``/``socketserver`` internals are out of scope —
+the lint covers this repo's own call sites. Run from the repo root:
+``python scripts/check_sockets.py``. Wired into tier-1 via
+tests/test_sockets_lint.py.
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+#: How far (in lines, both directions) evidence may sit from the call.
+CONTEXT_LINES = 6
+
+ACQUIRE = re.compile(
+    r"socket\.socket\(|socket\.create_connection\(|\.accept\(\)")
+EVIDENCE = re.compile(r"settimeout\(|timeout\s*=|#\s*socket:")
+
+
+def scan(repo_root: Path):
+    failures = []
+    pkg = repo_root / "dist_dqn_tpu"
+    for f in sorted(pkg.rglob("*.py")):
+        lines = f.read_text().splitlines()
+        for i, line in enumerate(lines):
+            if not ACQUIRE.search(line):
+                continue
+            lo = max(0, i - CONTEXT_LINES)
+            hi = min(len(lines), i + CONTEXT_LINES + 1)
+            window = "\n".join(lines[lo:hi])
+            if not EVIDENCE.search(window):
+                rel = f.relative_to(repo_root).as_posix()
+                failures.append(
+                    f"{rel}:{i + 1}: socket acquired without a nearby "
+                    f"timeout or '# socket:' rationale comment: "
+                    f"{line.strip()}")
+    return failures
+
+
+def main() -> int:
+    repo_root = Path(__file__).resolve().parent.parent
+    failures = scan(repo_root)
+    if failures:
+        print("check_sockets: FAIL", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        print("  Bound the socket (settimeout) or add a '# socket: "
+              "<why unbounded blocking is safe>' comment within "
+              f"{CONTEXT_LINES} lines.", file=sys.stderr)
+        return 1
+    print("check_sockets: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

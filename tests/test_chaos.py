@@ -1,0 +1,663 @@
+"""Deterministic chaos harness (ISSUE 8): seeded fault injection with
+named seams through the REAL code paths, and the survival invariants
+the hardening must hold.
+
+The load-bearing pins:
+
+* PLAN DETERMINISM — ``FaultPlan.generate(seed, seams)`` is a pure
+  function of its arguments, and an armed plan injects the same
+  (seam, fault, hit) sequence on every run of the same program: the
+  replayability contract every other chaos test stands on.
+* CORRUPT FRAMES NEVER DECODE (acceptance) — a bit flipped on the TCP
+  wire is dropped at the CRC gate and counted, the sender is NACKed
+  down the reply channel, and the connection recovers; a header flip
+  or a truncated frame desyncs the stream, which costs the CONNECTION
+  (reconnect + re-hello recovers), never the process.
+* RESUME IS BIT-IDENTICAL (acceptance) — a uniform-replay host-replay
+  run killed at chunk k by an injected crash and resumed from its
+  checkpoint produces the same params, bit for bit, as a run that was
+  never interrupted (and never checkpointed at all — the same pin
+  proves saves are read-only).
+* INJECTED failures exercise the SAME contracts as organic ones:
+  pipeline-worker exceptions tombstone and re-raise at the fence,
+  disk-full saves surface loudly, torn LATEST pointers fall back to
+  the orbax listing, serving dispatch failures are structured errors
+  with the next dispatch proving recovery.
+
+Everything here is seeded, CPU-only and fast — the tier-1 chaos smoke
+the ISSUE 8 CI satellite asks for. The process-level game day
+(kill -9, watchdog bundles, serving reload-under-load) lives in
+scripts/chaos_run.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.config import CONFIGS
+
+pytestmark = pytest.mark.chaos
+
+
+def _tiny_cfg():
+    cfg = CONFIGS["cartpole"]
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: seeded, validated, replayable
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_same_seed_same_plan(self):
+        seams = ["transport.send", "evac.drain", "checkpoint.save"]
+        a = chaos.FaultPlan.generate(7, seams, events_per_seam=2)
+        b = chaos.FaultPlan.generate(7, seams, events_per_seam=2)
+        assert a.to_json() == b.to_json()
+        assert len(a.events) == 6
+        # A different seed must actually move the schedule.
+        c = chaos.FaultPlan.generate(8, seams, events_per_seam=2)
+        assert a.to_json() != c.to_json()
+        # Round-trip: the manifest/env representation is lossless.
+        assert chaos.FaultPlan.from_json(a.to_json()) == a
+
+    def test_unknown_seam_and_fault_fail_at_build_time(self):
+        with pytest.raises(ValueError, match="unknown chaos seam"):
+            chaos.FaultEvent(seam="transport.teleport", fault="drop",
+                             at_hit=1)
+        with pytest.raises(ValueError, match="does not interpret"):
+            chaos.FaultEvent(seam="transport.send", fault="wedge",
+                             at_hit=1)
+        with pytest.raises(ValueError, match="exactly one"):
+            chaos.FaultEvent(seam="transport.send", fault="drop")
+
+    def test_for_seams_slices_per_process(self):
+        plan = chaos.FaultPlan.generate(
+            3, ["transport.send", "evac.drain"], events_per_seam=2)
+        sub = plan.for_seams(["evac.drain"])
+        assert sub.seed == plan.seed
+        assert {e.seam for e in sub.events} == {"evac.drain"}
+        assert len(sub.events) == 2
+
+    def test_generated_faults_are_interpretable(self):
+        """Every seam/fault pair generate() can emit is in the
+        registry, and parameterized faults carry their args."""
+        plan = chaos.FaultPlan.generate(11, sorted(chaos.SEAMS),
+                                        events_per_seam=3)
+        for ev in plan.events:
+            assert ev.fault in chaos.SEAMS[ev.seam]
+            if ev.fault == "bit_flip":
+                assert "bit" in ev.args
+            if ev.fault == "truncate":
+                assert 0.0 < ev.args["keep_frac"] < 1.0
+
+
+class TestInjector:
+    def test_fires_exactly_once_at_hit(self):
+        from dist_dqn_tpu.telemetry.registry import Registry
+
+        plan = chaos.FaultPlan(seed=1, events=(
+            chaos.FaultEvent("evac.drain", "exception", at_hit=3),))
+        with chaos.installed(plan, registry=Registry()) as inj:
+            fired = [chaos.fire("evac.drain") for _ in range(6)]
+        hits = [ev for ev in fired if ev is not None]
+        assert len(hits) == 1 and fired[2] is hits[0]
+        assert inj.injected == [{"seam": "evac.drain",
+                                 "fault": "exception", "hit": 3,
+                                 "t_s": inj.injected[0]["t_s"]}]
+        # Unarmed fire() is a no-op returning None.
+        assert chaos.fire("evac.drain") is None
+
+    def test_recovery_metric_closes_open_trip(self):
+        from dist_dqn_tpu.telemetry.registry import Registry
+
+        reg = Registry()
+        plan = chaos.FaultPlan(seed=1, events=(
+            chaos.FaultEvent("evac.drain", "stall", at_hit=1,
+                             args={"delay_s": 0.0}),))
+        with chaos.installed(plan, registry=reg) as inj:
+            chaos.fire("evac.drain")
+            assert inj.open_trips() == ["evac.drain"]
+            chaos.mark_recovered("evac.drain")
+            assert inj.open_trips() == []
+            # Recovery without an open trip is a no-op, so call sites
+            # mark unconditionally.
+            assert inj.mark_recovered("evac.drain") is None
+        fams = reg.collect()
+        assert fams["dqn_chaos_injected_total"][0].value == 1
+        assert fams["dqn_recovery_seconds"][0].count == 1
+
+    def test_env_arming_and_manifest_provenance(self, monkeypatch):
+        from dist_dqn_tpu.telemetry import manifest as manifest_mod
+
+        plan = chaos.FaultPlan.generate(5, ["actor.step"])
+        monkeypatch.setenv(chaos.CHAOS_PLAN_ENV, plan.to_json())
+        try:
+            inj = chaos.maybe_install_from_env()
+            assert inj is not None and inj.plan == plan
+            # Arming annotates the run manifest: the forensics bundle /
+            # BENCH provenance of any chaos run names its schedule.
+            man = manifest_mod.get_run_manifest()
+            assert man is not None
+            assert chaos.FaultPlan.from_dict(man["chaos_plan"]) == plan
+        finally:
+            chaos.uninstall()
+
+    def test_malformed_env_plan_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv(chaos.CHAOS_PLAN_ENV, '{"seed": 1, "events": '
+                           '[{"seam": "nope", "fault": "x", "at_hit": 1}]}')
+        with pytest.raises(ValueError, match="unknown chaos seam"):
+            chaos.maybe_install_from_env()
+        assert chaos.get_injector() is None
+
+
+# ---------------------------------------------------------------------------
+# Transport: a flipped bit never reaches the array codec (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestTransportChaos:
+    def _push_and_collect(self, server, client, payloads, want,
+                          timeout_s=20.0):
+        """Push ``payloads`` then pop until ``want`` records arrived."""
+        for p in payloads:
+            client.push(p)
+        got = []
+        deadline = time.monotonic() + timeout_s
+        while len(got) < want and time.monotonic() < deadline:
+            rec = server.pop()
+            if rec is None:
+                time.sleep(0.002)
+                continue
+            got.append(rec[1])
+        return got
+
+    def test_payload_bit_flip_dropped_counted_nacked(self):
+        """THE corrupt-frame pin: a bit flipped in a frame's payload on
+        the wire is dropped at the server's CRC gate (never unpickled /
+        decoded), counted under {reason="crc"}, and the sender is
+        NACKed so its lock-step lane reconnects immediately — while the
+        CONNECTION survives and later frames flow."""
+        from dist_dqn_tpu.actors.transport import (
+            CORRUPT_FRAME_NACK_KIND, TcpRecordClient, TcpRecordServer,
+            decode_arrays, encode_arrays)
+
+        # bit 200 sits past the 12-byte frame header: payload damage,
+        # trustworthy boundary — the single-frame-drop path.
+        plan = chaos.FaultPlan(seed=2, events=(
+            chaos.FaultEvent("transport.send", "bit_flip", at_hit=2,
+                             args={"bit": 200}),))
+        server = TcpRecordServer(host="127.0.0.1")
+        client = None
+        try:
+            with chaos.installed(plan) as inj:
+                client = TcpRecordClient(server.address)
+                frames = [encode_arrays({"x": np.full((64,), i, np.int64)},
+                                        {"i": i}) for i in range(4)]
+                got = self._push_and_collect(server, client, frames,
+                                             want=3)
+            # Frame 1 (0-based) was corrupted: exactly the other three
+            # decode, in order, bit-exact.
+            assert [decode_arrays(p)[1]["i"] for p in got] == [0, 2, 3]
+            assert server.corrupt_frames == 1
+            assert [e["fault"] for e in inj.injected] == ["bit_flip"]
+            # The NACK reached the sender's reply channel.
+            reply = client.read_reply(keep_waiting=lambda: True)
+            _, meta = decode_arrays(reply)
+            assert meta["kind"] == CORRUPT_FRAME_NACK_KIND
+            # The server proved recovery (valid frames after the drop).
+            assert "transport.recv" not in inj.open_trips()
+        finally:
+            if client is not None:
+                client.close()
+            server.close()
+
+    def test_header_flip_desyncs_connection_reconnect_recovers(self):
+        """A flip inside the frame HEADER leaves no trustworthy
+        boundary: the server drops the connection (bad_magic), and a
+        reconnect — the remote actor's organic response to a dead
+        reply stream — fully recovers the lane."""
+        from dist_dqn_tpu.actors.transport import (TcpRecordClient,
+                                                   TcpRecordServer,
+                                                   decode_arrays,
+                                                   encode_arrays)
+
+        plan = chaos.FaultPlan(seed=3, events=(
+            chaos.FaultEvent("transport.send", "bit_flip", at_hit=1,
+                             args={"bit": 5}),))    # inside magic
+        server = TcpRecordServer(host="127.0.0.1")
+        c1 = c2 = None
+        try:
+            with chaos.installed(plan):
+                c1 = TcpRecordClient(server.address)
+                c1.push(encode_arrays({"x": np.zeros(3)}, {"i": 0}))
+                deadline = time.monotonic() + 20.0
+                while (server.corrupt_frames < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.002)
+                assert server.corrupt_frames == 1
+                assert server.pop() is None
+                # Reconnect: the recovered lane carries frames again.
+                c2 = TcpRecordClient(server.address)
+                got = self._push_and_collect(
+                    server, c2,
+                    [encode_arrays({"x": np.arange(3)}, {"i": 1})], 1)
+            assert decode_arrays(got[0])[1]["i"] == 1
+        finally:
+            for c in (c1, c2):
+                if c is not None:
+                    c.close()
+            server.close()
+
+    def test_truncated_frame_counted_and_stream_recovers(self):
+        """A half-written frame (sender died mid-send) is counted as
+        truncated; push() reports the failure so the caller reconnects."""
+        from dist_dqn_tpu.actors.transport import (TcpRecordClient,
+                                                   TcpRecordServer,
+                                                   decode_arrays,
+                                                   encode_arrays)
+
+        plan = chaos.FaultPlan(seed=4, events=(
+            chaos.FaultEvent("transport.send", "truncate", at_hit=2,
+                             args={"keep_frac": 0.5}),))
+        server = TcpRecordServer(host="127.0.0.1")
+        c1 = c2 = None
+        try:
+            with chaos.installed(plan):
+                c1 = TcpRecordClient(server.address)
+                payload = encode_arrays({"x": np.zeros((256,))}, {"i": 0})
+                assert c1.push(payload)
+                assert not c1.push(payload)   # truncated + closed
+                deadline = time.monotonic() + 20.0
+                while (server.corrupt_frames < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.002)
+                assert server.corrupt_frames == 1
+                c2 = TcpRecordClient(server.address)
+                got = self._push_and_collect(
+                    server, c2,
+                    [encode_arrays({"x": np.arange(4)}, {"i": 7})], 2)
+            # The good frame before the kill plus the reconnect's frame
+            # both decode; the torn one never reached the codec.
+            assert sorted(decode_arrays(p)[1]["i"] for p in got) == [0, 7]
+        finally:
+            for c in (c1, c2):
+                if c is not None:
+                    c.close()
+            server.close()
+
+    def test_recv_disconnect_drops_connection_only(self):
+        """Server-side injected disconnect (the partition fault): the
+        connection dies, the process and listener survive, and a fresh
+        connection serves immediately."""
+        from dist_dqn_tpu.actors.transport import (TcpRecordClient,
+                                                   TcpRecordServer,
+                                                   decode_arrays,
+                                                   encode_arrays)
+
+        plan = chaos.FaultPlan(seed=5, events=(
+            chaos.FaultEvent("transport.recv", "disconnect", at_hit=2),))
+        server = TcpRecordServer(host="127.0.0.1")
+        c1 = c2 = None
+        try:
+            with chaos.installed(plan):
+                c1 = TcpRecordClient(server.address)
+                got = self._push_and_collect(
+                    server, c1,
+                    [encode_arrays({"x": np.zeros(2)}, {"i": 0})], 1)
+                c1.push(encode_arrays({"x": np.zeros(2)}, {"i": 1}))
+                # The dropped connection surfaces as a dead reply stream.
+                assert c1.read_reply(keep_waiting=lambda: True) is None
+                c2 = TcpRecordClient(server.address)
+                got += self._push_and_collect(
+                    server, c2,
+                    [encode_arrays({"x": np.zeros(2)}, {"i": 2})], 1)
+            assert [decode_arrays(p)[1]["i"] for p in got] == [0, 2]
+        finally:
+            for c in (c1, c2):
+                if c is not None:
+                    c.close()
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# Pipeline workers: injected failures ride the organic contracts
+# ---------------------------------------------------------------------------
+
+class TestPipelineWorkerChaos:
+    def test_evac_injected_exception_tombstones_like_organic(self):
+        from dist_dqn_tpu.replay.staging import (EvacuationWorker,
+                                                 StreamedEvacuator)
+        import jax.numpy as jnp
+
+        plan = chaos.FaultPlan(seed=6, events=(
+            chaos.FaultEvent("evac.drain", "exception", at_hit=1),))
+        ev = StreamedEvacuator(num_slices=2, name="chaos_evac")
+        w = EvacuationWorker(ev, lambda tree, lo, hi: None,
+                             name="chaos_evac")
+        try:
+            with chaos.installed(plan):
+                h = w.submit({"x": jnp.ones((4, 2, 3), jnp.float32)})
+                with pytest.raises(chaos.ChaosInjectedError,
+                                   match="evac.drain"):
+                    h.wait(timeout=30)
+                # Tombstone: the worker is dead, later submits refuse.
+                with pytest.raises(RuntimeError, match="worker died"):
+                    w.submit({"x": jnp.ones((4, 2, 3), jnp.float32)})
+        finally:
+            w.close()
+        assert not w._thread.is_alive()
+
+    def test_prefetch_injected_exception_reraises_at_pop(self):
+        from dist_dqn_tpu.replay.staging import SamplePrefetcher
+
+        plan = chaos.FaultPlan(seed=6, events=(
+            chaos.FaultEvent("prefetch.sample", "exception", at_hit=1),))
+        p = SamplePrefetcher(
+            lambda k: ({"x": np.zeros((4, 2), np.float32)}, None),
+            depth=2, wait_generation=lambda g, timeout=None: True,
+            name="chaos_prefetch")
+        try:
+            with chaos.installed(plan):
+                p.request(1, 0)
+                with pytest.raises(chaos.ChaosInjectedError,
+                                   match="prefetch.sample"):
+                    p.pop(0)
+        finally:
+            p.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint: disk-full surfaces; torn/missing LATEST falls back
+# ---------------------------------------------------------------------------
+
+class TestCheckpointChaos:
+    def _state(self):
+        return {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": np.full((1,), 1.5, np.float32)}
+
+    def test_disk_full_save_surfaces_loudly(self, tmp_path):
+        from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+        plan = chaos.FaultPlan(seed=7, events=(
+            chaos.FaultEvent("checkpoint.save", "fail", at_hit=1),))
+        ckpt = TrainCheckpointer(str(tmp_path), save_every_frames=1)
+        try:
+            with chaos.installed(plan):
+                with pytest.raises(OSError, match="disk-full"):
+                    ckpt.save(100, self._state())
+            # The failed save left nothing behind to resume from.
+            assert ckpt.latest_step() is None
+            # The NEXT save recovers the checkpointer.
+            ckpt.save(200, self._state())
+            ckpt.wait()
+            assert ckpt.latest_step() == 200
+        finally:
+            ckpt.close()
+
+    def test_torn_latest_pointer_falls_back_to_listing(self, tmp_path):
+        from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                                   read_latest_pointer)
+
+        plan = chaos.FaultPlan(seed=7, events=(
+            chaos.FaultEvent("latest.write", "torn", at_hit=2),))
+        ckpt = TrainCheckpointer(str(tmp_path), save_every_frames=1)
+        try:
+            with chaos.installed(plan) as inj:
+                ckpt.save(100, self._state())
+                ckpt.wait()
+                assert read_latest_pointer(str(tmp_path))["step"] == 100
+                ckpt.save(200, self._state())   # stamp is torn
+                ckpt.wait()
+                # The torn stamp is rejected, not trusted...
+                assert read_latest_pointer(str(tmp_path)) is None
+                # ...and the listing fallback still finds the newest
+                # COMMITTED step: readers never regress, never crash.
+                assert ckpt.latest_step() == 200
+                step, tree = ckpt.restore_latest(self._state())
+                assert step == 200
+                # The next save re-stamps: recovery proven.
+                ckpt.save(300, self._state())
+                ckpt.wait()
+                assert read_latest_pointer(str(tmp_path))["step"] == 300
+                assert "latest.write" not in inj.open_trips()
+        finally:
+            ckpt.close()
+
+    def test_crash_between_commit_and_stamp(self, tmp_path):
+        """The crash window the listing fallback exists for: the orbax
+        step commits but LATEST never lands — resume still finds it."""
+        from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                                   read_latest_pointer)
+
+        plan = chaos.FaultPlan(seed=7, events=(
+            chaos.FaultEvent("checkpoint.save", "crash_before_stamp",
+                             at_hit=1),))
+        ckpt = TrainCheckpointer(str(tmp_path), save_every_frames=1)
+        try:
+            with chaos.installed(plan):
+                ckpt.save(100, self._state())
+                ckpt.wait()
+            assert read_latest_pointer(str(tmp_path)) is None
+            assert ckpt.latest_step() == 100
+        finally:
+            ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Host-replay kill + resume: bit-identical to uninterrupted (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestResumeBitIdentical:
+    def test_killed_at_chunk_k_resumes_bit_identical(self, tmp_path):
+        """THE resume pin: run B is killed by an injected crash at its
+        4th chunk (right after that chunk's checkpoint) and resumed;
+        its final params must equal — bit for bit — run A, which was
+        never interrupted AND never checkpointed. One pin, two claims:
+        checkpoint saves are read-only, and resume reconstructs every
+        loop cursor (ring window, RNG stream index, train debt,
+        episode stats, pending chunk) exactly."""
+        from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+        cfg = _tiny_cfg()
+        kw = dict(total_env_steps=3200, chunk_iters=50)
+        out_a = run_host_replay(cfg, **kw, log_fn=lambda s: None)
+
+        ckpt_dir = str(tmp_path / "host_ckpt")
+        plan = chaos.FaultPlan(seed=9, events=(
+            chaos.FaultEvent("host_replay.chunk", "crash", at_hit=4),))
+        with chaos.installed(plan) as inj:
+            with pytest.raises(chaos.ChaosInjectedError,
+                               match="host_replay.chunk"):
+                run_host_replay(cfg, **kw, log_fn=lambda s: None,
+                                checkpoint_dir=ckpt_dir,
+                                save_every_frames=400)
+            assert [e["hit"] for e in inj.injected] == [4]
+
+        logs = []
+        out_b = run_host_replay(cfg, **kw, checkpoint_dir=ckpt_dir,
+                                save_every_frames=400,
+                                log_fn=lambda s: logs.append(s))
+        resumed = [json.loads(s) for s in logs
+                   if "resumed_at_frames" in s]
+        assert resumed and resumed[0]["resumed_at_frames"] == 1600
+        assert out_b["param_checksum"] == out_a["param_checksum"]
+        assert out_b["grad_steps"] == out_a["grad_steps"]
+        # The resumed run's per-chunk losses match the uninterrupted
+        # run's tail — the whole trajectory, not just the endpoint.
+        losses_a = [r["loss"] for r in out_a["history"] if "loss" in r]
+        losses_b = [r["loss"] for r in out_b["history"] if "loss" in r]
+        assert losses_b == losses_a[len(losses_a) - len(losses_b):]
+
+    def test_per_checkpoint_combination_refused(self, tmp_path):
+        """PER resume cannot be honest about priorities yet — the
+        combination must refuse loudly up front, not drift silently."""
+        from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+        cfg = _tiny_cfg()
+        cfg = dataclasses.replace(
+            cfg, replay=dataclasses.replace(cfg.replay, prioritized=True))
+        with pytest.raises(ValueError, match="prioritized"):
+            run_host_replay(cfg, total_env_steps=800, chunk_iters=50,
+                            log_fn=lambda s: None,
+                            checkpoint_dir=str(tmp_path / "d"))
+
+
+def test_emergency_hooks_bounded_and_snapshot_restorable(tmp_path):
+    """ISSUE 8 hardening: a watchdog abort runs emergency-checkpoint
+    hooks on a bounded side thread — a hook that saves lands a
+    restorable side snapshot, a hook that WEDGES is abandoned at the
+    timeout instead of blocking the abort, and both outcomes are
+    logged honestly."""
+    import time as _time
+
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
+    from dist_dqn_tpu.utils.checkpoint import restore_pytree, save_pytree
+
+    state = {"w": np.arange(4, dtype=np.float32)}
+    path = str(tmp_path / "emergency_learner")
+    tm_watchdog.register_emergency_hook(
+        "test.save", lambda: save_pytree(path, {"learner": state}))
+    tm_watchdog.register_emergency_hook(
+        "test.wedge", lambda: _time.sleep(60))
+    logs = []
+    try:
+        t0 = time.monotonic()
+        tm_watchdog.run_emergency_hooks(timeout_s=1.5,
+                                        log_fn=logs.append)
+        assert time.monotonic() - t0 < 30   # bounded, not 60s
+    finally:
+        tm_watchdog.unregister_emergency_hook("test.save")
+        tm_watchdog.unregister_emergency_hook("test.wedge")
+    restored = restore_pytree(path, {"learner": state})
+    np.testing.assert_array_equal(restored["learner"]["w"], state["w"])
+    outcome = {p["emergency_hook"]: p["completed"]
+               for p in (json.loads(s) for s in logs)}
+    assert outcome == {"test.save": True, "test.wedge": False}
+
+
+# ---------------------------------------------------------------------------
+# Serving dispatch chaos + the seeded whole-loop smoke
+# ---------------------------------------------------------------------------
+
+def test_serving_dispatch_injected_failure_is_structured(tmp_path):
+    """An injected dispatch exception reaches each rider as a
+    structured error (the server maps it to a 500, never a connection
+    reset), and the NEXT dispatch completes — recovery proven."""
+    import jax
+    import jax.numpy as jnp
+
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.serving import build_server
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    cfg = CONFIGS["cartpole"]
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init, _ = make_learner(net, cfg.learner)
+    state = init(jax.random.PRNGKey(0),
+                 jnp.zeros(env.observation_shape, env.observation_dtype))
+    ckpt = TrainCheckpointer(str(tmp_path), save_every_frames=1)
+    ckpt.save(10, state)
+    ckpt.wait()
+    ckpt.close()
+
+    plan = chaos.FaultPlan(seed=12, events=(
+        chaos.FaultEvent("serving.dispatch", "exception", at_hit=2),))
+    srv = build_server(cfg, {"default": str(tmp_path)}, max_rows=8,
+                       max_wait_ms=1.0, queue_limit=16,
+                       poll_interval_s=3600.0, log_fn=lambda *_: None)
+    try:
+        obs = np.zeros((2, 4), np.float32)
+        with chaos.installed(plan) as inj:
+            first = srv.batcher.submit(obs, greedy=True)
+            assert first.actions.shape == (2,)
+            with pytest.raises(chaos.ChaosInjectedError,
+                               match="serving.dispatch"):
+                srv.batcher.submit(obs, greedy=True)
+            again = srv.batcher.submit(obs, greedy=True)
+            assert again.actions.shape == (2,)
+            assert inj.open_trips() == []   # recovery observed
+    finally:
+        srv.close()
+
+
+def test_seeded_chaos_smoke_replays_identically(tmp_path):
+    """The tier-1 chaos smoke (ISSUE 8 CI satellite): one seeded plan
+    covering four seams — pipeline-worker stalls on both background
+    threads, a commit-without-stamp checkpoint crash and a torn LATEST
+    pointer — driven through two identical real host-replay runs.
+    Invariants: both runs complete training to target, inject the SAME
+    (seam, fault, hit) sequence (replayability), count every injection
+    in the registry, and end with every trip recovered."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.telemetry.registry import Registry
+
+    cfg = _tiny_cfg()
+    plan = chaos.FaultPlan(seed=8, events=(
+        chaos.FaultEvent("evac.drain", "stall", at_hit=2,
+                         args={"delay_s": 0.05}),
+        chaos.FaultEvent("prefetch.sample", "stall", at_hit=3,
+                         args={"delay_s": 0.05}),
+        chaos.FaultEvent("checkpoint.save", "crash_before_stamp",
+                         at_hit=1),
+        chaos.FaultEvent("latest.write", "torn", at_hit=2),
+    ))
+
+    def one_run(tag):
+        reg = Registry()
+        with chaos.installed(plan, registry=reg) as inj:
+            out = run_host_replay(
+                cfg, total_env_steps=3200, chunk_iters=50,
+                log_fn=lambda s: None,
+                checkpoint_dir=str(tmp_path / tag),
+                save_every_frames=800)
+            # Injection evidence, ordered per seam (the cross-seam
+            # interleaving is thread-timing; the per-seam dataflow
+            # positions are the deterministic claim).
+            injected = sorted((e["seam"], e["fault"], e["hit"])
+                              for e in inj.injected)
+            open_trips = inj.open_trips()
+        counted = sorted(
+            (c.labels["seam"], c.labels["fault"], int(c.value))
+            for c in reg.collect().get("dqn_chaos_injected_total", []))
+        return out, injected, open_trips, counted
+
+    out1, injected1, open1, counted1 = one_run("a")
+    out2, injected2, open2, counted2 = one_run("b")
+
+    # Survival: training completed to target under fire, both times.
+    assert out1["env_steps"] >= 3200 and out2["env_steps"] >= 3200
+    assert out1["grad_steps"] == out2["grad_steps"] > 0
+    # Stalls never change WHAT is computed, only when.
+    assert out1["param_checksum"] == out2["param_checksum"]
+    # Replayability: same plan, same injection sequence.
+    assert injected1 == injected2 == sorted([
+        ("checkpoint.save", "crash_before_stamp", 1),
+        ("evac.drain", "stall", 2),
+        ("latest.write", "torn", 2),
+        ("prefetch.sample", "stall", 3),
+    ])
+    # Every injection recovered and was counted, per {seam, fault}.
+    assert open1 == open2 == []
+    assert counted1 == counted2 == sorted([
+        ("checkpoint.save", "crash_before_stamp", 1),
+        ("evac.drain", "stall", 1),
+        ("latest.write", "torn", 1),
+        ("prefetch.sample", "stall", 1),
+    ])
