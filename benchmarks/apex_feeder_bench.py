@@ -37,6 +37,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -130,6 +131,189 @@ def _emit(row: dict) -> None:
     print(json.dumps(row), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Transport A/B (ISSUE 9): legacy JSON codec vs zero-copy wire vs shm ring
+# ---------------------------------------------------------------------------
+
+#: (obs_shape, obs_dtype, default A/B record count) per variant. Pixel
+#: records are ~450 KB raw (84x84x4 uint8, obs + next_obs), vector ~600 B.
+_AB_SPECS = {
+    "pixel": ((84, 84, 4), "uint8", 300),
+    "vector": ((4,), "float32", 4000),
+}
+
+
+def _transport_ab(variant: str, records: int, lanes: int):
+    """Measure the EXPERIENCE PATH in isolation — encode -> transport ->
+    decode, no learner — for three arms:
+
+      * ``legacy``   — today's remote-actor path exactly: JSON-header
+        codec (compress="auto": pixel records ride zlib-1) over the
+        CRC-framed TCP loopback;
+      * ``zerocopy`` — the same TCP framing, zero-copy payloads
+        (schema-negotiated raw bytes + q planes);
+      * ``shm``      — zero-copy records through the seqlock slot ring
+        (the same-host path; no socket stack at all).
+
+    Producer encodes live in a thread (what an actor does every step),
+    the consumer decodes every record; both share this box's core, so
+    rates reflect the full per-record CPU the codec costs each side.
+    Returns one row dict per arm: trajectories/sec (1 record = one
+    vector-env step batch), bytes on the wire, and the consumer's
+    decode CPU-seconds.
+    """
+    import threading
+
+    import numpy as np
+
+    from dist_dqn_tpu import ingest
+    from dist_dqn_tpu.actors.transport import (_FRAME_HDR,
+                                               TcpRecordClient,
+                                               TcpRecordServer,
+                                               decode_arrays,
+                                               encode_arrays)
+
+    obs_shape, obs_dtype, _ = _AB_SPECS[variant]
+    obs_dtype = np.dtype(obs_dtype)
+    rng = np.random.default_rng(0)
+    # Raw-array twin of actors/feeder.py _build_pool (which returns
+    # per-transport ENCODED payloads; the A/B needs the raw arrays to
+    # encode per arm). A step-record FIELD change must land in both —
+    # the schema-driven encoder below fails loudly if they drift.
+    pool_n = 16
+
+    def obs_batch():
+        if obs_dtype == np.uint8:
+            return rng.integers(0, 256, (lanes,) + obs_shape
+                                ).astype(np.uint8)
+        return rng.normal(size=(lanes,) + obs_shape).astype(obs_dtype)
+
+    pool = []
+    for _ in range(pool_n):
+        pool.append((
+            {"obs": obs_batch(),
+             "reward": rng.normal(size=(lanes,)).astype(np.float32),
+             "terminated": np.zeros((lanes,), np.uint8),
+             "truncated": np.zeros((lanes,), np.uint8),
+             "next_obs": obs_batch()},
+            rng.normal(size=(lanes,)).astype(np.float32),
+            rng.normal(size=(lanes,)).astype(np.float32)))
+    schema = ingest.step_schema(obs_shape, obs_dtype, lanes)
+    enc = ingest.StepEncoder(schema)
+    dec = ingest.StepDecoder(schema)
+
+    def encode_legacy(i):
+        arrays, _, _ = pool[i % pool_n]
+        return encode_arrays(arrays, {"kind": "step", "actor": 0,
+                                      "t": i + 1}, compress="auto")
+
+    def encode_zc(i):
+        arrays, q_sel, q_max = pool[i % pool_n]
+        return enc.encode_step(arrays, actor=0, t=i + 1,
+                               q_sel=q_sel, q_max=q_max)
+
+    decode_cpu = [0.0]
+
+    def decode_legacy(payload):
+        t0 = time.perf_counter()
+        decode_arrays(payload)
+        decode_cpu[0] += time.perf_counter() - t0
+
+    def decode_zc(payload):
+        t0 = time.perf_counter()
+        dec.decode(payload)
+        decode_cpu[0] += time.perf_counter() - t0
+
+    def tcp_arm(encode_one, decode_one):
+        server = TcpRecordServer()
+        client = TcpRecordClient(server.address)
+        sent = [0]
+
+        def produce():
+            for i in range(records):
+                payload = encode_one(i)
+                sent[0] += len(payload) + _FRAME_HDR.size
+                client.push(payload)
+
+        th = threading.Thread(target=produce, daemon=True,
+                              name="ab-producer")
+        decode_cpu[0] = 0.0
+        t0 = time.perf_counter()
+        th.start()
+        got = 0
+        while got < records:
+            rec = server.pop()
+            if rec is None:
+                # Real sleep, not sched_yield: every empty poll takes
+                # the server's backlog lock, and a yield-spin contends
+                # it against the serve thread (measured slower on both
+                # codecs than the 200us poll).
+                time.sleep(0.0002)
+                continue
+            decode_one(rec[1])
+            got += 1
+        wall = time.perf_counter() - t0
+        th.join(timeout=10)
+        client.close()
+        server.close()
+        return wall, sent[0], decode_cpu[0]
+
+    def shm_arm():
+        ring = ingest.ShmSlotRing(
+            f"ab_{os.getpid()}_{variant}",
+            slot_size=ingest.max_record_bytes(schema), nslots=64,
+            create=True)
+        att = ingest.ShmSlotRing(f"ab_{os.getpid()}_{variant}")
+        sent = [0]
+        try:
+            def produce():
+                for i in range(records):
+                    payload = encode_zc(i)
+                    sent[0] += len(payload)
+                    att.push_wait(payload)
+
+            th = threading.Thread(target=produce, daemon=True,
+                                  name="ab-producer")
+            decode_cpu[0] = 0.0
+            t0 = time.perf_counter()
+            th.start()
+            got = 0
+            while got < records:
+                payload = ring.pop()
+                if payload is None:
+                    # Yield, don't spin: a GIL-holding empty-poll loop
+                    # starves the single producer thread (measured 7x
+                    # on pixel records — 5 ms GIL switch interval).
+                    time.sleep(0)
+                    continue
+                decode_zc(payload)
+                got += 1
+            wall = time.perf_counter() - t0
+            th.join(timeout=10)
+            return wall, sent[0], decode_cpu[0]
+        finally:
+            att.close()
+            ring.close()
+            ring.unlink()
+
+    rows = []
+    for arm, run in (("legacy", lambda: tcp_arm(encode_legacy,
+                                                decode_legacy)),
+                     ("zerocopy", lambda: tcp_arm(encode_zc, decode_zc)),
+                     ("shm", shm_arm)):
+        wall, sent, cpu = run()
+        rows.append({
+            "bench": "apex_feeder", "phase": "ab", "variant": variant,
+            "arm": arm, "transport": arm, "records": records,
+            "lanes_per_record": lanes,
+            "trajectories_per_sec": round(records / max(wall, 1e-9), 1),
+            "bytes_on_wire": int(sent),
+            "bytes_per_record": round(sent / records, 1),
+            "decode_cpu_s": round(cpu, 4),
+            "wall_s": round(wall, 3)})
+    return rows
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--allow-cpu", action="store_true",
@@ -137,6 +321,21 @@ def main() -> int:
                         "BASELINE numbers)")
     p.add_argument("--variants", nargs="*", default=["vector", "pixel"])
     p.add_argument("--measure-seconds", type=float, default=120.0)
+    p.add_argument("--transport", choices=("zerocopy", "legacy"),
+                   default="zerocopy",
+                   help="experience path for the service phases "
+                        "(ISSUE 9); the --ab arms measure both "
+                        "regardless")
+    p.add_argument("--ab", action="store_true",
+                   help="transport-isolated A/B (ISSUE 9): encode -> "
+                        "wire -> decode for the legacy JSON codec, the "
+                        "zero-copy TCP framing and the shm slot ring — "
+                        "one BENCH row per arm with trajectories/sec, "
+                        "bytes-on-wire and decode CPU-seconds. Runs "
+                        "before the service phases; jax-free")
+    p.add_argument("--ab-records", type=int, default=0,
+                   help="records per A/B arm (0 = per-variant default; "
+                        "the smoke test passes a small count)")
     p.add_argument("--trace", default=None,
                    help="path PREFIX for the measure phase's host-span "
                         "Chrome trace (utils/trace.py): writes "
@@ -164,6 +363,31 @@ def main() -> int:
     for variant in args.variants:
         cfg, rt_kwargs, probe_total = _configs(variant, args.allow_cpu)
         lanes = rt_kwargs["envs_per_actor"]
+        rt_kwargs["transport"] = args.transport
+
+        if args.ab:
+            # Transport-isolated A/B first: no learner, no jax in the
+            # loop — the feeder-ceiling number for each codec/transport.
+            default_records = _AB_SPECS[variant][2]
+            n = args.ab_records or (default_records // 10
+                                    if args.allow_cpu else default_records)
+            ab_rows = _transport_ab(variant, n, lanes)
+            for row in ab_rows:
+                _emit(row)
+            by_arm = {r["arm"]: r for r in ab_rows}
+            _emit({"bench": "apex_feeder", "variant": variant,
+                   "phase": "ab_summary",
+                   "zerocopy_speedup_vs_legacy": round(
+                       by_arm["zerocopy"]["trajectories_per_sec"]
+                       / max(by_arm["legacy"]["trajectories_per_sec"],
+                             1e-9), 3),
+                   "shm_speedup_vs_legacy": round(
+                       by_arm["shm"]["trajectories_per_sec"]
+                       / max(by_arm["legacy"]["trajectories_per_sec"],
+                             1e-9), 3),
+                   "zerocopy_wire_bytes_vs_legacy": round(
+                       by_arm["zerocopy"]["bytes_on_wire"]
+                       / max(by_arm["legacy"]["bytes_on_wire"], 1), 3)})
 
         # Phase 1 — fixed small probe: pays every compile, measures the
         # saturated ingest rate on this host.
@@ -173,6 +397,11 @@ def main() -> int:
         _emit({"bench": "apex_feeder", "variant": variant,
                "phase": "probe", "wall_s": round(wall, 1),
                "avg_env_steps_per_sec": round(probe_rate, 1),
+               # Transport identity + wire cost ride every BENCH row
+               # (ISSUE 9 satellite): rows across PRs are comparable
+               # only when they name the experience path they measured.
+               "transport": summary["transport"],
+               "bytes_on_wire": summary["bytes_on_wire"],
                **_roundtrip_fields(summary),
                **{k: summary[k] for k in
                   ("env_steps", "grad_steps", "ring_dropped",
@@ -199,6 +428,12 @@ def main() -> int:
         row = {
             "bench": "apex_feeder", "variant": variant, "phase": "measure",
             "platforms": platforms,
+            # ISSUE 9 satellite (bugfix): the row must identify which
+            # transport carried it and what it cost on the wire, or the
+            # A/B trajectory across PRs is not comparable.
+            "transport": summary["transport"],
+            "bytes_on_wire": summary["bytes_on_wire"],
+            "ingest_bytes": summary["ingest_bytes"],
             "host_env": rt_kwargs["host_env"],
             "feeders": rt_kwargs["num_actors"],
             "lanes_per_record": lanes,
@@ -234,7 +469,11 @@ def main() -> int:
                 cfg, rt_kwargs, probe_total,
                 trace_path=(f"{args.trace}.{variant}.split.json"),
                 fused_ingest=False, prio_writeback_batch=1,
-                stage_depth=0)
+                stage_depth=0,
+                # The split reference must actually dispatch bootstraps:
+                # with actor-shipped priorities (ISSUE 9) there is
+                # nothing to split, so the reference disables them.
+                actor_priorities=False)
             # Compare at the SAME run size: the fused PROBE (phase 1,
             # also probe_total) vs the split reference — identical work,
             # so the per-pass ratio isolates the dispatch fusion.

@@ -80,10 +80,70 @@ def _obs_batch(cfg, rows: int) -> np.ndarray:
             env.observation_dtype)
 
 
+def _proc_load(address: str, obs: np.ndarray, clients: int,
+               warmup_s: float, duration_s: float, out_q) -> None:
+    """One load-generation PROCESS (ISSUE 9 satellite): the in-process
+    client threads are GIL-bound at 1-row requests — N real processes
+    each run their own thread pool against the server and report
+    (latencies_ms, fanin_inv, rows_served, shed, errors) through
+    ``out_q``. Jax-free: only the ServingClient wire codec is needed.
+    Module-level for the multiprocessing 'spawn' pickle contract."""
+    import threading
+
+    from dist_dqn_tpu.serving import QueueFullError, ServingClient
+
+    lock = threading.Lock()
+    latencies, fanin_inv, shed = [], [], [0]
+    rows_served = [0]
+    errors = []
+    start = time.perf_counter()
+    t_measure = start + warmup_s
+    t_stop = t_measure + duration_s
+
+    def worker():
+        cl = None
+        try:
+            cl = ServingClient(address)
+            while True:
+                now = time.perf_counter()
+                if now >= t_stop:
+                    return
+                t0 = now
+                try:
+                    r = cl.act(obs, greedy=True)
+                except QueueFullError as e:
+                    if time.perf_counter() >= t_measure:
+                        with lock:
+                            shed[0] += 1
+                    time.sleep(min(e.retry_after_s, 0.1))
+                    continue
+                t1 = time.perf_counter()
+                if t1 < t_measure:
+                    continue
+                with lock:
+                    latencies.append((t1 - t0) * 1e3)
+                    fanin_inv.append(1.0 / r.fanin_requests)
+                    rows_served[0] += obs.shape[0]
+        except Exception as e:  # noqa: BLE001 — reported to the parent
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            if cl is not None:
+                cl.close()
+
+    threads = [threading.Thread(target=worker, name=f"loadgen-{i}",
+                                daemon=True) for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out_q.put((latencies, fanin_inv, rows_served[0], shed[0], errors))
+
+
 def run_arm(cfg, checkpoint_dir: str, *, batching: bool, clients: int,
             duration_s: float, warmup_s: float, rows_per_request: int,
             max_rows: int, max_wait_ms: float, queue_limit: int,
-            transport: str = "http") -> dict:
+            transport: str = "http", procs: int = 1) -> dict:
     """One closed-loop measurement; returns its BENCH row dict.
 
     ``transport="http"`` drives the full stack — sockets, codec,
@@ -110,6 +170,59 @@ def run_arm(cfg, checkpoint_dir: str, *, batching: bool, clients: int,
     rows_served = [0]
     client_errors = []
 
+    if procs > 1:
+        # Process-separated load generation (ISSUE 9 satellite /
+        # ROADMAP item 3 follow-up): at 1-row requests the in-process
+        # client threads serialize on THIS interpreter's GIL and the
+        # bench measures the load generator, not the server. Real
+        # client processes each own a GIL; per-arm rows merge below.
+        if transport != "http":
+            server.close()
+            raise ValueError("--procs drives the real HTTP surface; "
+                             "combine it with --transport http")
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        out_q = ctx.Queue()
+        # Distribute the EXACT requested client count (remainder across
+        # the first processes) — rounding it would change the offered
+        # load and make rows across --procs values incomparable.
+        procs = min(procs, max(clients, 1))
+        base, extra = divmod(max(clients, 1), procs)
+        per_proc = [base + (1 if i < extra else 0) for i in range(procs)]
+        workers = [
+            ctx.Process(target=_proc_load,
+                        args=(f"{server.host}:{server.port}", obs, n,
+                              warmup_s, duration_s, out_q),
+                        name=f"loadgen-proc-{i}", daemon=True)
+            for i, n in enumerate(per_proc) if n > 0]
+        for w in workers:
+            w.start()
+        try:
+            for _ in workers:
+                lat, fin, rows_n, shed_n, errs = out_q.get(
+                    timeout=warmup_s + duration_s + 120)
+                latencies.extend(lat)
+                fanin_inv.extend(fin)
+                rows_served[0] += rows_n
+                shed[0] += shed_n
+                client_errors.extend(errs)
+        finally:
+            for w in workers:
+                w.join(timeout=30)
+                if w.is_alive():
+                    w.terminate()
+            server.close()
+        clients = sum(per_proc)
+        return _arm_row(transport, batching, latencies, fanin_inv,
+                        rows_served[0], shed[0], client_errors, clients,
+                        rows_per_request, duration_s, max_rows,
+                        max_wait_ms, procs)
+
+    # NOTE: this in-thread worker and _proc_load's worker are twins by
+    # design (the inproc transport can only run in-process; http with
+    # --procs runs the process copy) — a change to the measure-window,
+    # shed gating or retry rule must land in BOTH or the procs=1 and
+    # procs=N rows silently measure different things.
     def worker():
         cl = None
         try:
@@ -164,9 +277,19 @@ def run_arm(cfg, checkpoint_dir: str, *, batching: bool, clients: int,
     for t in threads:
         t.join()
     server.close()
+    return _arm_row(transport, batching, latencies, fanin_inv,
+                    rows_served[0], shed[0], client_errors, clients,
+                    rows_per_request, duration_s, max_rows, max_wait_ms,
+                    procs)
+
+
+def _arm_row(transport, batching, latencies, fanin_inv, rows_served,
+             shed, client_errors, clients, rows_per_request, duration_s,
+             max_rows, max_wait_ms, procs) -> dict:
+    """Merge one arm's (possibly multi-process) samples into its BENCH
+    row; dead clients fail the arm loudly (a zero-latency row from dead
+    workers would read as a great measurement)."""
     if client_errors:
-        # A zero-latency row from dead workers would read as a (great)
-        # measurement — fail the arm loudly instead.
         raise RuntimeError(
             f"{len(client_errors)}/{clients} bench clients died: "
             + "; ".join(sorted(set(client_errors))[:3]))
@@ -177,14 +300,15 @@ def run_arm(cfg, checkpoint_dir: str, *, batching: bool, clients: int,
         "bench": "serving",
         "transport": transport,
         "mode": "batched" if batching else "serial",
-        "acts_per_sec": round(rows_served[0] / duration_s, 1),
+        "procs": procs,
+        "acts_per_sec": round(rows_served / duration_s, 1),
         "requests_per_sec": round(n / duration_s, 1),
         "p50_ms": round(float(np.percentile(lat, 50)), 3),
         "p99_ms": round(float(np.percentile(lat, 99)), 3),
         "mean_fanin_requests": round(n / dispatches, 2),
-        "mean_fanin_rows": round(rows_served[0] / dispatches, 2),
+        "mean_fanin_rows": round(rows_served / dispatches, 2),
         "requests_ok": n,
-        "requests_shed": shed[0],
+        "requests_shed": shed,
         "clients": clients,
         "rows_per_request": rows_per_request,
         "duration_s": duration_s,
@@ -217,6 +341,12 @@ def main() -> int:
                              "inproc: direct batcher.submit — isolates "
                              "the dispatch economics (the A/B smoke's "
                              "arm)")
+    parser.add_argument("--procs", type=int, default=1,
+                        help="process-separated load generation "
+                             "(ISSUE 9 satellite): spawn N REAL client "
+                             "processes (clients split across them) "
+                             "instead of GIL-bound in-process threads; "
+                             "per-arm latency rows merge. http only")
     parser.add_argument("--ab", action="store_true",
                         help="run batched AND serial arms; the contract "
                              "line carries the speedup")
@@ -239,7 +369,8 @@ def main() -> int:
               warmup_s=args.warmup_s,
               rows_per_request=args.rows_per_request,
               max_rows=args.max_batch_rows, max_wait_ms=args.max_wait_ms,
-              queue_limit=args.queue_limit, transport=args.transport)
+              queue_limit=args.queue_limit, transport=args.transport,
+              procs=args.procs)
     try:
         rows = []
         if args.ab:

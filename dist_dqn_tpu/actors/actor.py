@@ -18,8 +18,9 @@ import time
 
 import numpy as np
 
-from dist_dqn_tpu import chaos
+from dist_dqn_tpu import chaos, ingest
 from dist_dqn_tpu.actors.transport import (CORRUPT_FRAME_NACK_KIND,
+                                           PROTO_MISMATCH_NACK_KIND,
                                            ShmMailbox, ShmRing,
                                            decode_arrays, encode_arrays)
 from dist_dqn_tpu.envs.gym_adapter import make_host_env
@@ -77,11 +78,11 @@ def _chaos_step_seam() -> None:
 
 def _step_and_encode(env, actions, actor_id: int, t: int,
                      compress: "bool | str" = False):
-    """Step the vector env and build the step record (shared by the shm
-    and TCP transports, so the record schema cannot diverge). The TCP
-    (DCN) caller passes compress="auto" — big pixel records shrink
-    severalfold under zlib before crossing hosts; shm stays uncompressed
-    (intra-host memcpy beats zlib).
+    """Step the vector env and build the LEGACY-codec step record
+    (shared by the shm and TCP transports, so the record schema cannot
+    diverge). The TCP (DCN) caller passes compress="auto" — big pixel
+    records shrink severalfold under zlib before crossing hosts; shm
+    stays uncompressed (intra-host memcpy beats zlib).
 
     Returns (obs, t + 1, payload).
     """
@@ -96,48 +97,121 @@ def _step_and_encode(env, actions, actor_id: int, t: int,
     return obs, t + 1, payload
 
 
+def _step_and_encode_zc(env, actions, enc: "ingest.StepEncoder",
+                        actor_id: int, t: int, shard: int,
+                        q_sel, q_max):
+    """The zero-copy twin of ``_step_and_encode``: raw array bytes into
+    the encoder's reusable buffer — no JSON, no per-field copies. The
+    q planes (from the act reply this step consumed) are Q(obs, action)
+    of THIS record's ``obs`` field, which is exactly the alignment the
+    learner's priority fold needs (ISSUE 9 piece 3). Returns
+    (obs, t + 1, payload memoryview — consumed before the next call).
+    """
+    obs, next_obs, reward, terminated, truncated = env.step(actions)
+    payload = enc.encode_step(
+        {"obs": obs, "reward": np.asarray(reward, np.float32),
+         "terminated": terminated.astype(np.uint8),
+         "truncated": truncated.astype(np.uint8),
+         "next_obs": next_obs},
+        actor=actor_id, t=t + 1, shard=shard, q_sel=q_sel, q_max=q_max)
+    return obs, t + 1, payload
+
+
+def _hello_meta(actor_id: int, t: int, transport: str,
+                schema=None) -> dict:
+    """Hello metadata with the explicit protocol-version field (ISSUE 9
+    satellite): the service rejects a mismatched version AT CONNECT —
+    a codec drift fails as one loud hello error instead of mid-stream
+    CRC/desync noise. Zero-copy hellos also declare the trajectory
+    schema (the one-time negotiation every later frame relies on)."""
+    meta = {"kind": "hello", "actor": actor_id, "t": t,
+            "proto": ingest.PROTOCOL_VERSION, "transport": transport}
+    if schema is not None:
+        meta["schema"] = schema.to_dict()
+    return meta
+
+
 def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
               req_ring: str, act_box: str, stop_path: str,
-              max_env_steps: int = 10 ** 12) -> None:
-    """Entry point for one actor process (multiprocessing 'spawn' target)."""
-    env = make_host_env(env_name, num_envs, seed=seed)
-    ring = ShmRing(req_ring)
-    box = ShmMailbox(act_box)
+              max_env_steps: int = 10 ** 12,
+              transport: str = "legacy") -> None:
+    """Entry point for one actor process (multiprocessing 'spawn' target).
 
+    ``transport="zerocopy"`` (ISSUE 9): trajectories publish into this
+    actor's seqlock slot ring (``{req_ring}_zc_{actor_id}``, created by
+    the service) as schema-negotiated zero-copy records, and act
+    replies arrive as zero-copy frames whose q planes ride the next
+    step record — the actor-side priority loop. ``"legacy"`` keeps the
+    JSON-codec records over the shared C++ ring, bit-pinned.
+    """
+    env = make_host_env(env_name, num_envs, seed=seed)
     obs = env.reset()
     t = 0
-    payload = encode_arrays({"obs": obs},
-                            {"kind": "hello", "actor": actor_id, "t": t})
-    while not ring.push(payload):
-        time.sleep(0.001)
-
+    enc = None
+    shard = 0
+    if transport == "zerocopy":
+        schema = ingest.step_schema(obs.shape[1:], obs.dtype, num_envs)
+        enc = ingest.StepEncoder(schema)
+        ring = ingest.ShmSlotRing(f"{req_ring}_zc_{actor_id}")
+        payload = encode_arrays(
+            {"obs": obs}, _hello_meta(actor_id, t, transport, schema))
+    else:
+        ring = ShmRing(req_ring)
+        payload = encode_arrays({"obs": obs},
+                                _hello_meta(actor_id, t, transport))
+    box = ShmMailbox(act_box)
     heartbeat, steps_total, hb_stage = _actor_telemetry(actor_id, "actor")
     steps = 0
-    while steps < max_env_steps and not os.path.exists(stop_path):
-        # Wait for the actions computed for our step-t observations.
-        data, ver = box.read()
-        if data is None or ver != t + 1:
-            time.sleep(0.0002)
-            continue
-        arrays, _ = decode_arrays(data)
-        _chaos_step_seam()
-        obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
-                                           t)
-        steps += num_envs
-        steps_total.inc(num_envs)
-        heartbeat.set(time.time())
-        hb_stage.beat()
+    try:
         while not ring.push(payload):
-            if os.path.exists(stop_path):
-                return
             time.sleep(0.001)
+        while steps < max_env_steps and not os.path.exists(stop_path):
+            # Wait for the actions computed for our step-t observations.
+            data, ver = box.read()
+            if data is None or ver != t + 1:
+                time.sleep(0.0002)
+                continue
+            q_sel = q_max = None
+            if enc is not None and ingest.is_zc(data):
+                actions, q_sel, q_max, hdr = ingest.decode_reply(data)
+                shard = hdr["shard"]   # sticky routing tag, echoed back
+            else:
+                # No NACK handling here: a rejected LOCAL hello raises
+                # HelloRejectedError in the service process itself
+                # (same host, same build — a deploy bug, not wire
+                # churn); NACKs are a TCP reply-channel concept
+                # (run_remote_actor handles them).
+                arrays, _ = decode_arrays(data)
+                actions = arrays["action"]
+            _chaos_step_seam()
+            if enc is not None:
+                obs, t, payload = _step_and_encode_zc(
+                    env, actions, enc, actor_id, t, shard, q_sel, q_max)
+            else:
+                obs, t, payload = _step_and_encode(env, actions, actor_id,
+                                                   t)
+            steps += num_envs
+            steps_total.inc(num_envs)
+            heartbeat.set(time.time())
+            hb_stage.beat()
+            while not ring.push(payload):
+                if os.path.exists(stop_path):
+                    return
+                time.sleep(0.001)
+    finally:
+        # Slot rings hold numpy views over the shm mapping: release
+        # them BEFORE interpreter teardown GCs the SharedMemory, or
+        # its close() raises a (cosmetic, noisy) BufferError.
+        if hasattr(ring, "close"):
+            ring.close()
 
 
 def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
                      address, stop_path: str,
                      max_env_steps: int = 10 ** 12,
                      max_consecutive_failures: int = 60,
-                     reconnect_backoff_s: float = 0.5) -> None:
+                     reconnect_backoff_s: float = 0.5,
+                     transport: str = "legacy") -> None:
     """Actor on another host: same stepping loop, DCN (TCP) transport.
 
     Lock-step protocol per actor: push an observation record, block on the
@@ -167,11 +241,13 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     max_reconnect_backoff_s = 10.0
     jitter_rng = np.random.default_rng(
         np.random.SeedSequence(seed, spawn_key=(0x6A17,)))
+    enc = None
+    schema = None
 
     def connect_and_hello(obs, t):
         client = TcpRecordClient(tuple(address))
         client.push(encode_arrays(
-            {"obs": obs}, {"kind": "hello", "actor": actor_id, "t": t},
+            {"obs": obs}, _hello_meta(actor_id, t, transport, schema),
             compress="auto"))
         return client
 
@@ -182,6 +258,10 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         labels={"actor": str(actor_id)})
     obs = env.reset()
     t = 0
+    shard = 0
+    if transport == "zerocopy":
+        schema = ingest.step_schema(obs.shape[1:], obs.dtype, num_envs)
+        enc = ingest.StepEncoder(schema)
     failures = 0
     client = None                    # first connect goes through the retry
     steps = 0                        # path too (learner may not be up yet)
@@ -214,18 +294,35 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             client.close()
             client = None
             continue
-        arrays, meta = decode_arrays(reply)
-        if meta.get("kind") == CORRUPT_FRAME_NACK_KIND:
-            # The service dropped our last frame at its integrity gate:
-            # the action this lane is waiting on will never come.
-            # Reconnect + re-hello NOW (one assembly window lost)
-            # instead of waiting out the full stall bound.
-            client.close()
-            client = None
-            continue
+        q_sel = q_max = None
+        if enc is not None and ingest.is_zc(reply):
+            actions, q_sel, q_max, hdr = ingest.decode_reply(reply)
+            shard = hdr["shard"]
+        else:
+            arrays, meta = decode_arrays(reply)
+            if meta.get("kind") == CORRUPT_FRAME_NACK_KIND:
+                # The service dropped our last frame at its integrity
+                # gate: the action this lane is waiting on will never
+                # come. Reconnect + re-hello NOW (one assembly window
+                # lost) instead of waiting out the full stall bound.
+                client.close()
+                client = None
+                continue
+            if meta.get("kind") == PROTO_MISMATCH_NACK_KIND:
+                # Version/transport drift is a BUILD problem, not churn:
+                # reconnect-retrying would hammer the service with
+                # hellos it must keep rejecting. Die loudly.
+                raise RuntimeError(
+                    f"actor {actor_id}: service rejected hello — "
+                    f"{meta.get('detail', 'protocol mismatch')}")
+            actions = arrays["action"]
         _chaos_step_seam()
-        obs, t, payload = _step_and_encode(env, arrays["action"], actor_id,
-                                           t, compress="auto")
+        if enc is not None:
+            obs, t, payload = _step_and_encode_zc(
+                env, actions, enc, actor_id, t, shard, q_sel, q_max)
+        else:
+            obs, t, payload = _step_and_encode(
+                env, actions, actor_id, t, compress="auto")
         steps += num_envs
         steps_total.inc(num_envs)
         heartbeat.set(time.time())

@@ -31,6 +31,7 @@ from typing import Tuple
 
 import numpy as np
 
+from dist_dqn_tpu import ingest
 from dist_dqn_tpu.actors.transport import (ShmMailbox, ShmRing,
                                            encode_arrays)
 from dist_dqn_tpu.telemetry import (get_registry,
@@ -89,17 +90,29 @@ class FeederSpecEnv:
 
 
 def _build_pool(rng: np.random.Generator, actor_id: int, lanes: int,
-                obs_shape: Tuple[int, ...], obs_dtype: np.dtype):
+                obs_shape: Tuple[int, ...], obs_dtype: np.dtype,
+                transport: str = "legacy"):
     """(hello_payload, [step payloads]): one synthetic trajectory slice,
-    encoded once up front so the pump loop is a pure ring memcpy."""
+    encoded once up front so the pump loop is a pure ring memcpy.
+
+    ``transport="zerocopy"`` builds zero-copy records instead (ISSUE 9),
+    each carrying a synthetic q-plane pair — the frame-shipped priority
+    inputs real actors echo from their act replies — so a feeder run
+    drives the learner's zero-bootstrap-dispatch ingest path end to end.
+    """
     def obs_batch():
         if obs_dtype == np.uint8:
             return rng.integers(0, 256, (lanes,) + obs_shape
                                 ).astype(np.uint8)
         return rng.normal(size=(lanes,) + obs_shape).astype(obs_dtype)
 
+    zc = transport == "zerocopy"
+    schema = (ingest.step_schema(obs_shape, obs_dtype, lanes)
+              if zc else None)
+    enc = ingest.StepEncoder(schema) if zc else None
+    from dist_dqn_tpu.actors.actor import _hello_meta
     hello = encode_arrays({"obs": obs_batch()},
-                          {"kind": "hello", "actor": actor_id, "t": 0})
+                          _hello_meta(actor_id, 0, transport, schema))
     steps = []
     for t in range(POOL_RECORDS):
         terminated = rng.random((lanes,)) < P_TERMINATED
@@ -108,30 +121,43 @@ def _build_pool(rng: np.random.Generator, actor_id: int, lanes: int,
         # honor the same contract or the assembler/bootstrap measure
         # inputs no production run produces (ADVICE r5).
         truncated = (rng.random((lanes,)) < P_TRUNCATED) & ~terminated
-        steps.append(encode_arrays(
-            {"obs": obs_batch(),
-             "reward": rng.normal(size=(lanes,)).astype(np.float32),
-             "terminated": terminated.astype(np.uint8),
-             "truncated": truncated.astype(np.uint8),
-             "next_obs": obs_batch()},
-            {"kind": "step", "actor": actor_id, "t": t + 1}))
+        arrays = {
+            "obs": obs_batch(),
+            "reward": rng.normal(size=(lanes,)).astype(np.float32),
+            "terminated": terminated.astype(np.uint8),
+            "truncated": truncated.astype(np.uint8),
+            "next_obs": obs_batch()}
+        if zc:
+            # bytes() copy: pool records must outlive the encoder's
+            # reusable scratch.
+            steps.append(bytes(enc.encode_step(
+                arrays, actor=actor_id, t=t + 1,
+                q_sel=rng.normal(size=(lanes,)).astype(np.float32),
+                q_max=rng.normal(size=(lanes,)).astype(np.float32))))
+        else:
+            steps.append(encode_arrays(
+                arrays, {"kind": "step", "actor": actor_id, "t": t + 1}))
     return hello, steps
 
 
 def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
                req_ring: str, act_box: str, stop_path: str,
-               max_env_steps: int = 10 ** 12) -> None:
+               max_env_steps: int = 10 ** 12,
+               transport: str = "legacy") -> None:
     """Entry point for one feeder process (multiprocessing 'spawn' target).
 
     Signature mirrors ``actor.run_actor`` so the service spawns either
-    interchangeably. ``act_box`` is accepted (the service still writes
-    computed actions there) but never read — feeders do not rate-limit
-    on inference replies.
+    interchangeably (including the ``transport`` mode). ``act_box`` is
+    accepted (the service still writes computed actions there) but only
+    read for the first hello reply — feeders do not rate-limit on
+    inference replies.
     """
     obs_shape, obs_dtype, _ = parse_feeder_spec(spec)
     rng = np.random.default_rng(seed)
-    hello, pool = _build_pool(rng, actor_id, num_envs, obs_shape, obs_dtype)
-    ring = ShmRing(req_ring)
+    hello, pool = _build_pool(rng, actor_id, num_envs, obs_shape,
+                              obs_dtype, transport=transport)
+    ring = (ingest.ShmSlotRing(f"{req_ring}_zc_{actor_id}")
+            if transport == "zerocopy" else ShmRing(req_ring))
     box = ShmMailbox(act_box)
     # Telemetry (ISSUE 1): feeders are a separate process, so their
     # registry is process-local — DQN_TELEMETRY_SNAPSHOT dumps it at
@@ -160,44 +186,49 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
     g_heartbeat = reg.gauge("dqn_actor_heartbeat_timestamp",
                             "unix time of the last pump-loop pass", labels)
 
-    while not ring.push(hello):
-        if os.path.exists(stop_path):
-            return
-        time.sleep(0.001)
-    # Wait for the hello's action reply ONCE: a real actor blocks on its
-    # mailbox every step, which guarantees the service has flushed the
-    # act queue (setting this lane's prev obs/action) before its first
-    # step record arrives. Feeders keep that guarantee for the first
-    # record only, then pump unthrottled.
-    while not os.path.exists(stop_path):
-        _, ver = box.read()
-        if ver >= 1:
-            break
-        time.sleep(0.001)
-
     steps = 0
     i = 0
     stop = False
-    while steps < max_env_steps and not stop:
-        if ring.push(pool[i % POOL_RECORDS]):
-            i += 1
-            steps += num_envs
-            # Stop checks cost a stat syscall each — off the per-push
-            # hot path (this pump shares the core with the service under
-            # measurement); the ring-full branch still checks every
-            # retry, so shutdown latency stays bounded either way. The
-            # records counter batches onto the same cadence to keep the
-            # pump a pure memcpy between checkpoints.
-            if i % 256 == 0:
-                stop = os.path.exists(stop_path)
-                c_records.inc(256)
+    try:
+        while not ring.push(hello):
+            if os.path.exists(stop_path):
+                return
+            time.sleep(0.001)
+        # Wait for the hello's action reply ONCE: a real actor blocks on
+        # its mailbox every step, which guarantees the service has
+        # flushed the act queue (setting this lane's prev obs/action)
+        # before its first step record arrives. Feeders keep that
+        # guarantee for the first record only, then pump unthrottled.
+        while not os.path.exists(stop_path):
+            _, ver = box.read()
+            if ver >= 1:
+                break
+            time.sleep(0.001)
+        while steps < max_env_steps and not stop:
+            if ring.push(pool[i % POOL_RECORDS]):
+                i += 1
+                steps += num_envs
+                # Stop checks cost a stat syscall each — off the per-push
+                # hot path (this pump shares the core with the service
+                # under measurement); the ring-full branch still checks
+                # every retry, so shutdown latency stays bounded either
+                # way. The records counter batches onto the same cadence
+                # to keep the pump a pure memcpy between checkpoints.
+                if i % 256 == 0:
+                    stop = os.path.exists(stop_path)
+                    c_records.inc(256)
+                    g_heartbeat.set(time.time())
+                    hb.beat()
+            else:
+                # Ring full: the service is the bottleneck (that is the
+                # point of the measurement) — yield briefly and retry.
+                c_full.inc()
                 g_heartbeat.set(time.time())
                 hb.beat()
-        else:
-            # Ring full: the service is the bottleneck (that is the
-            # point of the measurement) — yield briefly and retry.
-            c_full.inc()
-            g_heartbeat.set(time.time())
-            hb.beat()
-            time.sleep(0.0005)
-            stop = os.path.exists(stop_path)
+                time.sleep(0.0005)
+                stop = os.path.exists(stop_path)
+    finally:
+        # Zero-copy slot rings hold numpy views over the shm mapping:
+        # release before interpreter teardown (see actors/actor.py).
+        if hasattr(ring, "close"):
+            ring.close()

@@ -35,6 +35,14 @@ def main():
     parser.add_argument("--max-reconnect-failures", type=int, default=60,
                         help="exit after this many consecutive failed "
                              "reconnects (the learner is gone)")
+    parser.add_argument("--transport", choices=("zerocopy", "legacy"),
+                        default="zerocopy",
+                        help="wire codec (ISSUE 9): zerocopy = schema-"
+                             "negotiated raw-array frames + actor-side "
+                             "priority planes; legacy = the JSON-codec "
+                             "fallback. Must match the service's "
+                             "--transport (a zerocopy hello against a "
+                             "legacy service fails loudly at connect)")
     parser.add_argument("--telemetry-port", type=int, default=None,
                         help="serve this worker's /metrics (Prometheus "
                              "text) on this port; 0 = ephemeral. Worker "
@@ -65,7 +73,8 @@ def main():
     run_remote_actor(args.actor_id, args.env, args.num_envs, seed,
                      (host, int(port)), args.stop_file,
                      max_env_steps=args.max_env_steps,
-                     max_consecutive_failures=args.max_reconnect_failures)
+                     max_consecutive_failures=args.max_reconnect_failures,
+                     transport=args.transport)
 
 
 if __name__ == "__main__":

@@ -169,6 +169,27 @@ class ApexRuntimeConfig:
     # trains. Single-device learners only (the multi-host/multi-learner
     # paths shard batches themselves); 0 = legacy serial sample->upload.
     stage_depth: int = 2
+    # Zero-copy ingest subsystem (ISSUE 9, dist_dqn_tpu/ingest/):
+    # "zerocopy" (default) negotiates a trajectory schema at hello and
+    # ships raw-array frames — seqlock shm slot rings for same-host
+    # actors (no socket stack), length-prefixed zero-copy frames under
+    # the ISSUE 8 CRC framing on TCP. "legacy" keeps the bit-pinned
+    # JSON-header codec everywhere (the A/B baseline).
+    transport: str = "zerocopy"
+    # Actor-side priority pre-computation (ISSUE 9 piece 3, zerocopy
+    # only): act replies carry the inference-time q planes, actors echo
+    # them on their step frames, and insertion priorities are computed
+    # host-side from the frames — the ingest pass performs ZERO
+    # priority-bootstrap device dispatches (pinned via device_calls).
+    # Rides the Python assembler (q-plane threading); False restores
+    # the learner-side bootstrap (+ native assembly where configured).
+    actor_priorities: bool = True
+    # Sticky ingest routing (ISSUE 9 piece 4): replay-shard count for
+    # the actor -> shard assignment threaded through frame headers and
+    # the replay append path. MUST stay 1 until ROADMAP item 1 lands a
+    # sharded store; the plumbing (and telemetry) exists now so that
+    # scale-out is a config change, not a wire change.
+    ingest_shards: int = 1
     # Prometheus scrape endpoint (telemetry/server.py): serve the process
     # registry's /metrics on this port (0 = ephemeral, logged as
     # telemetry_port). None disables. Same surface as the fused
@@ -228,6 +249,36 @@ class ApexLearnerService:
         obs_example = probe.reset()[0]
         del probe
 
+        # Zero-copy ingest (ISSUE 9): sticky-shard router + per-local-
+        # actor seqlock slot rings (created HERE, attached by spawned
+        # actors — same ownership model as the mailboxes above). Slot
+        # geometry derives from the env probe; the actor's hello carries
+        # its own derivation and a mismatch fails at connect.
+        if rt.ingest_shards != 1:
+            raise ValueError(
+                "ingest_shards > 1 requires the sharded replay store "
+                "(ROADMAP item 1); the routing plumbing lands first")
+        from dist_dqn_tpu import ingest
+        self._ingest = ingest
+        self.router = ingest.StickyShardRouter(rt.ingest_shards)
+        self._decoders: Dict[int, object] = {}   # actor id -> StepDecoder
+        self._zc_rings: Dict[int, object] = {}
+        self._expected_schema = None
+        if rt.transport == "zerocopy":
+            self._expected_schema = ingest.step_schema(
+                obs_example.shape, obs_example.dtype, rt.envs_per_actor)
+            # Slot must fit the larger of a step record and the legacy-
+            # coded hello ([lanes, obs] + JSON header) with headroom.
+            slot = max(ingest.max_record_bytes(self._expected_schema),
+                       rt.envs_per_actor * obs_example.nbytes + 4096)
+            for i in range(rt.num_actors):
+                self._zc_rings[i] = ingest.ShmSlotRing(
+                    f"req_{self.run_id}_zc_{i}", slot_size=slot,
+                    nslots=8, create=True)
+        elif rt.transport != "legacy":
+            raise ValueError(f"unknown transport {rt.transport!r} "
+                             f"(expected 'zerocopy' or 'legacy')")
+
         net = build_network(cfg.network, self.num_actions)
         self.net = net
         # Multi-host (jax.distributed runtime): every host runs its own
@@ -273,8 +324,11 @@ class ApexLearnerService:
             self.seq_len = (cfg.replay.burn_in + cfg.replay.unroll_length
                             + cfg.learner.n_step)
             stride = cfg.replay.sequence_stride or cfg.replay.unroll_length
+            self._asm_factory = (
+                lambda lanes: SequenceAssembler(lanes, self.seq_len,
+                                                stride))
             self.assemblers = [
-                SequenceAssembler(rt.envs_per_actor, self.seq_len, stride)
+                self._asm_factory(rt.envs_per_actor)
                 for _ in range(self.total_actors)
             ]
             self._carry: List = [None] * self.total_actors
@@ -282,13 +336,26 @@ class ApexLearnerService:
             self._prev_q: List = [None] * self.total_actors
             self._prio_fn = None
             self._fused = None
+            # R2D2 already seeds priorities from its inference-time q
+            # planes service-side; the frame-shipped plane loop is the
+            # feed-forward path's (ISSUE 9).
+            self.actor_prio = False
+            self._act_q = None
         else:
             init, train_step = make_learner(net, cfg.learner,
                                             axis_name=axis)
             act_fn = make_actor_step(net)
             self._act = jax.jit(act_fn)
+            # Actor-side priorities (ISSUE 9 piece 3): the act program
+            # also returns (q_sel, q_max); the planes ride the reply,
+            # the actor echoes them on its next frame, and insertion
+            # priorities fold host-side — ZERO bootstrap dispatches.
+            self.actor_prio = (rt.transport == "zerocopy"
+                               and rt.actor_priorities)
+            self._act_q = (jax.jit(make_actor_step(net, return_q=True))
+                           if self.actor_prio else None)
             asm_cls = NStepAssembler
-            if rt.native_assembly:
+            if rt.native_assembly and not self.actor_prio:
                 try:
                     from dist_dqn_tpu.actors.assembler import \
                         NativeNStepAssembler
@@ -299,9 +366,15 @@ class ApexLearnerService:
                 except Exception as e:
                     log_fn(f"# native assembler unavailable "
                            f"({type(e).__name__}: {e}); using Python path")
+            elif rt.native_assembly and self.actor_prio:
+                log_fn("# actor-side priorities thread q planes through "
+                       "the Python assembler; native assembly applies "
+                       "to the legacy/bootstrap path only")
+            self._asm_factory = (
+                lambda lanes: asm_cls(lanes, cfg.learner.n_step,
+                                      cfg.learner.gamma))
             self.assemblers = [
-                asm_cls(rt.envs_per_actor, cfg.learner.n_step,
-                        cfg.learner.gamma)
+                self._asm_factory(rt.envs_per_actor)
                 for _ in range(self.total_actors)
             ]
 
@@ -333,7 +406,13 @@ class ApexLearnerService:
                                 b_reward, b_discount, b_next_obs)
                 return actions, prios
 
-            self._fused = jax.jit(fused_fn) if rt.fused_ingest else None
+            # With actor-side priorities the bootstrap has nothing to
+            # compute, so there is nothing to fuse: the act(+q) program
+            # is the single per-pass dispatch. _prio_fn stays jitted for
+            # legacy-codec actors joining a zerocopy service mid-fleet.
+            self._fused = (jax.jit(fused_fn)
+                           if rt.fused_ingest and not self.actor_prio
+                           else None)
         self.state = None
         self._init_learner = init
         self._mh = None
@@ -404,6 +483,14 @@ class ApexLearnerService:
             [None] * self.total_actors
         self._pending: List[Dict[str, np.ndarray]] = []
         self._pending_count = 0
+        # Actor-side priority bookkeeping (ISSUE 9): drained-but-not-
+        # yet-inserted transitions awaiting their bootstrap q_max from
+        # THIS pass's act flush, keyed by act-request id; the per-actor
+        # last flush planes cover the final-drain edge at shutdown.
+        self._req_seq = 0
+        self._prio_await: List = []          # (actor, rid, emitted)
+        self._flush_q: Dict[int, np.ndarray] = {}    # rid -> q_max rows
+        self._last_flush_q: Dict[int, np.ndarray] = {}
         # (idx, gen, metrics, t_dispatch) per dispatched train step.
         self._in_flight = deque()
         self._act_queue: List = []  # (actor, obs, t) awaiting batched act
@@ -523,6 +610,13 @@ class ApexLearnerService:
         self._tm_bad_records = reg.counter(
             "dqn_service_bad_records_total",
             "malformed/misrouted records rejected at the TCP boundary")
+        # Zero-copy ingest (ISSUE 9): transitions inserted with frame-
+        # shipped priorities — each one a bootstrap dispatch that never
+        # happened (the acceptance pin divides device_calls by these).
+        self._tm_actor_prio = reg.counter(
+            tmc.INGEST_ACTOR_PRIO_TRANSITIONS,
+            "transitions inserted with actor-shipped |TD| priorities "
+            "(zero learner-side bootstrap dispatches)")
         self._tm_ring_dropped = reg.gauge(
             "dqn_transport_ring_dropped",
             "records the shm ring dropped (producer overrun)")
@@ -660,6 +754,7 @@ class ApexLearnerService:
                 args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
                       1000 + 7 * actor_id, f"req_{self.run_id}",
                       f"act_{self.run_id}_{actor_id}", self.stop_path),
+                kwargs={"transport": self.rt.transport},
                 daemon=True)
         else:
             p = ctx.Process(
@@ -667,6 +762,7 @@ class ApexLearnerService:
                 args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
                       1000 + 7 * actor_id,
                       ("127.0.0.1", self.tcp_address[1]), self.stop_path),
+                kwargs={"transport": self.rt.transport},
                 daemon=True)
         p.start()
         return p
@@ -730,6 +826,9 @@ class ApexLearnerService:
         if self.telemetry_server is not None:
             self.telemetry_server.close()
         self.req_ring.unlink()
+        for ring in self._zc_rings.values():
+            ring.close()
+            ring.unlink()
         for b in self.act_boxes:
             b.unlink()
         try:
@@ -803,10 +902,15 @@ class ApexLearnerService:
         return self._host_params[1] if self.distributed \
             else self.state.target_params
 
-    def _reply_actions(self, actor: int, obs: np.ndarray, t: int):
+    def _reply_actions(self, actor: int, obs: np.ndarray, t: int) -> int:
         """Queue one actor's act request; the device call happens batched in
-        ``_flush_act_queue`` at the end of the drain burst."""
-        self._act_queue.append((actor, obs, t))
+        ``_flush_act_queue`` at the end of the drain burst. Returns the
+        request id — the key under which this request's flush will file
+        its q_max plane (the bootstrap inputs for transitions emitted by
+        the record that carried ``obs``)."""
+        self._req_seq += 1
+        self._act_queue.append((actor, obs, t, self._req_seq))
+        return self._req_seq
 
     def _flush_act_queue(self):
         """Sebulba-style batched inference: ONE device call serves every
@@ -828,8 +932,8 @@ class ApexLearnerService:
         # Shared pow2 packing (actors/act_dispatch.py): the same bucket
         # rule + zero-padding the serving micro-batcher dispatches with.
         obs_cat, eps, rows, total = pack_act_rows(
-            [obs for _, obs, _ in burst],
-            [self.actor_eps[actor] for actor, _, _ in burst])
+            [obs for _, obs, _, _ in burst],
+            [self.actor_eps[actor] for actor, _, _, _ in burst])
         padded = obs_cat.shape[0]
         self._rng, k = jax.random.split(self._rng)
         # Fused fast path (ISSUE 2): when a bootstrap batch is pending,
@@ -842,7 +946,7 @@ class ApexLearnerService:
                               fused_bootstrap=boot is not None):
             if self.recurrent:
                 cs, hs = [], []
-                for (actor, obs, _), r in zip(burst, rows):
+                for (actor, obs, _, _), r in zip(burst, rows):
                     carry = self._carry[actor] or self.net.initial_state(r)
                     c0 = np.asarray(carry[0], np.float32)
                     h0 = np.asarray(carry[1], np.float32)
@@ -876,13 +980,23 @@ class ApexLearnerService:
                 # the batch's priorities materialize on a later pass.
                 self._boot_inflight.append((prios, b_items, b_count))
                 self._count_device_call("fused_act_bootstrap", rows=total)
+            elif self._act_q is not None:
+                # Actor-priority path (ISSUE 9): ONE dispatched program
+                # per pass — act + the q planes that ride the replies.
+                actions, q_sel, q_max = self._act_q(
+                    self._policy_params, jnp.asarray(obs_cat), k,
+                    jnp.asarray(eps))
+                qs_np = np.asarray(q_sel, np.float32)
+                qm_np = np.asarray(q_max, np.float32)
+                self._count_device_call("act", rows=total)
             else:
                 actions = self._act(self._policy_params, jnp.asarray(obs_cat),
                                     k, jnp.asarray(eps))
                 self._count_device_call("act", rows=total)
             acts_np = np.asarray(actions, np.int32)
+        prio = not self.recurrent and self._act_q is not None
         off = 0
-        for (actor, obs, t), r in zip(burst, rows):
+        for (actor, obs, t, rid), r in zip(burst, rows):
             sl = slice(off, off + r)
             off += r
             if self.recurrent:
@@ -890,7 +1004,25 @@ class ApexLearnerService:
                 self._prev_q[actor] = (qs_np[sl], qm_np[sl])
             self._prev_actions[actor] = acts_np[sl]
             self._prev_obs[actor] = obs
-            payload = encode_arrays({"action": acts_np[sl]})
+            q_rows = None
+            if prio:
+                # File this request's q_max under its id: transitions
+                # the SAME record emitted bootstrap from these planes
+                # (their bootstrap obs IS the obs acted on here).
+                q_rows = (qs_np[sl], qm_np[sl])
+                self._flush_q[rid] = qm_np[sl]
+                self._last_flush_q[actor] = qm_np[sl]
+            if actor in self._decoders:
+                # Zero-copy reply: actions (+ q planes on the prio
+                # path) with the sticky shard id stamped — the actor
+                # echoes both on its next frame.
+                payload = self._ingest.encode_reply(
+                    acts_np[sl], actor=actor, t=t,
+                    shard=self.router.shard_for(actor),
+                    q_sel=q_rows[0] if q_rows else None,
+                    q_max=q_rows[1] if q_rows else None)
+            else:
+                payload = encode_arrays({"action": acts_np[sl]})
             if actor < self.rt.num_actors:
                 self.act_boxes[actor].write(payload, version=t + 1)
             else:
@@ -919,8 +1051,118 @@ class ApexLearnerService:
                             f'"env_steps": {self.env_steps}}}')
             self.tracer.instant("ingest_stalled", silent_s=round(silent, 1))
 
-    def _handle_record(self, payload: bytes, conn_id: Optional[int] = None):
-        arrays, meta = decode_arrays(payload)
+    class HelloRejectedError(ValueError):
+        """Protocol/transport/schema drift detected at connect — the
+        one record-level error that must stay LOUD on the same-host
+        path (a drifted local build is a deploy bug, not wire churn):
+        the shm drain's error boundary re-raises this type."""
+
+    def _hello_reject(self, detail: str, conn_id: Optional[int]):
+        """Protocol/transport drift fails LOUDLY at connect (ISSUE 9
+        satellite): TCP peers get a structured NACK (they raise and
+        exit rather than retry-hammering); the raise below surfaces as
+        one counted bad record on TCP and as a hard service error on
+        the same-host path (a drifted local build is a deploy bug)."""
+        if conn_id is not None and self.tcp_server is not None:
+            from dist_dqn_tpu.actors.transport import \
+                PROTO_MISMATCH_NACK_KIND
+            self.tcp_server.send(conn_id, encode_arrays(
+                {}, {"kind": PROTO_MISMATCH_NACK_KIND, "detail": detail}))
+        raise self.HelloRejectedError(f"hello rejected: {detail}")
+
+    def _validate_hello(self, actor: int, meta: Dict,
+                        conn_id: Optional[int]) -> None:
+        """Explicit protocol-version + transport-mode negotiation. A
+        version mismatch used to be undetectable until it surfaced as
+        CRC/desync noise mid-stream; now it is one loud connect error.
+        Zero-copy hellos also register the actor's declared schema —
+        the layout every later frame of the session is decoded with."""
+        from dist_dqn_tpu.ingest import PROTOCOL_VERSION, StepDecoder, \
+            TrajectorySchema
+        proto = meta.get("proto")
+        if proto is not None and int(proto) != PROTOCOL_VERSION:
+            self._hello_reject(
+                f"actor {actor} speaks wire protocol {proto}, service "
+                f"speaks {PROTOCOL_VERSION} — upgrade in lockstep",
+                conn_id)
+        peer_transport = meta.get("transport", "legacy")
+        if peer_transport == "zerocopy" and self.rt.transport != "zerocopy":
+            self._hello_reject(
+                f"actor {actor} wants zerocopy transport but the "
+                f"service runs --transport legacy", conn_id)
+        if peer_transport == "zerocopy":
+            if "schema" not in meta:
+                self._hello_reject(
+                    f"zerocopy hello from actor {actor} without a "
+                    f"trajectory schema", conn_id)
+            schema = TrajectorySchema.from_dict(meta["schema"])
+            # Canonical-layout gate: the declared schema must be
+            # exactly step_schema over its own obs field — a peer
+            # declaring extra/renamed/re-typed fields would decode but
+            # mis-feed every downstream consumer; reject at connect.
+            from dist_dqn_tpu.ingest import step_schema
+            obs_field = schema.fields[0] if schema.fields else None
+            if (obs_field is None or obs_field.name != "obs"
+                    or schema != step_schema(obs_field.shape,
+                                             obs_field.dtype,
+                                             schema.lanes)):
+                self._hello_reject(
+                    f"actor {actor} declared a non-canonical step "
+                    f"schema {schema.to_dict()}", conn_id)
+            self._decoders[actor] = StepDecoder(schema)
+            asm = self.assemblers[actor]
+            cur_lanes = getattr(asm, "num_lanes", None) \
+                or len(getattr(asm, "lanes", ()))
+            if self.actor_prio and (
+                    not getattr(asm, "with_q", False)
+                    or cur_lanes != schema.lanes):
+                # q planes ride this actor's frames: thread them
+                # through a q-aware assembler sized to the DECLARED
+                # lane count. Swapped only on first negotiation (or a
+                # lane-count change) — a re-hello must not discard the
+                # previous assembler's drained-but-uninserted output.
+                self.assemblers[actor] = NStepAssembler(
+                    schema.lanes, self.cfg.learner.n_step,
+                    self.cfg.learner.gamma, with_q=True)
+            elif not self.actor_prio and cur_lanes != schema.lanes:
+                # No-priority/recurrent modes: the pre-built assembler
+                # was sized envs_per_actor — an external worker with a
+                # different lane count would silently truncate (or
+                # crash) lane iteration; rebuild at the declared width.
+                self.assemblers[actor] = self._asm_factory(schema.lanes)
+
+    def _handle_record(self, payload: bytes, conn_id: Optional[int] = None,
+                       transport_kind: str = "legacy"):
+        ingest = self._ingest
+        if ingest.is_zc(payload):
+            # Zero-copy record: schema negotiated at hello, payload is
+            # raw array bytes — decode to views, no JSON, no copies.
+            try:
+                hdr = ingest.peek_header(payload)
+                dec = self._decoders.get(hdr["actor"])
+                if dec is None:
+                    raise ingest.WireFormatError(
+                        f"zero-copy record for actor {hdr['actor']} "
+                        f"before a schema hello")
+                arrays, meta = dec.decode(payload, hdr=hdr)
+            except ingest.WireFormatError as e:
+                self.router.decode_error(type(e).__name__)
+                if conn_id is not None and self.tcp_server is not None:
+                    # Same contract as the CRC gate one layer down
+                    # (transport.py): the lock-step sender's action
+                    # will never come — NACK so it reconnects NOW
+                    # instead of waiting out its stall bound.
+                    from dist_dqn_tpu.actors.transport import \
+                        CORRUPT_FRAME_NACK_KIND
+                    self.tcp_server.send(conn_id, encode_arrays(
+                        {}, {"kind": CORRUPT_FRAME_NACK_KIND}))
+                raise
+        else:
+            arrays, meta = decode_arrays(payload)
+            # dqn_ingest_* labels identify the CODEC, not the channel
+            # (collectors.py): a JSON-codec record over TCP is the
+            # legacy arm of the A/B, not zero-copy wire traffic.
+            transport_kind = "legacy"
         actor, t = int(meta["actor"]), int(meta["t"])
         if conn_id is not None:
             # Remote actor: only the remote id range is valid over TCP (a
@@ -948,7 +1190,12 @@ class ApexLearnerService:
                 raise ValueError(
                     f"actor {actor} {key} {arr.shape[1:]}/{arr.dtype} does "
                     f"not match the session spec {self._obs_spec}")
+        # Ingest accounting (ISSUE 9): bytes/records per transport and
+        # the sticky shard this actor's stream lands in — only for
+        # records that passed every validation gate above.
+        self.router.record(actor, len(payload), transport_kind)
         if meta["kind"] == "hello":
+            self._validate_hello(actor, meta, conn_id)
             self._ensure_learner(arrays["obs"][0])
             self._record_seen()
             if self._prev_obs[actor] is not None:
@@ -986,11 +1233,35 @@ class ApexLearnerService:
                 c = self._carry[actor]
                 self._carry[actor] = (c[0] * keep, c[1] * keep)
         else:
-            self.assemblers[actor].step(
-                self._prev_obs[actor], self._prev_actions[actor],
-                arrays["reward"], terminated, truncated, arrays["next_obs"])
+            asm = self.assemblers[actor]
+            if getattr(asm, "with_q", False):
+                q_sel = meta.get("q_sel")
+                if q_sel is None:
+                    raise ValueError(
+                        f"actor {actor} negotiated actor-side "
+                        f"priorities but shipped a frame without q "
+                        f"planes")
+                asm.step(self._prev_obs[actor], self._prev_actions[actor],
+                         arrays["reward"], terminated, truncated,
+                         arrays["next_obs"], q_sel=q_sel,
+                         q_max=meta["q_max"])
+            else:
+                asm.step(self._prev_obs[actor], self._prev_actions[actor],
+                         arrays["reward"], terminated, truncated,
+                         arrays["next_obs"])
         self.env_steps += arrays["reward"].shape[0]
         self._tm_env_steps.inc(arrays["reward"].shape[0])
+        if not self.recurrent and getattr(self.assemblers[actor],
+                                          "with_q", False):
+            # Actor-priority path: this record's emissions bootstrap
+            # from the obs the act request below will flush q planes
+            # for — park them keyed by that request id; insertion
+            # happens right after the flush (_insert_actor_prio).
+            rid = self._reply_actions(actor, arrays["obs"], t)
+            emitted = self.assemblers[actor].drain()
+            if emitted is not None:
+                self._prio_await.append((actor, rid, emitted))
+            return
         emitted = self.assemblers[actor].drain()
         if emitted is not None:
             if self.recurrent:
@@ -1011,6 +1282,49 @@ class ApexLearnerService:
                 self._pending.append(emitted)
                 self._pending_count += emitted["action"].shape[0]
         self._reply_actions(actor, arrays["obs"], t)
+
+    def _insert_actor_prio(self) -> None:
+        """Insert transitions whose priorities came off the wire
+        (ISSUE 9 piece 3): the frame shipped ``q_sel`` (start of each
+        n-step window), this pass's act flush produced ``q_max`` of the
+        bootstrap obs, and the fold
+
+            p = |q_start - (R + discount * q_max[boot_lane])|
+
+        runs in pure numpy — the priority twin of the R2D2 seeding
+        rule, and the reason the zerocopy ingest pass dispatches ZERO
+        bootstrap programs. Terminal windows carry discount 0, so their
+        bootstrap term vanishes exactly as in the device ``prio_fn``."""
+        if not self._prio_await:
+            self._flush_q.clear()
+            return
+        pend, self._prio_await = self._prio_await, []
+        for actor, rid, emitted in pend:
+            q_max = self._flush_q.get(rid)
+            if q_max is None:
+                # Shutdown edge: the loop ended between drain and
+                # flush — fall back to the actor's last known planes
+                # (one record's priorities slightly stale, not lost).
+                q_max = self._last_flush_q.get(actor)
+            q_start = emitted.pop("q_start")
+            boot_lane = emitted.pop("boot_lane")
+            boot_q = emitted.pop("boot_q")
+            boot = (q_max[boot_lane] if q_max is not None
+                    else np.zeros_like(q_start))
+            # Episode-end windows pinned their own in-band bootstrap q
+            # (the flush q below was computed on the POST-reset obs —
+            # the wrong episode for them); within-episode windows
+            # (boot_q NaN) bootstrap from this flush exactly.
+            boot = np.where(np.isnan(boot_q), boot, boot_q)
+            prios = np.abs(q_start
+                           - (emitted["reward"] + emitted["discount"]
+                              * boot))
+            with self.tracer.span("priority.actor_insert",
+                                  count=int(prios.shape[0])):
+                self.replay.add(emitted, priorities=prios,
+                                shard=self.router.shard_for(actor))
+            self._tm_actor_prio.inc(int(prios.shape[0]))
+        self._flush_q.clear()
 
     def _pop_boot_batch(self, force: bool = False):
         """Take up to ``_PRIO_MAX_ROWS`` pending transitions for one
@@ -1511,7 +1825,9 @@ class ApexLearnerService:
         # Close the pipelined-bootstrap window first: transitions whose
         # priorities are still in flight (up to a few _PRIO_CHUNKs of
         # the NEWEST experience) must land in the shard before it is
-        # snapshotted, or a crash-resume permanently drops them.
+        # snapshotted, or a crash-resume permanently drops them. Same
+        # for actor-priority transitions parked on this pass's flush.
+        self._insert_actor_prio()
         self._flush_pending(force=True)
         # Same for accumulated-but-unapplied learner priorities: the
         # snapshot must carry the freshest |TD| mass the learner computed.
@@ -1568,6 +1884,34 @@ class ApexLearnerService:
         path — the fan-in stress test (tests/test_fanin_stress.py) drives
         it directly with synthesized 256-actor record streams."""
         drained = False
+        # Zero-copy slot rings (ISSUE 9): one SPSC ring per local actor
+        # — no socket stack, no shared-ring contention, records decode
+        # to views over one owned copy out of the slot.
+        for actor_id, ring in self._zc_rings.items():
+            for _ in range(burst):
+                rec = ring.pop()
+                if rec is None:
+                    break
+                drained = True
+                try:
+                    with self.tracer.span("ingest.shm_record"):
+                        self._handle_record(rec, transport_kind="shm")
+                except self.HelloRejectedError:
+                    raise      # local build drift: fail loudly at connect
+                except Exception as e:
+                    # Same degrade-don't-die boundary as the TCP drain:
+                    # a record rejected at the codec gate (chaos
+                    # ingest.decode, a torn-then-garbled slot) must
+                    # cost ONE record, not the training run. The
+                    # lock-step actor's lane stalls; the ingest stall
+                    # watchdog + supervision own that recovery.
+                    self.bad_records += 1
+                    self._tm_bad_records.inc()
+                    if self.bad_records <= 5:
+                        self.log.log_fn(
+                            f"# bad shm record actor {actor_id} "
+                            f"({self.bad_records}): "
+                            f"{type(e).__name__}: {e}")
         for _ in range(burst):
             rec = self.req_ring.pop()
             if rec is None:
@@ -1584,7 +1928,8 @@ class ApexLearnerService:
                 conn_id, payload = rec
                 try:
                     with self.tracer.span("ingest.tcp_record"):
-                        self._handle_record(payload, conn_id=conn_id)
+                        self._handle_record(payload, conn_id=conn_id,
+                                            transport_kind="tcp")
                 except Exception as e:
                     # Network input is untrusted (the listener may face
                     # other hosts): a malformed or misrouted record must
@@ -1654,6 +1999,7 @@ class ApexLearnerService:
                     os._exit(137)
                 drained = self._drain_transports()
                 self._flush_act_queue()
+                self._insert_actor_prio()
                 self._flush_pending()
                 hb_ingest.beat()
                 self._maybe_train()
@@ -1717,6 +2063,7 @@ class ApexLearnerService:
                                 self.episodes_completed))
                     self.log.flush()
                     last_log = now
+            self._insert_actor_prio()
             self._flush_pending(force=True)
             self._finalize_all_train()
             if self._eval_thread is not None:
@@ -1733,6 +2080,18 @@ class ApexLearnerService:
             self.tracer.close()
             self.shutdown()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
+                # Zero-copy ingest provenance (ISSUE 9): which transport
+                # carried the run, what it cost on the wire, and where
+                # the sticky router placed it.
+                "transport": self.rt.transport,
+                "actor_priorities": bool(self._act_q is not None),
+                "ingest_bytes": dict(self.router.bytes_by_transport),
+                "bytes_on_wire": int(
+                    sum(self.router.bytes_by_transport.values())),
+                "records_by_shard": dict(self.router.records_by_shard),
+                "replay_added_by_shard": dict(
+                    getattr(self.replay, "added_by_shard", {}) or {}),
+                "ingest_decode_errors": self.router.decode_errors,
                 # Learner-utilization config provenance (ISSUE 6).
                 "replay_ratio": self.replay_ratio,
                 "train_batch": self.train_batch,

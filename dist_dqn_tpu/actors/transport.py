@@ -319,11 +319,20 @@ MAX_FRAME_BYTES = 256 << 20
 #: out the stall bound for an action that will never come.
 CORRUPT_FRAME_NACK_KIND = "corrupt_frame"
 
+#: Reply-channel control record (ISSUE 9 satellite): the hello declared
+#: a wire protocol version / transport mode this service does not
+#: speak. Unlike corrupt_frame this is NOT churn — the actor must fail
+#: loudly (build drift), not reconnect-retry. ``meta["detail"]``
+#: carries the human-readable reason.
+PROTO_MISMATCH_NACK_KIND = "proto_mismatch"
 
-def frame_encode(payload: bytes) -> bytes:
-    """One integrity-framed wire record."""
-    return _FRAME_HDR.pack(FRAME_MAGIC, len(payload),
-                           zlib.crc32(payload)) + payload
+
+def frame_encode(payload) -> bytes:
+    """One integrity-framed wire record (accepts any bytes-like payload,
+    e.g. the zero-copy encoder's memoryview — one join, no extra
+    copies)."""
+    return b"".join((_FRAME_HDR.pack(FRAME_MAGIC, len(payload),
+                                     zlib.crc32(payload)), payload))
 
 
 def _frame_check(payload: bytes, want_crc: int) -> bool:

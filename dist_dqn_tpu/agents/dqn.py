@@ -352,16 +352,24 @@ def make_scan_train(train_step: Callable) -> Callable:
     return scan_train
 
 
-def make_actor_step(net: nn.Module) -> Callable:
+def make_actor_step(net: nn.Module, return_q: bool = False) -> Callable:
     """Epsilon-greedy acting on scalar Q-values (any head type).
 
     act(params, obs, rng, epsilon) -> actions [B]. With a NoisyNet head,
     exploration comes from parameter noise: pass epsilon=0 and noise is drawn
     per call from ``rng``.
+
+    ``return_q=True`` also returns the inference-time Q planes —
+    ``(actions, q_sel, q_max)`` with ``q_sel = Q(obs, action_taken)``
+    (the TAKEN action, exploratory or greedy) and ``q_max = max_a Q`` —
+    both f32. The zero-copy ingest path (ISSUE 9) ships these planes in
+    the act reply so actors can echo them on their step frames and the
+    learner seeds insertion priorities with zero extra dispatches (the
+    feed-forward twin of the R2D2 ``return_q`` acting path).
     """
     noisy = getattr(net, "noisy", False)
 
-    def act(params: PyTree, obs: Array, rng: Array, epsilon: Array) -> Array:
+    def act(params: PyTree, obs: Array, rng: Array, epsilon: Array):
         k_noise, k_eps, k_rand = jax.random.split(rng, 3)
         rngs = {"noise": k_noise} if noisy else None
         q = net.apply(params, obs, add_noise=noisy, rngs=rngs,
@@ -370,6 +378,11 @@ def make_actor_step(net: nn.Module) -> Callable:
         random_a = jax.random.randint(k_rand, greedy.shape, 0,
                                       net.num_actions)
         explore = jax.random.uniform(k_eps, greedy.shape) < epsilon
-        return jnp.where(explore, random_a, greedy)
+        actions = jnp.where(explore, random_a, greedy)
+        if not return_q:
+            return actions
+        q_sel = jnp.take_along_axis(q, actions[:, None], axis=-1)[:, 0]
+        return (actions, q_sel.astype(jnp.float32),
+                jnp.max(q, axis=-1).astype(jnp.float32))
 
     return act

@@ -57,6 +57,15 @@ SEAMS: Dict[str, Tuple[str, ...]] = {
     "host_replay.chunk": ("crash",),
     # actors/service.py run loop (learner-process kill for game days).
     "service.loop": ("crash",),
+    # ingest/shm_ring.py ShmSlotRing.push (the zero-copy same-host
+    # publish; ISSUE 9). "torn" = die-mid-write semantics: the seq
+    # advances but the seqlock stamp stays odd — the consumer must
+    # drop + count, never decode.
+    "shm.publish": ("torn", "stall", "drop"),
+    # ingest/codec.py StepDecoder.decode (the zero-copy record gate,
+    # applied to the payload BEFORE validation — a corrupt record must
+    # reject whole, mirroring the transport.recv bit_flip invariant).
+    "ingest.decode": ("bit_flip", "truncate"),
 }
 
 

@@ -1,0 +1,34 @@
+"""Zero-copy ingest subsystem (ISSUE 9, ROADMAP item 4).
+
+The experience path between actors and the learner, rebuilt around a
+one-time schema negotiation instead of per-record self-description:
+
+* ``schema``   — :class:`TrajectorySchema` + :data:`PROTOCOL_VERSION`,
+  the dtype/shape contract negotiated at hello;
+* ``codec``    — fixed-header zero-copy frames (encode into one
+  reusable buffer, decode to views), layered under the ISSUE 8
+  magic/len/CRC32 TCP integrity frame;
+* ``shm_ring`` — seqlock-stamped SPSC slot ring over
+  ``multiprocessing.shared_memory`` for same-host actors (no socket
+  stack on the local path);
+* ``router``   — sticky actor -> replay-shard assignment + the
+  ``dqn_ingest_*`` telemetry families.
+
+The legacy JSON-header codec (``actors/transport.py``) remains the
+bit-pinned fallback behind ``--transport legacy``; both codecs share
+the TCP framing and chaos seams, so corruption handling is identical.
+Package contract: stdlib + numpy only — importable from jax-free actor
+processes.
+"""
+from dist_dqn_tpu.ingest.codec import (FLAG_HAS_Q, KIND_REPLY,  # noqa: F401
+                                       KIND_STEP, ProtocolMismatchError,
+                                       StepDecoder, StepEncoder,
+                                       WireFormatError, decode_reply,
+                                       encode_reply, is_zc,
+                                       max_record_bytes, peek_header)
+from dist_dqn_tpu.ingest.router import (StickyShardRouter,  # noqa: F401
+                                        shard_for)
+from dist_dqn_tpu.ingest.schema import (PROTOCOL_VERSION,  # noqa: F401
+                                        FieldSpec, TrajectorySchema,
+                                        step_schema)
+from dist_dqn_tpu.ingest.shm_ring import ShmSlotRing  # noqa: F401

@@ -1,0 +1,291 @@
+"""Zero-copy wire codec (ISSUE 9 tentpole piece 1).
+
+One record = a 20-byte fixed header + the raw C-order array bytes of a
+negotiated :class:`~dist_dqn_tpu.ingest.schema.TrajectorySchema`, in
+declaration order, optionally followed by the actor-side priority
+planes (``q_sel``/``q_max``, f32 per lane) when ``FLAG_HAS_Q`` is set::
+
+    0      2      4     5     6       8        12       16      18      20
+    +------+------+-----+-----+-------+--------+--------+-------+-------+
+    |"ZC"  | ver  |kind |flags| shard | actor  |   t    | lanes | rsvd  |
+    +------+------+-----+-----+-------+--------+--------+-------+-------+
+    | field 0 bytes | field 1 bytes | ... | [q_sel f32] | [q_max f32]   |
+    +---------------------------------------------------------------+
+
+Layering: this is the PAYLOAD format. On TCP it rides UNCHANGED under
+the ISSUE 8 integrity frame (``magic|len|crc32`` — corruption handling
+identical to the legacy codec); on the same-host path it is the slot
+body of ``ingest/shm_ring.py``. The encoder writes every field straight
+into one reusable buffer (no per-field ``tobytes`` copies, no JSON, no
+pickle); the decoder returns ``np.frombuffer`` VIEWS into the received
+buffer — zero copies on either side beyond the wire itself.
+
+Aliasing contract: decoded arrays alias the payload buffer passed to
+``decode`` — valid for as long as the caller keeps that buffer (both
+transports hand over owned ``bytes``). Encoded views alias the
+encoder's scratch — consumed (sent / ring-published) before the next
+``encode`` call by every caller in this repo.
+
+``scripts/check_wire.py`` pins the header layout: any field change must
+bump :data:`~dist_dqn_tpu.ingest.schema.PROTOCOL_VERSION` and record
+the new fingerprint in :data:`WIRE_HISTORY`.
+
+Stdlib + numpy only (jax-free actor processes).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.ingest.schema import PROTOCOL_VERSION, TrajectorySchema
+
+#: The frame-header layout, field by field. ``scripts/check_wire.py``
+#: fingerprints THIS tuple (plus the kind/flag registries below): edit
+#: it and the lint fails until PROTOCOL_VERSION is bumped and the new
+#: digest recorded in WIRE_HISTORY.
+WIRE_HEADER_FIELDS = (
+    ("magic", "2s"),        # b"ZC" — dispatch vs the legacy JSON codec
+    ("version", "H"),       # PROTOCOL_VERSION; mismatch fails at decode
+    ("kind", "B"),          # KIND_* record type
+    ("flags", "B"),         # FLAG_* bitfield
+    ("shard", "H"),         # sticky replay-shard id (ingest/router.py)
+    ("actor", "I"),         # fleet-unique actor id
+    ("t", "I"),             # actor step counter (lock-step protocol)
+    ("lanes", "H"),         # vector-env width; must match the schema
+    ("reserved", "H"),      # zero; room for one future field w/o resize
+)
+_HDR = struct.Struct("<" + "".join(fmt for _, fmt in WIRE_HEADER_FIELDS))
+HEADER_BYTES = _HDR.size
+
+MAGIC = b"ZC"
+KIND_STEP = 1               # actor -> learner trajectory step record
+KIND_REPLY = 2              # learner -> actor action (+ q-plane) reply
+WIRE_KINDS = {"step": KIND_STEP, "reply": KIND_REPLY}
+FLAG_HAS_Q = 0x01           # q_sel/q_max f32[lanes] planes appended
+WIRE_FLAGS = {"has_q": FLAG_HAS_Q}
+
+_F32 = np.dtype(np.float32)
+_I32 = np.dtype(np.int32)
+
+#: protocol version -> wire fingerprint (scripts/check_wire.py digest
+#: over WIRE_HEADER_FIELDS + WIRE_KINDS + WIRE_FLAGS). Append-only: a
+#: header change lands as a NEW (version, digest) pair; rewriting an
+#: existing entry is the drift the lint exists to block.
+WIRE_HISTORY = {
+    2: "4322d42d8ca0fadd",
+}
+
+
+class WireFormatError(ValueError):
+    """A payload that violates the zero-copy wire format (bad magic,
+    wrong kind/lanes/length). The record is rejected whole — a frame
+    that fails here never reaches the arrays."""
+
+
+class ProtocolMismatchError(WireFormatError):
+    """Peer speaks a different PROTOCOL_VERSION — fail loudly at the
+    connection level instead of desyncing mid-stream."""
+
+
+def is_zc(payload) -> bool:
+    """Codec dispatch: zero-copy payloads lead with the ZC magic. The
+    legacy JSON-header codec leads with a little-endian u32 header
+    length, so a collision would require a legacy header of exactly
+    0x..435A (>17 KB) bytes — far beyond any real header, and even then
+    the ZC version/length gates reject the record loudly rather than
+    mis-decoding it."""
+    return bytes(payload[:2]) == MAGIC
+
+
+def peek_header(payload) -> Dict[str, int]:
+    """Header fields of a ZC payload without touching the body."""
+    if len(payload) < HEADER_BYTES:
+        raise WireFormatError(
+            f"short ZC payload: {len(payload)} < header {HEADER_BYTES}")
+    magic, version, kind, flags, shard, actor, t, lanes, _ = \
+        _HDR.unpack_from(payload, 0)
+    if magic != MAGIC:
+        raise WireFormatError(f"bad ZC magic {magic!r}")
+    if version != PROTOCOL_VERSION:
+        raise ProtocolMismatchError(
+            f"wire protocol {version} != local {PROTOCOL_VERSION} — "
+            f"peer runs a different build; upgrade in lockstep")
+    return {"kind": kind, "flags": flags, "shard": shard, "actor": actor,
+            "t": t, "lanes": lanes}
+
+
+class StepEncoder:
+    """Encode step records into ONE reusable buffer.
+
+    Each field is copied exactly once, from the caller's array straight
+    into the scratch at its schema offset (``np.frombuffer`` views over
+    the scratch — no intermediate ``tobytes``). Returns a memoryview;
+    callers transfer it (socket send / ring publish) before the next
+    ``encode`` call.
+    """
+
+    def __init__(self, schema: TrajectorySchema):
+        self.schema = schema
+        self._q_off = HEADER_BYTES + schema.record_bytes
+        self._buf = bytearray(self._q_off + 2 * 4 * schema.lanes)
+        # Per-field destination views, built once.
+        self._views = []
+        off = HEADER_BYTES
+        for f in schema.fields:
+            dt = np.dtype(f.dtype)
+            count = schema.lanes
+            for s in f.shape:
+                count *= s
+            dst = np.frombuffer(self._buf, dtype=dt, count=count,
+                                offset=off).reshape(
+                                    (schema.lanes,) + f.shape)
+            self._views.append((f.name, dst))
+            off += count * dt.itemsize
+        lanes = schema.lanes
+        self._q_sel = np.frombuffer(self._buf, _F32, lanes, self._q_off)
+        self._q_max = np.frombuffer(self._buf, _F32, lanes,
+                                    self._q_off + 4 * lanes)
+
+    def encode_step(self, arrays: Dict[str, np.ndarray], actor: int,
+                    t: int, shard: int = 0,
+                    q_sel: Optional[np.ndarray] = None,
+                    q_max: Optional[np.ndarray] = None) -> memoryview:
+        flags = 0
+        end = self._q_off
+        for name, dst in self._views:
+            np.copyto(dst, arrays[name], casting="same_kind")
+        if q_sel is not None:
+            flags |= FLAG_HAS_Q
+            np.copyto(self._q_sel, q_sel, casting="same_kind")
+            np.copyto(self._q_max, q_max, casting="same_kind")
+            end += 2 * 4 * self.schema.lanes
+        _HDR.pack_into(self._buf, 0, MAGIC, PROTOCOL_VERSION, KIND_STEP,
+                       flags, shard, actor, t, self.schema.lanes, 0)
+        return memoryview(self._buf)[:end]
+
+
+class StepDecoder:
+    """Decode step records into views over the received buffer.
+
+    Validates magic / version / kind / lanes / EXACT length before any
+    array is built — a truncated or mis-schema'd payload raises
+    :class:`WireFormatError` whole, mirroring the legacy codec's
+    corruption posture (a bad record never becomes training data).
+    """
+
+    def __init__(self, schema: TrajectorySchema):
+        self.schema = schema
+        self._layout = []
+        off = HEADER_BYTES
+        for f in schema.fields:
+            dt = np.dtype(f.dtype)
+            count = schema.lanes
+            for s in f.shape:
+                count *= s
+            self._layout.append(
+                (f.name, dt, (schema.lanes,) + f.shape, count, off))
+            off += count * dt.itemsize
+        self._base = off
+        self._with_q = off + 2 * 4 * schema.lanes
+
+    def decode(self, payload,
+               hdr: Optional[Dict[str, int]] = None
+               ) -> Tuple[Dict[str, np.ndarray], Dict]:
+        """-> (field arrays, meta). Meta carries actor/t/shard plus the
+        ``q_sel``/``q_max`` planes when the frame shipped them.
+
+        ``hdr``: a header already parsed by ``peek_header`` on the SAME
+        payload — the ingest loop peeks once to route to the actor's
+        decoder, and passing it here avoids a second unpack per record
+        on the hot path."""
+        ev = chaos.fire("ingest.decode")
+        if ev is not None:
+            # Corrupt BEFORE validation: the gates below must reject the
+            # record whole — the ISSUE 8 invariant (corruption never
+            # decodes) extended to the zero-copy path. bit_flip targets
+            # the HEADER (the codec's own validation surface); body
+            # integrity belongs to the TCP CRC frame / shm seqlock.
+            if ev.fault == "bit_flip":
+                payload = (chaos.corrupt_bytes(
+                    bytes(payload[:HEADER_BYTES]), ev)
+                    + bytes(payload[HEADER_BYTES:]))
+            elif ev.fault == "truncate":
+                payload = chaos.truncate_bytes(bytes(payload), ev)
+            hdr = None      # the bytes changed: re-validate them
+        if hdr is None:
+            hdr = peek_header(payload)
+        if hdr["kind"] != KIND_STEP:
+            raise WireFormatError(f"expected step record, got kind "
+                                  f"{hdr['kind']}")
+        if hdr["lanes"] != self.schema.lanes:
+            raise WireFormatError(
+                f"record lanes {hdr['lanes']} != schema "
+                f"{self.schema.lanes}")
+        want = self._with_q if hdr["flags"] & FLAG_HAS_Q else self._base
+        if len(payload) != want:
+            raise WireFormatError(
+                f"record length {len(payload)} != schema-required {want} "
+                f"(flags={hdr['flags']:#x})")
+        out = {
+            name: np.frombuffer(payload, dtype=dt, count=count,
+                                offset=off).reshape(shape)
+            for name, dt, shape, count, off in self._layout
+        }
+        meta = {"kind": "step", "actor": hdr["actor"], "t": hdr["t"],
+                "shard": hdr["shard"]}
+        if hdr["flags"] & FLAG_HAS_Q:
+            lanes = self.schema.lanes
+            meta["q_sel"] = np.frombuffer(payload, _F32, lanes, self._base)
+            meta["q_max"] = np.frombuffer(payload, _F32, lanes,
+                                          self._base + 4 * lanes)
+        chaos.mark_recovered("ingest.decode")
+        return out, meta
+
+
+def encode_reply(action: np.ndarray, actor: int, t: int, shard: int = 0,
+                 q_sel: Optional[np.ndarray] = None,
+                 q_max: Optional[np.ndarray] = None) -> bytes:
+    """Learner -> actor reply: actions (+ optional q planes the actor
+    folds into its NEXT step frame — the actor-side priority loop).
+    Replies are small (a few bytes per lane); a fresh bytes object per
+    reply keeps the mailbox/connection write simple."""
+    lanes = int(action.shape[0])
+    flags = FLAG_HAS_Q if q_sel is not None else 0
+    parts = [_HDR.pack(MAGIC, PROTOCOL_VERSION, KIND_REPLY, flags, shard,
+                       actor, t, lanes, 0),
+             np.ascontiguousarray(action, _I32).tobytes()]
+    if q_sel is not None:
+        parts.append(np.ascontiguousarray(q_sel, _F32).tobytes())
+        parts.append(np.ascontiguousarray(q_max, _F32).tobytes())
+    return b"".join(parts)
+
+
+def decode_reply(payload) -> Tuple[np.ndarray, Optional[np.ndarray],
+                                   Optional[np.ndarray], Dict]:
+    """-> (actions, q_sel | None, q_max | None, header meta)."""
+    hdr = peek_header(payload)
+    if hdr["kind"] != KIND_REPLY:
+        raise WireFormatError(f"expected reply record, got kind "
+                              f"{hdr['kind']}")
+    lanes = hdr["lanes"]
+    want = HEADER_BYTES + 4 * lanes \
+        + (8 * lanes if hdr["flags"] & FLAG_HAS_Q else 0)
+    if len(payload) != want:
+        raise WireFormatError(
+            f"reply length {len(payload)} != required {want}")
+    action = np.frombuffer(payload, _I32, lanes, HEADER_BYTES)
+    q_sel = q_max = None
+    if hdr["flags"] & FLAG_HAS_Q:
+        off = HEADER_BYTES + 4 * lanes
+        q_sel = np.frombuffer(payload, _F32, lanes, off)
+        q_max = np.frombuffer(payload, _F32, lanes, off + 4 * lanes)
+    return action, q_sel, q_max, hdr
+
+
+def max_record_bytes(schema: TrajectorySchema) -> int:
+    """Worst-case encoded step size (header + body + q planes) — the
+    shm slot-sizing input."""
+    return HEADER_BYTES + schema.record_bytes + 2 * 4 * schema.lanes

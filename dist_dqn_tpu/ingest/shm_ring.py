@@ -1,0 +1,191 @@
+"""Seqlock-stamped shared-memory slot ring (ISSUE 9 tentpole piece 2).
+
+Same-host actors should not pay the socket stack (syscalls, TCP framing,
+kernel buffer copies) to hand the learner a record that already lives in
+the same DRAM. This ring is a single-producer / single-consumer slot
+ring over ``multiprocessing.shared_memory``: the actor publishes a
+zero-copy record (``ingest/codec.py``) straight into a fixed-size slot;
+the learner copies it out once (ownership transfer) and decodes views
+over that copy. One ring per actor — the SPSC discipline is what makes
+the design lock-free — selected automatically by the service whenever
+actor and learner share a host and ``transport="zerocopy"``.
+
+Layout::
+
+    header (32 B): u64 nslots | u64 slot_size | u64 write_seq | u64 read_seq
+    slot i (16 B + slot_size): u64 stamp | u32 length | u32 rsvd | payload
+
+Seqlock-style generation stamps: the producer writes ``2*seq + 1`` (odd
+= in flight) before touching the slot body and ``2*seq + 2`` (even,
+unique per wraparound reuse) after, THEN advances ``write_seq``; the
+consumer re-checks the stamp after its copy. Under the SPSC index
+discipline a torn read cannot happen organically — the stamp is the
+belt-and-braces detector for a producer that died mid-write (or a chaos
+``shm.publish: torn`` injection): the record is dropped and counted
+(``dqn_ingest_shm_torn_reads_total``), never decoded.
+
+Stdlib + numpy only (actors are jax-free).
+"""
+from __future__ import annotations
+
+import time
+from multiprocessing import shared_memory
+from typing import Optional
+
+import numpy as np
+
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.telemetry import get_registry
+from dist_dqn_tpu.telemetry.collectors import INGEST_SHM_TORN
+
+HEADER_BYTES = 32
+SLOT_HEADER_BYTES = 16
+# Header u64 indices.
+_NSLOTS, _SLOT_SIZE, _WRITE_SEQ, _READ_SEQ = 0, 1, 2, 3
+
+
+class ShmSlotRing:
+    """SPSC byte-record ring over POSIX shared memory.
+
+    ``create=True`` (the learner service) allocates and owns unlink;
+    actors attach. If the service dies without its shutdown path, the
+    inherited resource tracker unlinks the leaked segment at exit.
+    """
+
+    def __init__(self, name: str, slot_size: int = 0, nslots: int = 0,
+                 create: bool = False):
+        self.name = name
+        if create:
+            if slot_size <= 0 or nslots <= 0:
+                raise ValueError("create=True requires slot_size and "
+                                 "nslots")
+            total = HEADER_BYTES + nslots * (SLOT_HEADER_BYTES + slot_size)
+            self._shm = shared_memory.SharedMemory(name=name, create=True,
+                                                   size=total)
+            hdr = np.frombuffer(self._shm.buf, np.uint64, 4)
+            hdr[_NSLOTS] = nslots
+            hdr[_SLOT_SIZE] = slot_size
+            hdr[_WRITE_SEQ] = 0
+            hdr[_READ_SEQ] = 0
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            # CPython 3.10 registers ATTACHMENTS with the resource
+            # tracker too (bpo-39959). Spawned workers inherit the
+            # parent's tracker, whose cache is a name set — the
+            # double-register collapses and the creator's unlink()
+            # clears it, so no correction is needed here; unregistering
+            # on attach would instead strand the creator's entry.
+        self._hdr = np.frombuffer(self._shm.buf, np.uint64, 4)
+        self.nslots = int(self._hdr[_NSLOTS])
+        self.slot_size = int(self._hdr[_SLOT_SIZE])
+        self._stride = SLOT_HEADER_BYTES + self.slot_size
+        # Per-slot stamp/length views, strided over the buffer.
+        n = self.nslots
+        self._stamps = [
+            np.frombuffer(self._shm.buf, np.uint64, 1,
+                          HEADER_BYTES + i * self._stride)
+            for i in range(n)]
+        self._lengths = [
+            np.frombuffer(self._shm.buf, np.uint32, 1,
+                          HEADER_BYTES + i * self._stride + 8)
+            for i in range(n)]
+        self.torn_reads = 0
+        self._c_torn = get_registry().counter(
+            INGEST_SHM_TORN,
+            "shm slot-ring records dropped on a stamp mismatch "
+            "(producer died mid-write or injected torn publish)")
+
+    def _slot_data(self, i: int) -> memoryview:
+        off = HEADER_BYTES + i * self._stride + SLOT_HEADER_BYTES
+        return self._shm.buf[off:off + self.slot_size]
+
+    # -- producer ----------------------------------------------------------
+    def push(self, payload) -> bool:
+        """Publish one record; False when the ring is full (caller
+        retries — the lock-step actor protocol keeps at most one record
+        in flight, so a full ring means the learner is behind)."""
+        n = len(payload)
+        if n > self.slot_size:
+            raise ValueError(f"record of {n} bytes exceeds slot_size "
+                             f"{self.slot_size}")
+        ev = chaos.fire("shm.publish")
+        if ev is not None:
+            if ev.fault == "drop":
+                # Simulated loss: report success, publish nothing — the
+                # stall watchdog / supervision path must recover.
+                return True
+            if ev.fault == "stall":
+                chaos.sleep_for(ev)
+        w = int(self._hdr[_WRITE_SEQ])
+        if w - int(self._hdr[_READ_SEQ]) >= self.nslots:
+            return False
+        i = w % self.nslots
+        self._stamps[i][0] = 2 * w + 1          # odd: write in flight
+        self._lengths[i][0] = n
+        self._slot_data(i)[:n] = payload
+        if ev is not None and ev.fault == "torn":
+            # Die-mid-write semantics: the seq advances but the stamp
+            # stays odd — the consumer must detect and drop, never
+            # decode. (Recovery proof = the next clean publish.)
+            self._hdr[_WRITE_SEQ] = w + 1
+            return True
+        self._stamps[i][0] = 2 * w + 2          # even: published
+        self._hdr[_WRITE_SEQ] = w + 1
+        chaos.mark_recovered("shm.publish")
+        return True
+
+    def push_wait(self, payload, stop=lambda: False,
+                  poll_s: float = 0.0005) -> bool:
+        """Blocking push: retry until published or ``stop()``."""
+        while not self.push(payload):
+            if stop():
+                return False
+            time.sleep(poll_s)
+        return True
+
+    # -- consumer ----------------------------------------------------------
+    def pop(self) -> Optional[bytes]:
+        """Next record as an OWNED bytes copy (the one copy of the shm
+        path — ownership transfer out of the reusable slot), or None
+        when empty. Torn records are counted and skipped."""
+        r = int(self._hdr[_READ_SEQ])
+        if r >= int(self._hdr[_WRITE_SEQ]):
+            return None
+        i = r % self.nslots
+        want = np.uint64(2 * r + 2)
+        if self._stamps[i][0] != want:
+            self.torn_reads += 1
+            self._c_torn.inc()
+            self._hdr[_READ_SEQ] = r + 1
+            return None
+        n = int(self._lengths[i][0])
+        out = bytes(self._slot_data(i)[:n])
+        if self._stamps[i][0] != want:          # torn during the copy
+            self.torn_reads += 1
+            self._c_torn.inc()
+            self._hdr[_READ_SEQ] = r + 1
+            return None
+        self._hdr[_READ_SEQ] = r + 1
+        return out
+
+    @property
+    def pending(self) -> int:
+        return int(self._hdr[_WRITE_SEQ]) - int(self._hdr[_READ_SEQ])
+
+    def close(self) -> None:
+        # Drop every numpy/memoryview alias BEFORE SharedMemory.close():
+        # an exported buffer pointer keeps the mmap pinned and close()
+        # raises BufferError.
+        self._hdr = None
+        self._stamps = []
+        self._lengths = []
+        try:
+            self._shm.close()
+        except BufferError:
+            pass
+
+    def unlink(self) -> None:
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass

@@ -338,6 +338,9 @@ class PrioritizedHostReplay:
         # Cumulative counters for metrics (BASELINE.json:2 throughput).
         self.added = 0
         self.sampled = 0
+        # Sticky-ingest placement accounting (ISSUE 9): items per
+        # routing shard — shard count is 1 until ROADMAP item 1.
+        self.added_by_shard: Dict[int, int] = {}
         # Telemetry (ISSUE 1): occupancy/eviction/priority-distribution
         # for the host shard. Instruments are cached here — the add/
         # sample hot paths pay one attribute op + one locked float add.
@@ -380,9 +383,19 @@ class PrioritizedHostReplay:
             }
 
     def add(self, items: Dict[str, np.ndarray],
-            priorities: Optional[np.ndarray] = None) -> None:
-        """Ring-write a batch; new items default to the running max priority."""
+            priorities: Optional[np.ndarray] = None,
+            shard: Optional[int] = None) -> None:
+        """Ring-write a batch; new items default to the running max priority.
+
+        ``shard`` is the sticky-ingest routing tag (ingest/router.py,
+        ISSUE 9): today the service owns ONE shard and the tag is pure
+        accounting (``added_by_shard``); when ROADMAP item 1 shards the
+        store, this is the append-path hook that places the batch in
+        the shard that will sample it."""
         batch = next(iter(items.values())).shape[0]
+        if shard is not None:
+            self.added_by_shard[shard] = \
+                self.added_by_shard.get(shard, 0) + batch
         self._ensure_storage(items)
         idx = (self._pos + np.arange(batch)) % self.capacity
         for k, v in items.items():

@@ -120,6 +120,26 @@ TRANSPORT_CORRUPT = "dqn_transport_corrupt_frames_total"
 TRANSPORT_SHED = "dqn_transport_tcp_shed_total"
 INGEST_DEGRADED = "dqn_ingest_degraded"
 
+# Zero-copy ingest subsystem (ISSUE 9): the schema-negotiated
+# experience path (dist_dqn_tpu/ingest/). RECORDS/BYTES are labeled
+# {transport="shm"|"tcp"|"legacy"} (slot ring / zero-copy wire / the
+# JSON-codec fallback paths); SHARD_RECORDS counts sticky-router
+# placement per {shard} (shard count is 1 until ROADMAP item 1 lands —
+# the family exists NOW so the scale-out is a config change);
+# DECODE_ERRORS counts records rejected whole at the codec gate per
+# {reason}; SHM_TORN counts slot-ring records dropped on a seqlock
+# stamp mismatch; ACTOR_PRIO_TRANSITIONS counts transitions inserted
+# with frame-shipped |TD| priorities (zero learner-side bootstrap
+# dispatches — the ISSUE 9 acceptance pin).
+INGEST_RECORDS = "dqn_ingest_records_total"
+INGEST_BYTES = "dqn_ingest_bytes_total"
+INGEST_SHARDS = "dqn_ingest_shards"
+INGEST_SHARD_RECORDS = "dqn_ingest_shard_records_total"
+INGEST_DECODE_ERRORS = "dqn_ingest_decode_errors_total"
+INGEST_SHM_TORN = "dqn_ingest_shm_torn_reads_total"
+INGEST_ACTOR_PRIO_TRANSITIONS = \
+    "dqn_ingest_actor_priority_transitions_total"
+
 # Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
 # heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
 # (the full stage table is in docs/observability.md), divergence trips

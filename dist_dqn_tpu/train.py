@@ -556,6 +556,24 @@ def main():
                         help="apex runtime: sample the host replay shard's "
                              "priorities ON DEVICE (Pallas stratified "
                              "kernel; items stay in host DRAM)")
+    parser.add_argument("--transport", choices=("zerocopy", "legacy"),
+                        default="zerocopy",
+                        help="apex runtime experience path (ISSUE 9): "
+                             "zerocopy = schema-negotiated raw-array "
+                             "frames (shm slot rings locally, zero-copy "
+                             "framing on TCP) with actor-shipped "
+                             "priorities; legacy = the bit-pinned "
+                             "JSON-codec fallback")
+    parser.add_argument("--no-actor-priorities", action="store_true",
+                        help="apex runtime: keep the learner-side "
+                             "priority bootstrap dispatches even on "
+                             "--transport zerocopy (A/B baseline; "
+                             "re-enables native assembly)")
+    parser.add_argument("--ingest-shards", type=int, default=1,
+                        help="apex runtime: sticky replay-shard count "
+                             "for ingest routing (must stay 1 until the "
+                             "sharded store lands; the id is threaded "
+                             "through frames + telemetry now)")
     parser.add_argument("--remote-actor-mode", choices=("local", "external"),
                         default="local",
                         help="local: the service spawns its remote actors "
@@ -759,10 +777,19 @@ def main():
             learner_devices=args.learner_devices,
             trace_path=args.trace_path,
             device_sampling=args.device_sampling,
+            transport=args.transport,
+            actor_priorities=not args.no_actor_priorities,
+            ingest_shards=args.ingest_shards,
             telemetry_port=args.telemetry_port,
             telemetry_host=args.telemetry_host)
         print(json.dumps(run_apex(cfg, rt)))
         return
+    if args.transport != parser.get_default("transport") \
+            or args.no_actor_priorities \
+            or args.ingest_shards != parser.get_default("ingest_shards"):
+        print("# --transport/--no-actor-priorities/--ingest-shards apply "
+              "to --runtime apex only (the fused/host-replay runtimes "
+              "have no actor transport); ignored")
     if args.no_double_buffer:
         print("# --no-double-buffer applies to --runtime host-replay only; "
               "ignored under the fused runtime (its replay never leaves "

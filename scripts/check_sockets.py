@@ -16,9 +16,14 @@ CREATED or ACCEPTED (``socket.socket(``, ``socket.create_connection(``,
     down out from under it).
 
 Stdlib ``http.server``/``socketserver`` internals are out of scope —
-the lint covers this repo's own call sites. Run from the repo root:
-``python scripts/check_sockets.py``. Wired into tier-1 via
-tests/test_sockets_lint.py.
+the lint covers this repo's own call sites: every package under
+``dist_dqn_tpu/`` including the zero-copy ingest subsystem
+(``dist_dqn_tpu/ingest/``, ISSUE 9 — its shm slot ring is socket-free
+by design, and this lint is what keeps a future wire helper there
+honest). REQUIRED_SUBPACKAGES makes the coverage explicit: the lint
+FAILS if a listed tree goes missing rather than silently scanning
+nothing. Run from the repo root: ``python scripts/check_sockets.py``.
+Wired into tier-1 via tests/test_sockets_lint.py.
 """
 from __future__ import annotations
 
@@ -34,9 +39,23 @@ ACQUIRE = re.compile(
 EVIDENCE = re.compile(r"settimeout\(|timeout\s*=|#\s*socket:")
 
 
+#: Subtrees the scan must actually see (guards against a refactor
+#: moving socket code out from under the rglob): the transport-bearing
+#: packages today.
+REQUIRED_SUBPACKAGES = ("actors", "ingest", "serving", "telemetry")
+
+
 def scan(repo_root: Path):
     failures = []
     pkg = repo_root / "dist_dqn_tpu"
+    # Coverage guard only for the real repo (the lint tests scan
+    # synthetic single-file trees, which legitimately lack subpackages).
+    if (repo_root / "scripts" / "check_sockets.py").exists():
+        for sub in REQUIRED_SUBPACKAGES:
+            if pkg.is_dir() and not (pkg / sub).is_dir():
+                failures.append(
+                    f"dist_dqn_tpu/{sub}/: expected subpackage missing "
+                    f"— update REQUIRED_SUBPACKAGES if it moved")
     for f in sorted(pkg.rglob("*.py")):
         lines = f.read_text().splitlines()
         for i, line in enumerate(lines):

@@ -106,10 +106,16 @@ def _drive_rounds(service, stream, ring, rounds):
 
 
 def _build_service(fused: bool, n_actors=32, lanes=16):
+    # transport="legacy": the fused act+bootstrap dispatch is the
+    # LEGACY experience path's optimization — on the zerocopy default
+    # (ISSUE 9) actors ship their |TD| planes in-frame and the ingest
+    # pass dispatches NO bootstrap at all (its stricter 1.0-calls/pass
+    # budget is pinned by tests/test_ingest.py); this file pins the
+    # fused-vs-split budget on the transport that owns it.
     rt = ApexRuntimeConfig(num_actors=n_actors, envs_per_actor=lanes,
                            total_env_steps=10 ** 9, ring_mb=8,
                            stall_warn_s=0.0, log_every_s=10 ** 9,
-                           fused_ingest=fused)
+                           fused_ingest=fused, transport="legacy")
     service = ApexLearnerService(_ingest_cfg(), rt,
                                  log_fn=lambda *a: None)
     ring = ShmRing(f"req_{service.run_id}")
