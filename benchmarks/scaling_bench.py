@@ -1,0 +1,183 @@
+"""n-chip scale-out measurement (ISSUE 10): the row that makes the
+trajectory measure SCALE-OUT, not just single-chip rate.
+
+Two legs, one emit-once JSON row (the bench.py ContractEmitter
+discipline):
+
+* **host-replay dp leg** — the same tiny run at ``dp=1`` and ``dp=N``
+  (``run_host_replay --mesh-devices``): aggregate and PER-CHIP
+  env-steps/sec and grad-steps/sec, so the row answers "what did the
+  extra chips buy" instead of hiding the division. On the 2-core dev
+  box the virtual CPU mesh shares those cores, so dpN/dp1 near 1.0 is
+  the honest expectation there — the row records the mechanism works
+  and what it costs; the chip battery records the real scaling.
+* **apex ingest-shard leg** — a real 4-actor fleet into a 2-shard
+  store: ``records_by_shard`` / ``replay_added_by_shard`` prove the
+  sticky crc32 spread end to end (skippable with --skip-apex; actor
+  processes need ~30s even at tiny sizes).
+
+Usage:
+  python benchmarks/scaling_bench.py [--allow-cpu]
+      [--force-host-devices 8] [--dp 0] [--chunks 12]
+      [--chunk-iters 100] [--lanes 8] [--skip-apex]
+
+``--force-host-devices N`` must be honored BEFORE jax initializes, so
+pass it on the command line (not via an env var set after import).
+Wired as a tpu_battery stage; tests/test_chip_benches.py smokes the
+CPU path so the harness cannot bit-rot.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--allow-cpu", action="store_true")
+    p.add_argument("--force-host-devices", type=int, default=0,
+                   help="CPU smoke: fake this many host devices "
+                        "(XLA --xla_force_host_platform_device_count; "
+                        "must be set before jax initializes, which is "
+                        "why it is a flag here and not an env you "
+                        "export after)")
+    p.add_argument("--dp", type=int, default=0,
+                   help="mesh width for the scaled leg (0 = all "
+                        "devices)")
+    p.add_argument("--lanes", type=int, default=8)
+    p.add_argument("--chunks", type=int, default=12)
+    p.add_argument("--chunk-iters", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--skip-apex", action="store_true",
+                   help="skip the actor-fleet ingest-shard leg "
+                        "(sub-second CI smokes)")
+    return p.parse_args()
+
+
+def _host_replay_leg(cfg, total, chunk_iters, dp):
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    out = run_host_replay(cfg, total_env_steps=total,
+                          chunk_iters=chunk_iters,
+                          log_fn=lambda s: None, mesh_devices=dp)
+    return {
+        "dp_size": out["dp_size"],
+        "env_steps_per_sec": out["env_steps_per_sec"],
+        "grad_steps_per_sec": out["grad_steps_per_sec"],
+        "env_steps_per_sec_per_chip": round(
+            out["env_steps_per_sec"] / out["dp_size"], 1),
+        "grad_steps_per_sec_per_chip": round(
+            out["grad_steps_per_sec"] / out["dp_size"], 1),
+        "grad_steps": out["grad_steps"],
+        "param_checksum": out["param_checksum"],
+    }
+
+
+def main() -> int:
+    args = _parse_args()
+    if args.force_host_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.force_host_devices}").strip()
+
+    from bench import ContractEmitter
+    from tpu_battery import gate_backend
+
+    contract = ContractEmitter(
+        "dp_scaling",
+        "aggregate + per-chip env-steps/sec and grad-steps/sec over the "
+        "dp mesh (host-replay runtime), with the apex sticky-shard "
+        "ingest spread")
+
+    platforms, gate_rc = gate_backend(args.allow_cpu, "scaling_bench")
+    if gate_rc is not None:
+        return gate_rc
+
+    try:
+        import jax
+
+        from dist_dqn_tpu.config import CONFIGS
+
+        dp = args.dp or len(jax.devices())
+        if dp < 2:
+            contract.error("mesh", f"only {len(jax.devices())} device(s) "
+                           "— a scaling row needs >= 2 (CPU smoke: "
+                           "--force-host-devices 8)")
+            return 1
+        lanes = args.lanes - args.lanes % dp or dp
+        # The train batch must divide over the mesh too (each shard
+        # draws an equal row block): round UP to a multiple of dp so a
+        # 32-device slice widens the batch instead of killing the
+        # battery stage on the divisibility gate.
+        batch = -(-args.batch_size // dp) * dp
+        cfg = CONFIGS["cartpole"]
+        cfg = dataclasses.replace(
+            cfg,
+            actor=dataclasses.replace(cfg.actor, num_envs=lanes),
+            network=dataclasses.replace(cfg.network, torso="mlp",
+                                        mlp_features=(64, 64), hidden=0,
+                                        compute_dtype="float32"),
+            replay=dataclasses.replace(cfg.replay, capacity=65536,
+                                       min_fill=256, prioritized=False),
+            learner=dataclasses.replace(cfg.learner, batch_size=batch),
+        )
+        total = args.chunks * args.chunk_iters * lanes
+        legs = {
+            "dp1": _host_replay_leg(cfg, total, args.chunk_iters, 1),
+            f"dp{dp}": _host_replay_leg(cfg, total, args.chunk_iters,
+                                        dp),
+        }
+        dpn = legs[f"dp{dp}"]
+        scaling = {
+            "env_steps_x": round(dpn["env_steps_per_sec"]
+                                 / max(legs["dp1"]["env_steps_per_sec"],
+                                       1e-9), 3),
+            "grad_steps_x": round(dpn["grad_steps_per_sec"]
+                                  / max(legs["dp1"]["grad_steps_per_sec"],
+                                        1e-9), 3),
+        }
+        apex = None
+        if not args.skip_apex:
+            from dist_dqn_tpu.actors.service import (ApexRuntimeConfig,
+                                                     run_apex)
+            rt = ApexRuntimeConfig(
+                host_env="CartPole-v1", num_actors=4, envs_per_actor=2,
+                total_env_steps=2000, ingest_shards=2)
+            acfg = dataclasses.replace(
+                cfg, replay=dataclasses.replace(cfg.replay,
+                                                capacity=4096,
+                                                min_fill=128))
+            aout = run_apex(acfg, rt, log_fn=lambda s: None)
+            apex = {
+                "ingest_shards": 2,
+                "records_by_shard": aout["records_by_shard"],
+                "replay_added_by_shard": aout["replay_added_by_shard"],
+                "grad_steps": aout["grad_steps"],
+            }
+        contract.emit_payload({
+            "metric": "dp_scaling", "unit": contract.unit,
+            "value": scaling["grad_steps_x"],
+            "platform": jax.default_backend(),
+            "dp_size": dp,
+            "host_replay": legs,
+            "scaling": scaling,
+            "apex": apex,
+        })
+        return 0
+    except Exception as e:  # noqa: BLE001 — the contract wants one line
+        contract.error("run", f"{type(e).__name__}: {e}")
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

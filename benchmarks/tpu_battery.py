@@ -68,6 +68,12 @@ STAGES = [
     # (+2.1) in 95s; near-perfect (+4.6) in 310s with --margin 9.5.
     ("pong_learning",
      [sys.executable, "benchmarks/pong_learning.py"], 800),
+    # n-chip scale-out row (ISSUE 10): host-replay at dp=1 vs dp=all
+    # (aggregate + per-chip env/grad rates) and the apex 2-shard sticky
+    # ingest spread — the battery's first measurement where the chip
+    # COUNT, not the single-chip rate, is the variable.
+    ("scaling",
+     [sys.executable, "benchmarks/scaling_bench.py"], 1200),
     # Full-game learning proof through the REAL AtariPreprocessing path
     # (fake-ALE Pong, Nature-CNN apex split). Self-sizing; exit 0 iff
     # the bar clears. KNOWN-STRUCTURAL miss on this box (2026-08-01

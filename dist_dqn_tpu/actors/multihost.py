@@ -31,6 +31,8 @@ from typing import Tuple
 
 import numpy as np
 
+from dist_dqn_tpu.utils import compat
+
 
 class MultihostLearner:
     """Collective-learner machinery for one service process in the group."""
@@ -89,7 +91,9 @@ class MultihostLearner:
         def sharded(state, *data):
             state_spec = jax.tree.map(lambda _: repl, state,
                                       is_leaf=lambda x: x is None)
-            body = jax.shard_map(
+            # mesh-axis: data_specs/metric_specs name the dp axis
+            # (parallel/learner.py train_step_specs).
+            body = compat.shard_map(
                 train_step, mesh=mesh,
                 in_specs=(state_spec,) + data_specs,
                 out_specs=(state_spec, metric_specs), check_vma=False)
@@ -146,7 +150,9 @@ class MultihostLearner:
                 "out; the worker thread may still be blocked inside that "
                 "psum, so this learner is poisoned — restart the process")
         if self._agree is None:
-            self._agree = jax.jit(jax.shard_map(
+            # donation: a few-element counter psum — nothing worth
+            # donating, and the caller reuses its input array.
+            self._agree = jax.jit(compat.shard_map(
                 lambda x: jax.lax.psum(x, "dp"), mesh=self.mesh,
                 in_specs=P("dp"), out_specs=P(), check_vma=False))
         ints = np.asarray(values, np.int64)

@@ -184,11 +184,14 @@ class ApexRuntimeConfig:
     # Rides the Python assembler (q-plane threading); False restores
     # the learner-side bootstrap (+ native assembly where configured).
     actor_priorities: bool = True
-    # Sticky ingest routing (ISSUE 9 piece 4): replay-shard count for
-    # the actor -> shard assignment threaded through frame headers and
-    # the replay append path. MUST stay 1 until ROADMAP item 1 lands a
-    # sharded store; the plumbing (and telemetry) exists now so that
-    # scale-out is a config change, not a wire change.
+    # Sticky ingest routing (ISSUE 9 piece 4, store landed in ISSUE 10):
+    # replay-shard count. > 1 splits the store into that many
+    # PrioritizedHostReplay shards (replay/sharded.py) and every
+    # actor's stream lands in its sticky crc32 shard — the id threaded
+    # through frame headers since PR 9, now consumed by the append
+    # path. Requires per-actor insert attribution (zerocopy transport
+    # with actor priorities, or a recurrent config) and the host tree
+    # sampler; the constructor rejects anything else loudly.
     ingest_shards: int = 1
     # Prometheus scrape endpoint (telemetry/server.py): serve the process
     # registry's /metrics on this port (0 = ephemeral, logged as
@@ -218,6 +221,30 @@ class ApexLearnerService:
         # Actor id space: [0, num_actors) are local (shm transport),
         # [num_actors, total_actors) are remote (TCP/DCN transport).
         self.total_actors = rt.num_actors + rt.num_remote_actors
+
+        # ingest_shards validation FIRST — before any shm segment or
+        # socket exists, so a rejected config cannot leak transports
+        # out of a half-built service (ISSUE 10; the sharded store
+        # itself is constructed further down).
+        if rt.ingest_shards < 1:
+            raise ValueError(
+                f"ingest_shards must be >= 1, got {rt.ingest_shards}")
+        if rt.ingest_shards > 1:
+            if rt.device_sampling:
+                raise ValueError(
+                    "ingest_shards > 1 with --device-sampling is not "
+                    "supported: the on-device priority plane is one "
+                    "contiguous buffer with no per-shard story yet — "
+                    "use the host tree sampler, or ingest_shards=1")
+            if cfg.network.lstm_size <= 0 and not (
+                    rt.transport == "zerocopy" and rt.actor_priorities):
+                raise ValueError(
+                    "ingest_shards > 1 requires per-actor insert "
+                    "attribution: run --transport zerocopy with actor "
+                    "priorities (the default), or a recurrent (R2D2) "
+                    "config — the legacy bootstrap path concatenates "
+                    "transitions across actors before inserting, so "
+                    "sticky placement would be a lie there")
 
         # Transport endpoints (created before actors spawn).
         self.req_ring = ShmRing(f"req_{self.run_id}",
@@ -254,10 +281,12 @@ class ApexLearnerService:
         # actors — same ownership model as the mailboxes above). Slot
         # geometry derives from the env probe; the actor's hello carries
         # its own derivation and a mismatch fails at connect.
-        if rt.ingest_shards != 1:
-            raise ValueError(
-                "ingest_shards > 1 requires the sharded replay store "
-                "(ROADMAP item 1); the routing plumbing lands first")
+        #
+        # ingest_shards > 1 (ISSUE 10): the sharded store exists now —
+        # the replay splits into N PrioritizedHostReplay shards and
+        # every actor's stream lands in its sticky crc32 shard
+        # (replay/sharded.py; config validated at the top of __init__,
+        # before any transport existed).
         from dist_dqn_tpu import ingest
         self._ingest = ingest
         self.router = ingest.StickyShardRouter(rt.ingest_shards)
@@ -417,6 +446,7 @@ class ApexLearnerService:
         self._init_learner = init
         self._mh = None
         self._host_params = None
+        self._mesh = None
         if self.distributed:
             from dist_dqn_tpu.actors.multihost import MultihostLearner
             self._mh = MultihostLearner()
@@ -440,17 +470,36 @@ class ApexLearnerService:
         from dist_dqn_tpu import loop_common
         self.replay_ratio = loop_common.resolve_replay_ratio(cfg)
         self.train_batch = loop_common.resolve_train_batch(cfg)
+        if not self.distributed and self.train_batch % self.n_learners:
+            raise ValueError(
+                f"train batch {self.train_batch} not divisible by "
+                f"learner_devices={self.n_learners} (rows shard evenly "
+                "over the learner mesh)")
         self._train_scan = None
         if self.replay_ratio > 1:
-            if self.recurrent or self.distributed or self.n_learners != 1:
+            if self.recurrent or self.distributed:
                 log_fn("# replay.updates_per_chunk > 1 is not supported "
-                       "on the recurrent / multi-learner / multi-host "
-                       "apex paths yet; running at replay ratio 1")
+                       "on the recurrent / multi-host apex paths yet; "
+                       "running at replay ratio 1")
                 self.replay_ratio = 1
-            else:
+            elif self.n_learners == 1:
                 from dist_dqn_tpu.agents.dqn import make_scan_train
                 self._train_scan = jax.jit(make_scan_train(train_step),
                                            donate_argnums=0)
+            else:
+                # Data-parallel replay-ratio scan (ISSUE 10): the SAME
+                # scanned N-sub-step program, lifted over the local
+                # learner mesh — rows shard on batch axis 1 and the
+                # priorities come back [N, B] (flatten=False) so the
+                # host's chronological [N*B] reshape is sub-step-major,
+                # not device-block-major (scan_train_step_specs).
+                from dist_dqn_tpu.agents.dqn import make_scan_train
+                from dist_dqn_tpu.parallel.learner import (
+                    make_sharded_train_step, scan_train_step_specs)
+                scan_data, scan_metrics = scan_train_step_specs(axis)
+                self._train_scan = make_sharded_train_step(
+                    make_scan_train(train_step, flatten=False),
+                    self._learner_mesh(), scan_data, scan_metrics)
         if self.distributed and self.train_batch != cfg.learner.batch_size:
             log_fn("# replay.train_batch widening is single-host only "
                    "(multi-host batches shard from learner.batch_size); "
@@ -465,10 +514,22 @@ class ApexLearnerService:
                    "service yet (acting uses the live learner params); "
                    "running actor inference in float32")
 
-        self.replay = PrioritizedHostReplay(
-            cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
-            priority_eps=cfg.replay.priority_eps,
-            sampler="device" if rt.device_sampling else "tree")
+        if rt.ingest_shards > 1:
+            # Sharded store (ISSUE 10): N per-shard sum-trees, inserts
+            # routed by the sticky shard id every frame header carries,
+            # draws stratified across shards by tree mass, slot ids
+            # globally encoded so the pipelined write-back path works
+            # unchanged (replay/sharded.py).
+            from dist_dqn_tpu.replay.sharded import ShardedPrioritizedReplay
+            self.replay = ShardedPrioritizedReplay(
+                rt.ingest_shards, cfg.replay.capacity,
+                alpha=cfg.replay.priority_exponent,
+                priority_eps=cfg.replay.priority_eps)
+        else:
+            self.replay = PrioritizedHostReplay(
+                cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
+                priority_eps=cfg.replay.priority_eps,
+                sampler="device" if rt.device_sampling else "tree")
         # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1)*alpha).
         n_act = max(self.total_actors - 1, 1)
         self.actor_eps = np.array([
@@ -684,55 +745,31 @@ class ApexLearnerService:
 
     def _step_specs(self, axis: str):
         """(data_specs, metric_specs) PartitionSpecs for the train step:
-        batch leaves shard over ``axis``, scalars/state replicate."""
-        from jax.sharding import PartitionSpec as P
+        the ONE shared spec set in parallel/learner.py (the fused path's
+        spec idiom), so the apex, host-replay and multi-host learners
+        cannot drift apart."""
+        from dist_dqn_tpu.parallel.learner import train_step_specs
 
-        from dist_dqn_tpu.types import SequenceSample, Transition
+        return train_step_specs(axis, recurrent=self.recurrent)
 
-        repl = P()
-        if self.recurrent:
-            # Time-major [L, S, ...] fields shard the sequence axis (1).
-            data_specs = (SequenceSample(
-                obs=P(None, axis), action=P(None, axis),
-                reward=P(None, axis), done=P(None, axis),
-                reset=P(None, axis), start_state=(P(axis), P(axis)),
-                weights=P(axis), t_idx=P(axis), b_idx=P(axis)),)
-            metric_specs = {"loss": repl, "raw_loss": repl,
-                            "priorities": P(axis), "grad_norm": repl}
-        else:
-            data_specs = (self.jax.tree.map(
-                lambda _: P(axis),
-                Transition(obs=0, action=0, reward=0, discount=0,
-                           next_obs=0)),
-                P(axis))  # batch, weights
-            metric_specs = {"loss": repl, "raw_loss": repl,
-                            "priorities": P(axis), "grad_norm": repl,
-                            "mean_q_target_gap": repl}
-        return data_specs, metric_specs
+    def _learner_mesh(self):
+        """The local learner dp mesh (first ``n_learners`` devices)."""
+        from dist_dqn_tpu.parallel import make_mesh
+
+        if self._mesh is None:
+            self._mesh = make_mesh(
+                devices=self.jax.devices()[:self.n_learners])
+        return self._mesh
 
     def _shard_train_step(self, train_step, axis: str):
         """Lift the per-device train step onto the local learner mesh:
         batch leaves shard over ``axis``, learner state replicates, and the
         pmean inside the step (agents/) allreduces gradients over ICI."""
-        jax = self.jax
-        from jax.sharding import PartitionSpec as P
+        from dist_dqn_tpu.parallel.learner import make_sharded_train_step
 
-        from dist_dqn_tpu.parallel import make_mesh
-
-        mesh = make_mesh(devices=jax.devices()[:self.n_learners])
-        repl = P()
         data_specs, metric_specs = self._step_specs(axis)
-
-        def sharded(state, *data):
-            state_spec = jax.tree.map(lambda _: repl, state,
-                                      is_leaf=lambda x: x is None)
-            body = jax.shard_map(
-                train_step, mesh=mesh,
-                in_specs=(state_spec,) + data_specs,
-                out_specs=(state_spec, metric_specs), check_vma=False)
-            return body(state, *data)
-
-        return jax.jit(sharded, donate_argnums=0)
+        return make_sharded_train_step(train_step, self._learner_mesh(),
+                                       data_specs, metric_specs)
 
     # -- actor lifecycle ----------------------------------------------------
     def _spawn_one(self, actor_id: int):
@@ -1090,6 +1127,19 @@ class ApexLearnerService:
             self._hello_reject(
                 f"actor {actor} wants zerocopy transport but the "
                 f"service runs --transport legacy", conn_id)
+        if self.rt.ingest_shards > 1 and not self.recurrent \
+                and peer_transport != "zerocopy":
+            # Sharded-store placement needs per-actor insert attribution
+            # (ISSUE 10): a legacy-codec actor's transitions would take
+            # the concatenated bootstrap path, whose unattributed insert
+            # the sharded store rejects — failing HERE, at connect, is
+            # one rejected hello instead of a learner-loop crash on the
+            # actor's first drained window.
+            self._hello_reject(
+                f"actor {actor} speaks the legacy codec but the service "
+                f"runs ingest_shards={self.rt.ingest_shards}: sharded "
+                "placement needs the zerocopy actor-priority path — "
+                "upgrade the actor, or run ingest_shards=1", conn_id)
         if peer_transport == "zerocopy":
             if "schema" not in meta:
                 self._hello_reject(
@@ -1277,7 +1327,8 @@ class ApexLearnerService:
                     self.cfg.learner.value_rescale)
                 emitted.pop("q_sel")
                 emitted.pop("q_max")
-                self.replay.add(emitted, priorities=prios)
+                self.replay.add(emitted, priorities=prios,
+                                shard=self.router.shard_for(actor))
             else:
                 self._pending.append(emitted)
                 self._pending_count += emitted["action"].shape[0]
@@ -1685,7 +1736,11 @@ class ApexLearnerService:
         if not self._in_flight:
             return
         idx, gen, metrics, t_dispatch = self._in_flight.popleft()
-        prios = np.asarray(metrics["priorities"])
+        # The data-parallel scan path keeps priorities [N, local_b] per
+        # shard (global [N, B]); reshape(-1) recovers the sub-step-major
+        # chronological order the batched write-back pairs with its
+        # concatenated idx. A no-op for the already-flat paths.
+        prios = np.asarray(metrics["priorities"]).reshape(-1)
         # Dispatch -> materialized: the np.asarray above blocked until the
         # device finished this step, so this IS the grad-step round-trip
         # (pipelining means it includes up to pipeline_depth-1 queued

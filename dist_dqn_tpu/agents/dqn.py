@@ -311,7 +311,7 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     return init, train_step
 
 
-def make_scan_train(train_step: Callable) -> Callable:
+def make_scan_train(train_step: Callable, flatten: bool = True) -> Callable:
     """Fold N train sub-steps into ONE dispatched program (ISSUE 6).
 
     ``scan_train(state, batches, weights)`` scans ``train_step`` over a
@@ -328,6 +328,12 @@ def make_scan_train(train_step: Callable) -> Callable:
     ``loss``/``raw_loss``/``mean_q_target_gap`` are sub-step means, and
     ``grad_norm`` is the LAST sub-step's (the freshest divergence
     signal for the sentinel).
+
+    ``flatten=False`` keeps priorities [N, B] instead: required when the
+    scan runs data-parallel under ``shard_map`` (batch rows sharded on
+    axis 1) — a per-shard flatten would concatenate device blocks, not
+    sub-steps, so the HOST reshapes the global [N, B] to [N*B] instead
+    (parallel/learner.py scan_train_step_specs).
     """
 
     def scan_train(state: LearnerState, batches: Transition,
@@ -343,7 +349,7 @@ def make_scan_train(train_step: Callable) -> Callable:
         metrics = {
             "loss": jnp.mean(loss),
             "raw_loss": jnp.mean(raw),
-            "priorities": prios.reshape(-1),
+            "priorities": prios.reshape(-1) if flatten else prios,
             "grad_norm": gnorm[-1],
             "mean_q_target_gap": jnp.mean(gap),
         }

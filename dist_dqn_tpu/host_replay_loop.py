@@ -160,6 +160,34 @@ def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
     return init, collect
 
 
+class _MultiEvacHandle:
+    """Fan-in completion handle over per-shard evacuation jobs (dp > 1):
+    the train event fences when EVERY shard's lane block is published.
+    ``evac_s`` reports the slowest shard (the critical-path wall);
+    bytes/slices aggregate."""
+
+    def __init__(self, handles):
+        self.handles = handles
+
+    def wait(self, timeout=None) -> bool:
+        ok = True
+        for h in self.handles:
+            ok = h.wait(timeout) and ok
+        return ok
+
+    @property
+    def done(self) -> bool:
+        return all(h.done for h in self.handles)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "evac_s": max(h.stats["evac_s"] for h in self.handles),
+            "bytes": sum(h.stats["bytes"] for h in self.handles),
+            "slices": sum(h.stats["slices"] for h in self.handles),
+        }
+
+
 class _ResumedEvacHandle:
     """Completion-handle stand-in installed on resume: the chunk it
     fences was already appended to the ring INSIDE the checkpoint, so
@@ -183,7 +211,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     prioritized: Optional[bool] = None,
                     prio_writeback_batch: int = 8,
                     checkpoint_dir: Optional[str] = None,
-                    save_every_frames: int = 0):
+                    save_every_frames: int = 0,
+                    mesh_devices: int = 1):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
@@ -239,6 +268,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     tests/test_chaos.py holds against a mid-run kill. PER mode raises:
     its sum-tree is rebuilt from appends, not checkpointed, so resume
     could not be honest about priorities yet.
+
+    ``mesh_devices`` (ISSUE 10 tentpole) runs the runtime DATA-PARALLEL
+    over a ``dp`` mesh of that many devices (0 = all): env lanes split
+    into ``dp`` lane blocks, each block's transitions evacuate through
+    that shard's own EvacuationWorker into its own host ring
+    (replay/sharded.py ShardedHostReplay), each shard's own
+    SamplePrefetcher feeds its LOCAL chip, and the train step runs
+    under ``shard_map`` with params replicated, batch rows sharded over
+    ``dp`` and ONE pmean gradient allreduce per update (the same specs
+    the fused and apex learners use — parallel/learner.py). The collect
+    chunk itself stays a single-device program acting on a per-chunk
+    host mirror of the replicated params (the Sebulba actor-side
+    refresh); sharding collection is the fused runtime's job.
+    ``mesh_devices=1`` is the untouched pre-mesh program — bit-identical
+    by construction (same code path).
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
@@ -266,8 +310,23 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             "--checkpoint-dir with prioritized host-replay sampling is "
             "not supported yet: the sum-tree rebuilds from appends, not "
             "from the checkpoint, so a resumed run's priorities would "
-            "silently differ. Checkpoint uniform runs (--no-per), or "
-            "use the apex runtime's --checkpoint-replay")
+            "silently differ. Supported checkpoint configurations: "
+            "uniform single-chip host-replay (--no-per --mesh-devices "
+            "1), or the apex runtime's --checkpoint-replay (which "
+            "snapshots sum-tree mass)")
+    dp = len(jax.devices()) if mesh_devices == 0 else int(mesh_devices)
+    if dp < 1:
+        raise ValueError(f"mesh_devices must be >= 0, got {mesh_devices}")
+    if dp > len(jax.devices()):
+        raise ValueError(f"--mesh-devices {dp} requested but only "
+                         f"{len(jax.devices())} devices are available")
+    if dp > 1 and checkpoint_dir:
+        raise ValueError(
+            "--checkpoint-dir with --mesh-devices > 1 is not supported "
+            "yet: the whole-state snapshot would have to restore N "
+            "per-shard rings bit-identically AND refuse a changed shard "
+            "count; checkpoint single-chip runs (--mesh-devices 1), or "
+            "run dp > 1 without checkpointing")
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -299,10 +358,33 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             "the ring a FIFO of the last chunk — keep chunk_iters well "
             "below the slot count)")
 
+    if dp > 1 and B % dp:
+        raise ValueError(
+            f"actor.num_envs={B} not divisible by --mesh-devices {dp}: "
+            "each dp shard owns one env-lane block of the collect chunk")
+
     init_collect, collect = make_collect_chunk(cfg, env, net, stack)
     collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
-    init_learner, train_step = make_learner(net, cfg.learner)
-    train_jit = jax.jit(train_step, donate_argnums=0)
+    init_learner, train_step = make_learner(
+        net, cfg.learner, axis_name="dp" if dp > 1 else None)
+    mesh = mesh_devs = weights_sharding = None
+    if dp == 1:
+        train_jit = jax.jit(train_step, donate_argnums=0)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dist_dqn_tpu.parallel import make_mesh
+        from dist_dqn_tpu.parallel.learner import (make_sharded_train_step,
+                                                   train_step_specs)
+        mesh = make_mesh(devices=jax.devices()[:dp])
+        mesh_devs = list(mesh.devices.flat)
+        data_specs, metric_specs = train_step_specs("dp")
+        # Donates the replicated learner state (inside the helper) — the
+        # same aliasing contract the single-chip audit pins.
+        train_jit = make_sharded_train_step(train_step, mesh,
+                                            data_specs, metric_specs)
+        weights_sharding = NamedSharding(mesh, P("dp"))
+        repl_sharding = NamedSharding(mesh, P())
     # Replay-ratio engine (ISSUE 6): multiplies the grad steps each
     # train event runs — the SamplePrefetcher simply draws that many
     # batches ahead, so the ratio rides the existing sample pipeline.
@@ -310,6 +392,11 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # Wide bucketed train batches (ISSUE 6): resolved through the same
     # pow2 rule as the fused loop; default = learner.batch_size exactly.
     train_batch = loop_common.resolve_train_batch(cfg)
+    if dp > 1 and train_batch % dp:
+        raise ValueError(
+            f"train batch {train_batch} not divisible by --mesh-devices "
+            f"{dp}: each dp shard draws and uploads an equal row block "
+            "(widen replay.train_batch or change the mesh size)")
     # Actor-dtype split (ISSUE 6): collect already acts on chunk-stale
     # params by construction (the collect-ahead schedule), so the bf16
     # snapshot costs ONE extra cast dispatch per chunk and no extra
@@ -319,23 +406,45 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     cast_jit = jax.jit(_cast_actor) if _actor_split else None
 
     def collect_params(state):
-        return cast_jit(state.params) if _actor_split else state.params
+        params = state.params
+        if dp > 1:
+            # Host mirror of the mesh-replicated params (the Sebulba
+            # actor-side param refresh): collect is a single-device
+            # program and must not consume mesh-committed arrays; the
+            # D2H copy costs once per chunk, exactly where the bf16
+            # cast already sits.
+            params = jax.device_get(params)
+        return cast_jit(params) if _actor_split else params
 
-    ring = HostTimeRing(num_slots, B, stored_shape,
-                        np.dtype(env.observation_dtype), frame_stack=stack)
+    if dp == 1:
+        ring = HostTimeRing(num_slots, B, stored_shape,
+                            np.dtype(env.observation_dtype),
+                            frame_stack=stack)
+        store = None
+    else:
+        from dist_dqn_tpu.replay.sharded import ShardedHostReplay
+        store = ShardedHostReplay(dp, num_slots, B // dp, stored_shape,
+                                  np.dtype(env.observation_dtype),
+                                  frame_stack=stack)
+        ring = None
 
     rng = jax.random.PRNGKey(cfg.seed)
     k_carry, k_learn = jax.random.split(rng)
     carry = init_collect(k_carry)
     obs_example = jax.tree.map(lambda x: x[0], carry.obs)
     state = init_learner(k_learn, obs_example)
+    if dp > 1:
+        # Replicate the learner once onto the mesh; the donated sharded
+        # train step then updates the replicas in place.
+        state = jax.device_put(state, repl_sharding)
 
     # Prioritized sampling (ISSUE 5): a sum-tree shard over the ring's
     # slots, kept in lockstep with every append (main thread or
     # evacuation worker) through the ring's publish hook — under the
-    # same generation fence the samplers hold.
-    per_sampler = None
-    if per_enabled:
+    # same generation fence the samplers hold. dp > 1 attaches ONE
+    # sum-tree per shard ring (per-shard fences, per-shard flushes).
+    per_sampler = per_samplers = None
+    if per_enabled and dp == 1:
         from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
         per_sampler = RingPrioritySampler(
             ring, n_step=cfg.learner.n_step,
@@ -347,16 +456,33 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                f"alpha={cfg.replay.priority_exponent}, "
                f"beta={cfg.replay.importance_exponent}, "
                f"prio_writeback_batch={prio_writeback_batch})")
+    elif per_enabled:
+        per_samplers = store.attach_priority_samplers(
+            n_step=cfg.learner.n_step,
+            alpha=cfg.replay.priority_exponent,
+            beta=cfg.replay.importance_exponent,
+            eps=cfg.replay.priority_eps)
+        log_fn(f"# host-replay sampler: prioritized sum-tree x {dp} "
+               f"shards ({type(per_samplers[0].tree).__name__}, "
+               f"alpha={cfg.replay.priority_exponent}, "
+               f"beta={cfg.replay.importance_exponent}, "
+               f"prio_writeback_batch={prio_writeback_batch})")
     else:
-        log_fn("# host-replay sampler: uniform")
+        log_fn("# host-replay sampler: uniform"
+               + (f" x {dp} shards" if dp > 1 else ""))
 
-    def _batch_rng(k: int) -> np.random.Generator:
+    def _batch_rng(k: int, shard: Optional[int] = None
+                   ) -> np.random.Generator:
         # Per-batch-index RNG streams split from the seed: batch k's
         # content is a pure function of (k, ring window), never of
         # which thread drew it or when — the property that makes the
-        # prefetched and serial paths bit-identical.
+        # prefetched and serial paths bit-identical. dp shards extend
+        # the spawn key with the shard id: stream (k, s) is shard s's
+        # slice of train batch k, identical whether a prefetcher thread
+        # or the serial reference draws it.
+        key = (k,) if shard is None else (k, shard)
         return np.random.default_rng(
-            np.random.SeedSequence(cfg.seed, spawn_key=(k,)))
+            np.random.SeedSequence(cfg.seed, spawn_key=key))
 
     def sample_host(k: int):
         """Batch k's host-side sample+gather -> (host pytree, aux)."""
@@ -384,29 +510,146 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         ring.add_chunk(tree["obs"], tree["action"], tree["reward"],
                        tree["terminated"], tree["truncated"])
 
+    # -- dp > 1 plumbing (ISSUE 10): per-shard sample/upload/assemble ------
+    shard_samples = shard_puts = assemble_tree = None
+    if dp > 1:
+        lb_shard = train_batch // dp
+
+        def make_shard_sample(s: int):
+            ring_s = store.rings[s]
+            sampler_s = (per_samplers[s] if per_samplers is not None
+                         else None)
+
+            def sample_shard(k: int):
+                """Shard s's row block of train batch k."""
+                rng_k = _batch_rng(k, s)
+                if sampler_s is not None:
+                    hb, aux = sampler_s.sample(rng_k, lb_shard,
+                                               cfg.learner.gamma)
+                    tr = Transition(obs=hb.obs, action=hb.action,
+                                    reward=hb.reward,
+                                    discount=hb.discount,
+                                    next_obs=hb.next_obs)
+                    return (tr, aux.weights), aux
+                hs = ring_s.sample(rng_k, lb_shard, cfg.learner.n_step,
+                                   cfg.learner.gamma)
+                hb = hs.batch
+                tr = Transition(obs=hb.obs, action=hb.action,
+                                reward=hb.reward, discount=hb.discount,
+                                next_obs=hb.next_obs)
+                return tr, _UniformTag(generation=hs.generation)
+
+            return sample_shard
+
+        def _make_shard_put(dev):
+            def put(tree):
+                # Fresh copy per upload: the staging slot buffers are
+                # REUSED while an earlier upload may still alias their
+                # pages on CPU PJRT (the ISSUE 5 alias bug) — a per-call
+                # copy makes each upload's source immutable for its
+                # whole lifetime, and lands the rows on shard s's OWN
+                # device so assembly below is zero-copy.
+                return jax.tree.map(
+                    lambda x: jax.device_put(np.array(x, copy=True),
+                                             dev), tree)
+
+            return put
+
+        shard_samples = [make_shard_sample(s) for s in range(dp)]
+        shard_puts = [_make_shard_put(mesh_devs[s]) for s in range(dp)]
+
+        def _assemble(*leaves):
+            shape = ((sum(lf.shape[0] for lf in leaves),)
+                     + tuple(leaves[0].shape[1:]))
+            return jax.make_array_from_single_device_arrays(
+                shape, weights_sharding, list(leaves))
+
+        def assemble_tree(trees):
+            """N per-shard device trees (shard s committed to mesh
+            device s) -> one global row-sharded tree, no data motion."""
+            return jax.tree.map(lambda *ls: _assemble(*ls), *trees)
+
     # Sample-side pipeline (ISSUE 5): a background prefetcher runs
     # sample -> gather -> stage ahead of the learner. Without it, the
     # legacy main-thread double-buffered stager (ISSUE 2) or the fully
-    # serial put_batch path serve as the pinned references.
-    prefetcher = stager = None
-    if prefetch:
+    # serial put_batch path serve as the pinned references. dp > 1 runs
+    # ONE prefetcher per shard, staging onto that shard's local chip.
+    prefetcher = stager = prefetchers = None
+    if prefetch and dp > 1:
+        from dist_dqn_tpu.replay.staging import SamplePrefetcher
+        prefetchers = [
+            SamplePrefetcher(shard_samples[s], depth=prefetch_depth,
+                             name=f"host_replay_s{s}",
+                             wait_generation=store.rings[s]
+                             .wait_generation,
+                             device_put=shard_puts[s])
+            for s in range(dp)
+        ]
+    elif prefetch:
         from dist_dqn_tpu.replay.staging import SamplePrefetcher
         prefetcher = SamplePrefetcher(sample_host, depth=prefetch_depth,
                                       name="host_replay",
                                       wait_generation=ring.wait_generation)
-    elif double_buffer:
+    elif double_buffer and dp == 1:
         from dist_dqn_tpu.replay.staging import DoubleBufferedStager
         stager = DoubleBufferedStager(depth=2, name="host_replay")
+    elif double_buffer:
+        # Never degrade a requested reference path silently (the
+        # train.py ignored-flag discipline): the legacy main-thread
+        # stager is single-chip only — the dp serial path samples and
+        # uploads per shard on the critical path instead.
+        log_fn("# --no-prefetch with --mesh-devices > 1 runs the fully "
+               "serial per-shard reference (sample -> per-device upload "
+               "-> assemble); the double-buffered stager is single-chip "
+               "only — ignored")
 
     # Streamed D2H + background worker (the pipeline's stages 2 and 3).
-    evacuator = worker = None
-    if pipeline:
+    # dp > 1: one evacuator/worker pair PER SHARD — each shard's lane
+    # block streams into its own ring under its own generation fence.
+    evacuator = worker = workers = lane_split = None
+    if pipeline and dp > 1:
+        from dist_dqn_tpu.replay.staging import (EvacuationWorker,
+                                                 StreamedEvacuator)
+        Bs = B // dp
+
+        def _make_append(s: int):
+            def append(tree, lo, hi):
+                store.add_chunk(s, tree["obs"], tree["action"],
+                                tree["reward"], tree["terminated"],
+                                tree["truncated"])
+
+            return append
+
+        workers = [
+            EvacuationWorker(
+                StreamedEvacuator(num_slices=evac_slices,
+                                  name=f"host_replay_s{s}"),
+                _make_append(s), name=f"host_replay_s{s}")
+            for s in range(dp)
+        ]
+        # One dispatched lane-split program per chunk: [C, B, ...]
+        # records -> dp lane blocks, each submitted to its shard's
+        # worker (the time-slice split happens per shard inside its
+        # StreamedEvacuator, same as the single-ring path).
+        lane_split = jax.jit(lambda tree: tuple(
+            jax.tree.map(lambda x, s=s: x[:, s * Bs:(s + 1) * Bs], tree)
+            for s in range(dp)))
+    elif pipeline:
         from dist_dqn_tpu.replay.staging import (EvacuationWorker,
                                                  StreamedEvacuator)
         evacuator = StreamedEvacuator(num_slices=evac_slices,
                                       name="host_replay")
         worker = EvacuationWorker(evacuator, ring_append,
                                   name="host_replay")
+
+    def submit_evac(records):
+        """Queue one chunk's evacuation; returns the completion handle
+        the next train event fences on."""
+        if dp == 1:
+            return worker.submit(records)
+        blocks = lane_split(records)
+        return _MultiEvacHandle([w.submit(b)
+                                 for w, b in zip(workers, blocks)])
 
     # Crash forensics (ISSUE 4): per-stage heartbeats (the evacuation
     # stage's heartbeat lives inside EvacuationWorker as
@@ -455,7 +698,11 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # iterations (chunk_iters need not divide train_every).
     updates_per_train = max(cfg.updates_per_train, 1) * replay_ratio
     train_debt_iters = 0
-    weights = jnp.ones((train_batch,), jnp.float32)
+    if dp == 1:
+        weights = jnp.ones((train_batch,), jnp.float32)
+    else:
+        weights = jax.device_put(np.ones((train_batch,), np.float32),
+                                 weights_sharding)
 
     # Batched priority write-backs (ISSUE 5, PER only): each train
     # step's |TD| plane stays a device array in this pending list (its
@@ -463,30 +710,47 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # costs a copy, not a sync) and lands in the sum-tree as ONE
     # vectorized set per prio_writeback_batch steps. Chronological
     # order + the per-slot generation guard preserve last-write-wins.
+    # dp > 1: aux is the LIST of per-shard PerSamples and the flush is
+    # per shard — the global priority rows materialize in shard-block
+    # order (shard s owns rows [s*lb, (s+1)*lb) of every batch), each
+    # shard's rows applied as its own vectorized set under its own fence.
     wb_pending = []
     is_w_sum, is_w_count, is_w_min = 0.0, 0, 1.0
 
     def _wb_add(aux, metrics):
         nonlocal is_w_sum, is_w_count, is_w_min
-        if per_sampler is None:
+        if per_sampler is None and per_samplers is None:
             return
-        wb_pending.append((aux.leaf, metrics["priorities"],
-                           aux.slot_gen))
-        is_w_sum += float(aux.weights.sum())
-        is_w_count += int(aux.weights.shape[0])
-        is_w_min = min(is_w_min, float(aux.weights.min()))
+        wb_pending.append((aux, metrics["priorities"]))
+        for a in (aux if dp > 1 else (aux,)):
+            is_w_sum += float(a.weights.sum())
+            is_w_count += int(a.weights.shape[0])
+            is_w_min = min(is_w_min, float(a.weights.min()))
         if len(wb_pending) >= prio_writeback_batch:
             _wb_flush()
 
     def _wb_flush():
-        if per_sampler is None or not wb_pending:
+        if (per_sampler is None and per_samplers is None) \
+                or not wb_pending:
             return
         pending, wb_pending[:] = wb_pending[:], []
-        leaf = np.concatenate([e[0] for e in pending])
-        prios = np.concatenate([np.asarray(e[1], np.float64)
-                                for e in pending])
-        gens = np.concatenate([e[2] for e in pending])
-        per_sampler.update_priorities(leaf, prios, expected_gen=gens)
+        if dp == 1:
+            leaf = np.concatenate([a.leaf for a, _ in pending])
+            prios = np.concatenate([np.asarray(p, np.float64)
+                                    for _, p in pending])
+            gens = np.concatenate([a.slot_gen for a, _ in pending])
+            per_sampler.update_priorities(leaf, prios, expected_gen=gens)
+            return
+        lb = train_batch // dp
+        prios_np = [np.asarray(p, np.float64) for _, p in pending]
+        for s in range(dp):
+            leaf = np.concatenate([aux[s].leaf for aux, _ in pending])
+            pr = np.concatenate([p[s * lb:(s + 1) * lb]
+                                 for p in prios_np])
+            gens = np.concatenate([aux[s].slot_gen
+                                   for aux, _ in pending])
+            per_samplers[s].update_priorities(leaf, pr,
+                                              expected_gen=gens)
 
     num_chunks = max(0, math.ceil(total_env_steps / (chunk_iters * B)))
     env_steps = 0
@@ -669,7 +933,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             carry, records, stats = collect_jit(
                 carry, collect_params(state), chunk_iters)
             if pipeline:
-                handle = worker.submit(records)
+                handle = submit_evac(records)
                 records = None
         elif resumed:
             # Re-establish the loop invariants at the top of body
@@ -721,8 +985,17 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 host = {k: np.asarray(jax.device_get(v))
                         for k, v in records.items()}
                 t_mono_fetch = time.perf_counter()
-                ring.add_chunk(host["obs"], host["action"], host["reward"],
-                               host["terminated"], host["truncated"])
+                if dp == 1:
+                    ring.add_chunk(host["obs"], host["action"],
+                                   host["reward"], host["terminated"],
+                                   host["truncated"])
+                else:
+                    Bs = B // dp
+                    for s in range(dp):
+                        store.add_chunk(
+                            s, *(host[k][:, s * Bs:(s + 1) * Bs]
+                                 for k in ("obs", "action", "reward",
+                                           "terminated", "truncated")))
                 t_fence = time.perf_counter()
                 fence_wait_s = evac_s = t_fence - t0
                 d2h_bytes = int(sum(v.nbytes for v in host.values()))
@@ -750,20 +1023,87 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # deterministic post-chunk-g state.
             g_overlap.set(overlap)
             h_fence.observe(fence_wait_s)
-            ring_transitions = ring.size * B
+            ring_transitions = (ring.size if dp == 1 else store.size) * B
 
             # Stage 3 — train event for chunk g (samples the window
             # INCLUDING chunk g, exactly as the serial path does).
             did = 0
             ev_sample_s = ev_wait_s = 0.0
             ev_depth_sum = ev_stale = 0
-            if (ring.can_sample(cfg.learner.n_step)
-                    and ring.size * B >= cfg.replay.min_fill):
+            sampleable = (ring.can_sample(cfg.learner.n_step)
+                          if dp == 1
+                          else store.can_sample(cfg.learner.n_step))
+            if sampleable and ring_transitions >= cfg.replay.min_fill:
                 train_debt_iters += chunk_iters
                 events = train_debt_iters // max(cfg.train_every, 1)
                 train_debt_iters -= events * max(cfg.train_every, 1)
                 grads_this_chunk = events * updates_per_train
-                if grads_this_chunk:
+                if grads_this_chunk and dp > 1:
+                    # Data-parallel train event (ISSUE 10): each shard's
+                    # pipeline delivers its OWN row block onto its local
+                    # chip; assembly stitches the blocks into one global
+                    # row-sharded batch and the shard_map'd step runs
+                    # one pmean gradient allreduce per update. Per-shard
+                    # fences: every shard's ring published chunk g
+                    # (fenced above), so each shard's generation is
+                    # stable across the event.
+                    fence_gens = store.generation
+                    lb = train_batch // dp
+                    if prefetchers is not None:
+                        s0 = [(p.sample_s_total, p.wait_s_total,
+                               p.stale_total) for p in prefetchers]
+                        for s, p in enumerate(prefetchers):
+                            p.request(grads_this_chunk, fence_gens[s])
+                        for i in range(grads_this_chunk):
+                            parts, w_parts, auxes = [], [], []
+                            for s, p in enumerate(prefetchers):
+                                dev, aux = p.pop(fence_gens[s])
+                                ev_depth_sum += len(p)
+                                if per_samplers is not None:
+                                    tr, w_s = dev
+                                    parts.append(tr)
+                                    w_parts.append(w_s)
+                                else:
+                                    parts.append(dev)
+                                auxes.append(aux)
+                            batch = assemble_tree(parts)
+                            w = (assemble_tree(w_parts)
+                                 if per_samplers is not None else weights)
+                            state, metrics = train_jit(state, batch, w)
+                            _wb_add(auxes, metrics)
+                        for s, p in enumerate(prefetchers):
+                            ev_sample_s += p.sample_s_total - s0[s][0]
+                            ev_wait_s += p.wait_s_total - s0[s][1]
+                            ev_stale += p.stale_total - s0[s][2]
+                        sample_k = prefetchers[0].next_k
+                    else:
+                        # Serial dp reference (--no-prefetch): identical
+                        # per-(k, shard) RNG streams, so it draws the
+                        # SAME batches the prefetched path does.
+                        for i in range(grads_this_chunk):
+                            t_s = time.perf_counter()
+                            parts, w_parts, auxes = [], [], []
+                            for s in range(dp):
+                                host, aux = shard_samples[s](sample_k)
+                                if per_samplers is not None:
+                                    tr, w_s = host
+                                    parts.append(shard_puts[s](tr))
+                                    w_parts.append(shard_puts[s](w_s))
+                                else:
+                                    parts.append(shard_puts[s](host))
+                                auxes.append(aux)
+                            ev_sample_s += time.perf_counter() - t_s
+                            sample_k += 1
+                            batch = assemble_tree(parts)
+                            w = (assemble_tree(w_parts)
+                                 if per_samplers is not None else weights)
+                            state, metrics = train_jit(state, batch, w)
+                            _wb_add(auxes, metrics)
+                    did = grads_this_chunk
+                    grad_steps += did
+                    sample_s_total += ev_sample_s
+                    prefetch_wait_s_total += ev_wait_s
+                elif grads_this_chunk:
                     # The window every one of this event's batches must
                     # see: chunk g is published (fenced above) and
                     # chunk g+1's appends are gated until the event's
@@ -846,7 +1186,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # submit now, and its transfers overlap chunk g's train
             # execution and chunk g+2's collect.
             if pipeline and records is not None:
-                handle = worker.submit(records)
+                handle = submit_evac(records)
                 records = None
             if did:
                 jax.block_until_ready(state.params)
@@ -888,7 +1228,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 "device_idle_est_s": round(fence_wait_s, 4),
                 "d2h_bytes": d2h_bytes,
                 "ring_transitions": ring_transitions,
-                "ring_gb": round(ring.nbytes / 1e9, 3),
+                "ring_gb": round((ring.nbytes if dp == 1
+                                  else store.nbytes) / 1e9, 3),
                 # Sample-side overlap accounting (ISSUE 5): sample_s is
                 # the host sampling wall this chunk (on the critical
                 # path when prefetch is off, overlapped when on);
@@ -897,14 +1238,17 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 # at pop time; stale_batches the generation-fence drops.
                 "sample_s": round(ev_sample_s, 4),
                 "prefetch_wait_s": round(ev_wait_s, 4),
-                "prefetch_depth": round(ev_depth_sum / did, 2) if did
-                else 0.0,
+                "prefetch_depth": round(ev_depth_sum / (did * dp), 2)
+                if did else 0.0,
                 "stale_batches": ev_stale,
             }
             if t_evac_parts is not None:
                 row["chunk_collect_fetch_s"] = round(t_evac_parts[0], 4)
                 row["chunk_ring_s"] = round(t_evac_parts[1], 4)
-            if prefetcher is not None:
+            if prefetchers is not None:
+                row["h2d_staged_bytes"] = sum(p.bytes_staged
+                                              for p in prefetchers)
+            elif prefetcher is not None:
                 row["h2d_staged_bytes"] = prefetcher.bytes_staged
             elif stager is not None:
                 row["h2d_staged_bytes"] = stager.bytes_staged
@@ -934,8 +1278,14 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     finally:
         if worker is not None:
             worker.close()
+        if workers is not None:
+            for w in workers:
+                w.close()
         if prefetcher is not None:
             prefetcher.close()
+        if prefetchers is not None:
+            for p in prefetchers:
+                p.close()
         if ckpt is not None:
             tm_watchdog.unregister_emergency_hook("host_replay.checkpoint")
             try:
@@ -967,44 +1317,54 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                                        step=grad_steps)
     n = max(len(overlap_fracs), 1)
     g_grad_rate.set(grad_steps / wall)
+    _prefetch_on = prefetcher is not None or prefetchers is not None
+    _samplers = ([per_sampler] if per_sampler is not None
+                 else per_samplers if per_samplers is not None else [])
     return {
         "env_steps": env_steps, "grad_steps": grad_steps,
         "wall_s": round(wall, 1),
         "env_steps_per_sec": round(env_steps / wall, 1),
         "grad_steps_per_sec": round(grad_steps / wall, 1),
+        # n-chip scale-out provenance (ISSUE 10): the dp mesh width this
+        # run's aggregate rates were produced over (1 = single chip).
+        "dp_size": dp,
         # Learner-utilization config provenance (ISSUE 6): the knobs
         # that shaped this run's grad-step numbers.
         "replay_ratio": replay_ratio,
         "train_batch": train_batch,
         "actor_dtype": cfg.network.actor_dtype or "float32",
-        "ring_transitions": ring.size * B,
-        "ring_gb": round(ring.nbytes / 1e9, 3),
+        "ring_transitions": (ring.size if dp == 1 else store.size) * B,
+        "ring_gb": round((ring.nbytes if dp == 1 else store.nbytes)
+                         / 1e9, 3),
         "window_transitions_max": num_slots * B,
         "pipeline": pipeline,
-        "evac_slices": (evacuator.num_slices if evacuator is not None
-                        else 0),
+        "evac_slices": (evac_slices if (evacuator is not None
+                                        or workers is not None) else 0),
         "d2h_bytes_total": d2h_bytes_total,
         "evac_fence_wait_s_total": round(fence_wait_total, 4),
         "evac_overlap_frac_mean": round(sum(overlap_fracs) / n, 4),
         "param_checksum": param_checksum,
-        "double_buffer": stager is not None or prefetcher is not None,
+        "double_buffer": stager is not None or _prefetch_on,
         "h2d_staged_bytes": (
-            prefetcher.bytes_staged if prefetcher is not None
+            sum(p.bytes_staged for p in prefetchers)
+            if prefetchers is not None
+            else prefetcher.bytes_staged if prefetcher is not None
             else stager.bytes_staged if stager is not None else 0),
         # Sample-side pipeline summary (ISSUE 5).
-        "prefetch": prefetcher is not None,
-        "prefetch_depth": prefetch_depth if prefetcher is not None else 0,
-        "prioritized": per_sampler is not None,
+        "prefetch": _prefetch_on,
+        "prefetch_depth": prefetch_depth if _prefetch_on else 0,
+        "prioritized": bool(_samplers),
         "sample_s_total": round(sample_s_total, 4),
         "prefetch_wait_s_total": round(prefetch_wait_s_total, 4),
-        "stale_batches": (prefetcher.stale_total
-                          if prefetcher is not None else 0),
-        "prio_writeback_flushes": (per_sampler.writeback_flushes
-                                   if per_sampler is not None else 0),
-        "prio_writeback_rows": (per_sampler.writeback_rows
-                                if per_sampler is not None else 0),
-        "prio_writeback_dropped": (per_sampler.writeback_dropped
-                                   if per_sampler is not None else 0),
+        "stale_batches": (
+            sum(p.stale_total for p in prefetchers)
+            if prefetchers is not None
+            else prefetcher.stale_total if prefetcher is not None else 0),
+        "prio_writeback_flushes": sum(s.writeback_flushes
+                                      for s in _samplers),
+        "prio_writeback_rows": sum(s.writeback_rows for s in _samplers),
+        "prio_writeback_dropped": sum(s.writeback_dropped
+                                      for s in _samplers),
         "is_weight_mean": round(is_w_sum / is_w_count, 6)
         if is_w_count else 1.0,
         "is_weight_min": round(is_w_min, 6) if is_w_count else 1.0,

@@ -26,6 +26,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dist_dqn_tpu.telemetry import get_registry
+from dist_dqn_tpu.utils import compat
 
 from dist_dqn_tpu.agents.dqn import LearnerState
 from dist_dqn_tpu.config import ExperimentConfig
@@ -94,13 +95,16 @@ def _mesh_wrap(mesh: Mesh, specs, init_local, run_local):
     """Lift per-device (init, run_chunk) bodies to jit-compiled functions on
     GLOBAL arrays; the carry is donated so replay shards update in place in
     each device's HBM."""
+    # donation: init consumes only a PRNG key (run() donates the carry).
+    # mesh-axis: specs name the dp axis (built by the _carry_specs family).
     init = jax.jit(
-        jax.shard_map(init_local, mesh=mesh, in_specs=P(),
-                      out_specs=specs, check_vma=False))
+        compat.shard_map(init_local, mesh=mesh, in_specs=P(),
+                         out_specs=specs, check_vma=False))
 
     @partial(jax.jit, static_argnums=1, donate_argnums=0)
     def run(carry, num_iters: int):
-        body = jax.shard_map(
+        # mesh-axis: specs name the dp axis (see _carry_specs).
+        body = compat.shard_map(
             lambda c: run_local(c, num_iters), mesh=mesh,
             in_specs=(specs,), out_specs=(specs, P()), check_vma=False)
         return body(carry)
@@ -160,6 +164,82 @@ def make_mesh_r2d2_train(cfg: ExperimentConfig, env: JaxEnv, net,
     init_local, run_local = make_r2d2_train(cfg, env, net, axis_name=axis,
                                             num_shards=ndp)
     return _mesh_wrap(mesh, _r2d2_carry_specs(axis), init_local, run_local)
+
+
+def train_step_specs(axis: str, recurrent: bool = False):
+    """(data_specs, metric_specs) for one data-parallel train step: batch
+    leaves shard their row axis over ``axis``, IS weights shard with
+    them, pmean-reduced scalars replicate, per-example priorities stay
+    sharded. The ONE spec set every host-side data-parallel learner
+    (apex service, host-replay runtime, multi-host wrapper) lifts the
+    per-device step with — the specs cannot drift apart per runtime.
+    """
+    from dist_dqn_tpu.types import SequenceSample, Transition
+
+    repl = P()
+    if recurrent:
+        # Time-major [L, S, ...] fields shard the sequence axis (1).
+        data_specs = (SequenceSample(
+            obs=P(None, axis), action=P(None, axis),
+            reward=P(None, axis), done=P(None, axis),
+            reset=P(None, axis), start_state=(P(axis), P(axis)),
+            weights=P(axis), t_idx=P(axis), b_idx=P(axis)),)
+        metric_specs = {"loss": repl, "raw_loss": repl,
+                        "priorities": P(axis), "grad_norm": repl}
+    else:
+        data_specs = (jax.tree.map(
+            lambda _: P(axis),
+            Transition(obs=0, action=0, reward=0, discount=0,
+                       next_obs=0)),
+            P(axis))  # batch, weights
+        metric_specs = {"loss": repl, "raw_loss": repl,
+                        "priorities": P(axis), "grad_norm": repl,
+                        "mean_q_target_gap": repl}
+    return data_specs, metric_specs
+
+
+def scan_train_step_specs(axis: str):
+    """Specs for the replay-ratio SCAN dispatch (agents/dqn.py
+    make_scan_train with ``flatten=False``): batches carry a leading
+    sub-step axis N, so rows shard on axis 1 and the returned
+    priorities keep [N, local_rows] shape per shard — the host reshapes
+    the global [N, B] to the chronological [N*B] the batched write-back
+    expects (a sharded flat concat would interleave by device block,
+    not by sub-step)."""
+    from dist_dqn_tpu.types import Transition
+
+    repl = P()
+    data_specs = (jax.tree.map(
+        lambda _: P(None, axis),
+        Transition(obs=0, action=0, reward=0, discount=0, next_obs=0)),
+        P(None, axis))  # stacked batches, stacked weights
+    metric_specs = {"loss": repl, "raw_loss": repl,
+                    "priorities": P(None, axis), "grad_norm": repl,
+                    "mean_q_target_gap": repl}
+    return data_specs, metric_specs
+
+
+def make_sharded_train_step(train_step, mesh: Mesh, data_specs,
+                            metric_specs):
+    """Lift a per-device train step (built with ``axis_name`` set, so the
+    pmean grad allreduce lives INSIDE it — agents/) onto ``mesh``: batch
+    leaves shard per ``data_specs``, learner state replicates, and the
+    state is donated so replicas update in place. Shared by the apex
+    service's local learner mesh and the host-replay dp runtime."""
+    repl = P()
+
+    def sharded(state, *data):
+        state_spec = jax.tree.map(lambda _: repl, state,
+                                  is_leaf=lambda x: x is None)
+        # mesh-axis: data_specs/metric_specs name the axis
+        # (train_step_specs / scan_train_step_specs).
+        body = compat.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(state_spec,) + tuple(data_specs),
+            out_specs=(state_spec, metric_specs), check_vma=False)
+        return body(state, *data)
+
+    return jax.jit(sharded, donate_argnums=0)
 
 
 def global_metrics(metrics: Dict) -> Dict:

@@ -339,7 +339,8 @@ class PrioritizedHostReplay:
         self.added = 0
         self.sampled = 0
         # Sticky-ingest placement accounting (ISSUE 9): items per
-        # routing shard — shard count is 1 until ROADMAP item 1.
+        # routing shard (the sharded facade routes by it; on this
+        # single store the tag is placement accounting).
         self.added_by_shard: Dict[int, int] = {}
         # Telemetry (ISSUE 1): occupancy/eviction/priority-distribution
         # for the host shard. Instruments are cached here — the add/
@@ -388,10 +389,11 @@ class PrioritizedHostReplay:
         """Ring-write a batch; new items default to the running max priority.
 
         ``shard`` is the sticky-ingest routing tag (ingest/router.py,
-        ISSUE 9): today the service owns ONE shard and the tag is pure
-        accounting (``added_by_shard``); when ROADMAP item 1 shards the
-        store, this is the append-path hook that places the batch in
-        the shard that will sample it."""
+        ISSUE 9): on this single store it is placement accounting
+        (``added_by_shard``); the sharded facade
+        (replay/sharded.py ShardedPrioritizedReplay, ISSUE 10) routes
+        each batch to the sub-store this tag names — the shard that
+        will sample it."""
         batch = next(iter(items.values())).shape[0]
         if shard is not None:
             self.added_by_shard[shard] = \

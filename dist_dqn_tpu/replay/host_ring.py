@@ -474,6 +474,47 @@ class RingPrioritySampler:
                                 slot_gen=slot_gen, weights=w,
                                 generation=generation)
 
+    def sample_at_mass(self, mass_positions: np.ndarray, gamma: float
+                       ) -> Tuple[HostBatch, PerSample, np.ndarray]:
+        """Draw + gather at EXPLICIT sum-tree mass positions — the
+        per-shard leg of a cross-shard stratified draw (replay/
+        sharded.py): the facade lays one stratified ladder over the
+        concatenated per-shard totals and hands each shard its local
+        mass values, so draws land here in proportion to THIS tree's
+        mass. Returns (batch, bookkeeping, raw p^alpha mass per row —
+        zeroed where a boundary-pathology draw was substituted, so the
+        caller's IS weights zero those rows exactly like :meth:`sample`
+        does). ``PerSample.weights`` is a placeholder here; the facade
+        owns the globally-normalized weights."""
+        ring = self._ring
+        B = ring.num_envs
+        mass_positions = np.asarray(mass_positions, np.float64)
+        n = mass_positions.shape[0]
+        with ring._fence:
+            num_valid = ring.size - self.n_step - ring._extra()
+            if num_valid <= 0:
+                raise ValueError(
+                    "ring not sampleable yet (gate on can_sample)")
+            leaf = self.tree.sample(mass_positions)
+            mass = self.tree.get(leaf)
+            bad = mass <= 0.0
+            if bad.any():
+                oldest_valid = ((ring.pos - ring.size + ring._extra())
+                                % ring.num_slots) * B
+                leaf = np.where(bad, oldest_valid, leaf)
+                mass = np.where(bad, 0.0, self.tree.get(leaf))
+            t_idx = (leaf // B).astype(np.int32)
+            b_idx = (leaf % B).astype(np.int32)
+            slot_gen = self._ring.slot_gen[t_idx].copy()
+            generation = ring.generation
+            batch = ring._gather_locked(t_idx, b_idx, self.n_step, gamma)
+        ring._c_sampled.inc(n)
+        per = PerSample(leaf=leaf, t_idx=t_idx, b_idx=b_idx,
+                        slot_gen=slot_gen,
+                        weights=np.zeros(n, np.float32),
+                        generation=generation)
+        return batch, per, mass
+
     # -- priority write-backs ----------------------------------------------
     def update_priorities(self, leaf: np.ndarray, priorities: np.ndarray,
                           expected_gen: np.ndarray) -> Tuple[int, int]:

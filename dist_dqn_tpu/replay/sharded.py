@@ -1,0 +1,446 @@
+"""Sharded host replay: N per-shard stores behind one facade (ISSUE 10,
+ROADMAP item 1 — the store PR 9's sticky actor->shard router was built
+for).
+
+Two facades, one per runtime family:
+
+* :class:`ShardedHostReplay` — N ``HostTimeRing`` shards (lane blocks of
+  the collect chunk), each with its own generation fence and, in PER
+  mode, its own ``RingPrioritySampler`` sum-tree. The host-replay dp
+  runtime gives every shard its own EvacuationWorker/SamplePrefetcher
+  pipeline feeding its local chip (host_replay_loop.py); cross-shard
+  prioritized draws go through :meth:`ShardedHostReplay.sample` — ONE
+  stratified mass ladder over the CONCATENATED per-shard sum-tree
+  masses, so P(i) = p_i^alpha / sum-over-every-shard stays exactly the
+  single-tree distribution (draws land in each shard in proportion to
+  its tree mass) and the IS weights use the global total. With one
+  shard the facade DELEGATES to the bare ring/sampler — bit-identical
+  by construction, pinned by tests/test_sharded_replay.py.
+
+* :class:`ShardedPrioritizedReplay` — N ``PrioritizedHostReplay`` item
+  shards for the Ape-X service. Inserts carry the sticky shard id the
+  ingest router stamped into the frame header (ingest/router.py), so a
+  trajectory lands DIRECTLY in the shard that will sample it; draws use
+  the same global-mass stratification; slot ids are globally encoded as
+  ``shard * shard_capacity + local`` so the service's pipelined
+  write-back path (idx, generation guards, batched flushes) works
+  unchanged.
+
+Like the stores they wrap, this module must not import jax — host DRAM
+residency is the point.
+
+Why per-shard draws stay IS-correct (the fixed-width dp path): when the
+dp learner draws exactly ``b/N`` rows from EACH shard (device alignment
+requires equal widths), row i's true inclusion probability is
+``p_i / (N * T_s)``; the weight ``(valid_global * p_true)^-beta``
+algebraically equals the shard-local formula
+``(valid_shard * p_i / T_s)^-beta`` because ``valid_global =
+N * valid_shard`` for equal shards — the N cancels. Per-shard draws
+with the UNCHANGED local sampler therefore already produce the
+globally-correct IS weights; only the max-normalization constant is
+per-shard (the same convention the fused multi-chip PER path uses).
+tests/test_sharded_replay.py checks the algebra numerically.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dist_dqn_tpu.replay.host import (PrioritizedHostReplay,
+                                      stratified_mass)
+from dist_dqn_tpu.replay.host_ring import (HostBatch, HostTimeRing,
+                                           PerSample, RingPrioritySampler)
+
+
+def _shard_edges(totals: np.ndarray) -> np.ndarray:
+    """Cumulative mass edges for mapping a global stratified mass ladder
+    onto per-shard trees (empty shards get zero-width intervals that no
+    mass value can land in)."""
+    return np.cumsum(totals)
+
+
+def _map_mass_to_shards(mass: np.ndarray, totals: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """(shard id, shard-local mass) per global mass value. ``mass`` is
+    ascending (stratified), so rows come out shard-contiguous in shard
+    order — the same ordering a single concatenated tree would yield."""
+    edges = _shard_edges(totals)
+    shard_of = np.searchsorted(edges, mass, side="right")
+    shard_of = np.minimum(shard_of, totals.shape[0] - 1).astype(np.int64)
+    local = mass - (edges[shard_of] - totals[shard_of])
+    return shard_of, local
+
+
+class ShardedHostReplay:
+    """N per-shard ``HostTimeRing`` (lane blocks) behind one facade.
+
+    ``num_shards == 1`` is the equivalence pin: every method delegates
+    straight to the single ring/sampler, so the facade is bit-identical
+    to the bare store (same RNG consumption, same draws, same weights).
+
+    Shards append in lockstep (every collect chunk lands one lane block
+    per shard), so the aggregate ``size``/``can_sample`` read shard 0
+    and assert agreement where it is cheap to do so.
+    """
+
+    def __init__(self, num_shards: int, num_slots: int,
+                 lanes_per_shard: int, obs_shape, obs_dtype,
+                 frame_stack: int = 0):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_shards = int(num_shards)
+        self.num_slots = int(num_slots)
+        self.lanes_per_shard = int(lanes_per_shard)
+        self.rings: List[HostTimeRing] = [
+            HostTimeRing(num_slots, lanes_per_shard, obs_shape, obs_dtype,
+                         frame_stack=frame_stack)
+            for _ in range(self.num_shards)
+        ]
+        self.samplers: Optional[List[RingPrioritySampler]] = None
+        #: flat-leaf stride for global slot encoding (shard * stride + local)
+        self.leaf_stride = self.num_slots * self.lanes_per_shard
+
+    # -- construction -------------------------------------------------------
+    def attach_priority_samplers(self, n_step: int, alpha: float,
+                                 beta: float, eps: float,
+                                 native: Optional[bool] = None,
+                                 name: str = "host_replay"
+                                 ) -> List[RingPrioritySampler]:
+        """One sum-tree sampler per shard, registered on each ring's
+        publish hook (per-shard generation fences stay per-shard)."""
+        self.samplers = [
+            RingPrioritySampler(ring, n_step=n_step, alpha=alpha,
+                                beta=beta, eps=eps, native=native,
+                                name=f"{name}_s{i}" if self.num_shards > 1
+                                else name)
+            for i, ring in enumerate(self.rings)
+        ]
+        return self.samplers
+
+    # -- aggregate ring surface --------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.rings[0].size
+
+    @property
+    def generation(self) -> List[int]:
+        return [r.generation for r in self.rings]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(r.nbytes for r in self.rings)
+
+    @property
+    def num_envs(self) -> int:
+        return self.lanes_per_shard * self.num_shards
+
+    def can_sample(self, n_step: int) -> bool:
+        return all(r.can_sample(n_step) for r in self.rings)
+
+    def add_chunk(self, shard: int, obs, action, reward, terminated,
+                  truncated) -> None:
+        """Append one lane block to its owning shard's ring (atomic under
+        that shard's generation fence)."""
+        self.rings[shard].add_chunk(obs, action, reward, terminated,
+                                    truncated)
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Whole-window snapshot, one sub-dict per shard. No production
+        caller yet — run_host_replay refuses --checkpoint-dir at dp > 1
+        until resume can be proven bit-identical; this (and the
+        shard-count pin in load_state_dict) is the half that already
+        exists for that follow-up."""
+        out: Dict[str, np.ndarray] = {
+            "num_shards": np.int64(self.num_shards)}
+        for i, r in enumerate(self.rings):
+            out.update({f"shard{i}_{k}": v
+                        for k, v in r.state_dict().items()})
+        return out
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        saved = int(state["num_shards"])
+        if saved != self.num_shards:
+            raise ValueError(
+                f"replay snapshot was written with {saved} shards, this "
+                f"run configures {self.num_shards} — resume with the "
+                "same shard count (re-sharding a checkpointed window is "
+                "not supported)")
+        for i, r in enumerate(self.rings):
+            prefix = f"shard{i}_"
+            r.load_state_dict({k[len(prefix):]: v
+                               for k, v in state.items()
+                               if k.startswith(prefix)})
+
+    # -- cross-shard prioritized sampling -----------------------------------
+    def sample(self, rng: np.random.Generator, batch_size: int,
+               gamma: float) -> Tuple[HostBatch, PerSample]:
+        """Stratified prioritized draw across EVERY shard's sum-tree:
+        one global mass ladder over the concatenated per-shard masses,
+        so draws land in each shard in proportion to its tree mass and
+        P(i) is exactly the single-tree distribution. Returns the
+        gathered batch plus ONE PerSample whose ``leaf`` is globally
+        encoded (``shard * leaf_stride + local``) and whose IS weights
+        use the global total/valid count, normalized over the whole
+        batch. 1-shard delegates to the bare sampler (bit-identical).
+
+        Who draws what: this is the SINGLE-CONSUMER draw — one learner
+        sampling the whole sharded window (and the reference semantics
+        the tests pin). The dp runtime's train event instead draws a
+        fixed-width row block PER SHARD through each shard's own
+        sampler (device alignment requires equal widths; the module
+        docstring carries the algebra showing those per-shard draws
+        already produce the globally-correct IS weights)."""
+        if self.samplers is None:
+            raise ValueError("attach_priority_samplers() first")
+        if self.num_shards == 1:
+            return self.samplers[0].sample(rng, batch_size, gamma)
+        totals = np.array([s.tree.total for s in self.samplers],
+                          np.float64)
+        T = float(totals.sum())
+        if T <= 0.0:
+            raise ValueError("sharded sample() with zero total priority "
+                             "mass (gate on can_sample)")
+        mass = stratified_mass(rng, batch_size, T)
+        shard_of, local_mass = _map_mass_to_shards(mass, totals)
+        valid_global = sum(
+            (r.size - s.n_step - r._extra()) * r.num_envs
+            for r, s in zip(self.rings, self.samplers))
+        obs_parts, act_parts, rew_parts, disc_parts, next_parts = \
+            [], [], [], [], []
+        leaf_parts, t_parts, b_parts, gen_parts, p_parts = \
+            [], [], [], [], []
+        generations = []
+        for s_id in range(self.num_shards):
+            rows = shard_of == s_id
+            n = int(rows.sum())
+            if n == 0:
+                generations.append(self.rings[s_id].generation)
+                continue
+            batch, per, p_mass = self.samplers[s_id].sample_at_mass(
+                local_mass[rows], gamma)
+            obs_parts.append(batch.obs)
+            act_parts.append(batch.action)
+            rew_parts.append(batch.reward)
+            disc_parts.append(batch.discount)
+            next_parts.append(batch.next_obs)
+            leaf_parts.append(per.leaf + s_id * self.leaf_stride)
+            t_parts.append(per.t_idx)
+            b_parts.append(per.b_idx)
+            gen_parts.append(per.slot_gen)
+            p_parts.append(p_mass)
+            generations.append(per.generation)
+        p_raw = np.concatenate(p_parts)
+        bad = p_raw <= 0.0          # substituted boundary-pathology rows
+        p_sel = p_raw / max(T, 1e-300)
+        w = (valid_global * np.maximum(p_sel, 1e-12)) ** \
+            (-self.samplers[0].beta)
+        # Normalize over the REAL rows only: a substituted row's clamped
+        # p would otherwise dominate the max and crush every weight.
+        norm = float(w[~bad].max()) if (~bad).any() else float(w.max())
+        w = (w / norm).astype(np.float32)
+        if bad.any():
+            w[bad] = 0.0
+        batch = HostBatch(obs=np.concatenate(obs_parts),
+                          action=np.concatenate(act_parts),
+                          reward=np.concatenate(rew_parts),
+                          discount=np.concatenate(disc_parts),
+                          next_obs=np.concatenate(next_parts))
+        per = PerSample(leaf=np.concatenate(leaf_parts),
+                        t_idx=np.concatenate(t_parts),
+                        b_idx=np.concatenate(b_parts),
+                        slot_gen=np.concatenate(gen_parts),
+                        weights=w,
+                        # max generation across shards: callers that
+                        # fence on a scalar get the newest window seen.
+                        generation=max(generations))
+        return batch, per
+
+    def update_priorities(self, leaf: np.ndarray, priorities: np.ndarray,
+                          expected_gen: np.ndarray) -> Tuple[int, int]:
+        """Route globally-encoded slot ids back to their shard's sampler
+        — one flush PER SHARD, each under its own generation fence."""
+        if self.samplers is None:
+            raise ValueError("attach_priority_samplers() first")
+        if self.num_shards == 1:
+            return self.samplers[0].update_priorities(
+                leaf, priorities, expected_gen=expected_gen)
+        leaf = np.asarray(leaf, np.int64)
+        priorities = np.asarray(priorities, np.float64)
+        expected_gen = np.asarray(expected_gen, np.int64)
+        shard_of = leaf // self.leaf_stride
+        applied = dropped = 0
+        for s_id in range(self.num_shards):
+            rows = shard_of == s_id
+            if not rows.any():
+                continue
+            a, d = self.samplers[s_id].update_priorities(
+                leaf[rows] - s_id * self.leaf_stride, priorities[rows],
+                expected_gen=expected_gen[rows])
+            applied += a
+            dropped += d
+        return applied, dropped
+
+
+class ShardedPrioritizedReplay:
+    """N ``PrioritizedHostReplay`` item shards for the Ape-X service.
+
+    The drop-in sharded twin of the single store: ``add`` routes each
+    batch to the sticky shard the ingest router assigned its actor
+    (ingest/router.py — the id every zero-copy frame header carries),
+    ``sample`` runs the global-mass stratified draw across the per-shard
+    sum-trees, and slot ids are globally encoded
+    (``shard * shard_capacity + local``) so the service's pipelined
+    priority write-backs, generation guards and batched flushes work
+    unchanged. The host sampler backend only — the on-device priority
+    plane (``device_sampling``) owns one contiguous plane and has no
+    per-shard story yet (the constructor refuses, loudly).
+    """
+
+    def __init__(self, num_shards: int, capacity: int, alpha: float = 0.6,
+                 priority_eps: float = 1e-6, seed: int = 0,
+                 native: Optional[bool] = None):
+        if num_shards < 2:
+            raise ValueError(
+                "ShardedPrioritizedReplay needs num_shards >= 2; one "
+                "shard is the plain PrioritizedHostReplay")
+        self.num_shards = int(num_shards)
+        # Total capacity split evenly; ceil so the configured window is
+        # a floor, not a ceiling.
+        self.shard_capacity = -(-int(capacity) // self.num_shards)
+        self.capacity = self.shard_capacity * self.num_shards
+        self.alpha = float(alpha)
+        self.shards: List[PrioritizedHostReplay] = [
+            PrioritizedHostReplay(self.shard_capacity, alpha=alpha,
+                                  priority_eps=priority_eps,
+                                  seed=seed + 7 * i, native=native)
+            for i in range(self.num_shards)
+        ]
+        self._rng = np.random.default_rng(seed)
+        self.sampled = 0
+        self.added_by_shard: Dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    @property
+    def added(self) -> int:
+        return sum(s.added for s in self.shards)
+
+    def add(self, items: Dict[str, np.ndarray],
+            priorities: Optional[np.ndarray] = None,
+            shard: Optional[int] = None) -> None:
+        """Insert into the sticky shard. ``shard`` is REQUIRED here —
+        an unattributed insert (the legacy concatenated bootstrap path)
+        cannot be placed honestly in a sharded store."""
+        if shard is None:
+            raise ValueError(
+                "sharded replay insert without a shard id: ingest_shards "
+                "> 1 requires per-actor insert attribution — run the "
+                "zerocopy transport with actor priorities (or the "
+                "recurrent assembler), not the legacy concatenated "
+                "bootstrap path")
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(f"shard {shard} out of range "
+                             f"[0, {self.num_shards})")
+        batch = next(iter(items.values())).shape[0]
+        self.added_by_shard[shard] = \
+            self.added_by_shard.get(shard, 0) + batch
+        self.shards[shard].add(items, priorities=priorities)
+
+    def sample(self, batch_size: int, beta: float
+               ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+        """Stratified prioritized draw across every shard's tree: one
+        global mass ladder, draws per shard in proportion to its tree
+        mass (P(i) = p_i^alpha / global total — exactly the single-tree
+        distribution), IS weights from the global total/size with one
+        batch-wide max normalization."""
+        size = len(self)
+        if size == 0:
+            raise ValueError("sample() on an empty replay shard")
+        totals = np.array([s.tree.total for s in self.shards], np.float64)
+        T = float(totals.sum())
+        mass = stratified_mass(self._rng, batch_size, T)
+        shard_of, local_mass = _map_mass_to_shards(mass, totals)
+        idx_g = np.empty(batch_size, np.int64)
+        p_sel = np.empty(batch_size, np.float64)
+        out: Optional[Dict[str, np.ndarray]] = None
+        for s_id in range(self.num_shards):
+            rows = shard_of == s_id
+            if not rows.any():
+                continue
+            s = self.shards[s_id]
+            idx = s.tree.sample(local_mass[rows])
+            idx = np.minimum(idx, max(len(s), 1) - 1)
+            p_sel[rows] = s.tree.get(idx) / max(T, 1e-300)
+            idx_g[rows] = idx + s_id * self.shard_capacity
+            if out is None:
+                out = {k: np.empty((batch_size,) + v.shape[1:], v.dtype)
+                       for k, v in s._data.items()}
+            for k, v in s._data.items():
+                out[k][rows] = v[idx]
+            # Keep each sub-store's sample instrumentation live even
+            # though the gather bypasses its sample(): the per-store
+            # dqn_replay_sampled_total / priority-mass series are what
+            # dashboards ratio against the add counters.
+            n_rows = int(rows.sum())
+            s.sampled += n_rows
+            s._c_sampled.inc(n_rows)
+            s._g_mass.set(s.tree.total)
+        weights = (size * np.maximum(p_sel, 1e-12)) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        self.sampled += batch_size
+        return out, idx_g, weights
+
+    def generation(self, idx: np.ndarray) -> np.ndarray:
+        idx = np.asarray(idx, np.int64)
+        shard_of = idx // self.shard_capacity
+        out = np.empty(idx.shape[0], np.int64)
+        for s_id in range(self.num_shards):
+            rows = shard_of == s_id
+            if rows.any():
+                out[rows] = self.shards[s_id].generation(
+                    idx[rows] - s_id * self.shard_capacity)
+        return out
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray,
+                          expected_gen: Optional[np.ndarray] = None
+                          ) -> None:
+        """Per-shard batched write-back flushes: rows route to their
+        owning shard's tree, each applied as one vectorized set."""
+        idx = np.asarray(idx, np.int64)
+        priorities = np.asarray(priorities, np.float64)
+        shard_of = idx // self.shard_capacity
+        for s_id in range(self.num_shards):
+            rows = shard_of == s_id
+            if not rows.any():
+                continue
+            self.shards[s_id].update_priorities(
+                idx[rows] - s_id * self.shard_capacity, priorities[rows],
+                expected_gen=(None if expected_gen is None
+                              else np.asarray(expected_gen)[rows]))
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {
+            "num_shards": np.int64(self.num_shards)}
+        for i, s in enumerate(self.shards):
+            if len(s) == 0:
+                continue
+            out.update({f"shard{i}.{k}": v
+                        for k, v in s.state_dict().items()})
+        return out
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        saved = int(state["num_shards"])
+        if saved != self.num_shards:
+            raise ValueError(
+                f"replay snapshot was written with ingest_shards={saved}, "
+                f"this run configures {self.num_shards} — resume with "
+                "the same shard count (re-sharding a checkpointed "
+                "window is not supported)")
+        for i, s in enumerate(self.shards):
+            prefix = f"shard{i}."
+            sub = {k[len(prefix):]: v for k, v in state.items()
+                   if k.startswith(prefix)}
+            if sub:
+                s.load_state_dict(sub)

@@ -124,8 +124,8 @@ INGEST_DEGRADED = "dqn_ingest_degraded"
 # experience path (dist_dqn_tpu/ingest/). RECORDS/BYTES are labeled
 # {transport="shm"|"tcp"|"legacy"} (slot ring / zero-copy wire / the
 # JSON-codec fallback paths); SHARD_RECORDS counts sticky-router
-# placement per {shard} (shard count is 1 until ROADMAP item 1 lands —
-# the family exists NOW so the scale-out is a config change);
+# placement per {shard} (backed by the ISSUE 10 sharded store when
+# --ingest-shards > 1; one shard otherwise);
 # DECODE_ERRORS counts records rejected whole at the codec gate per
 # {reason}; SHM_TORN counts slot-ring records dropped on a seqlock
 # stamp mismatch; ACTOR_PRIO_TRANSITIONS counts transitions inserted

@@ -514,10 +514,15 @@ def main():
                              "`timeout` you wrap the run in; a run killed "
                              "mid-device-op wedges the shared TPU tunnel)")
     parser.add_argument("--mesh-devices", type=int, default=1,
-                        help="fused runtime: run over a dp mesh of this "
-                             "many devices (0 = all; multi-process runs "
-                             "use the GLOBAL device list). Replay shards "
-                             "per device, gradients pmean over the mesh")
+                        help="fused + host-replay runtimes: run over a "
+                             "dp mesh of this many devices (0 = all; "
+                             "multi-process runs use the GLOBAL device "
+                             "list). Fused: env lanes + replay shard "
+                             "per device. Host-replay: env-lane blocks "
+                             "+ one host ring / evac worker / sample "
+                             "prefetcher per device. Gradients pmean "
+                             "over the mesh either way; apex uses "
+                             "--learner-devices instead")
     parser.add_argument("--coordinator", default=None,
                         help="multi-host: host:port of process 0's "
                              "jax.distributed coordinator. Every host runs "
@@ -570,10 +575,16 @@ def main():
                              "--transport zerocopy (A/B baseline; "
                              "re-enables native assembly)")
     parser.add_argument("--ingest-shards", type=int, default=1,
-                        help="apex runtime: sticky replay-shard count "
-                             "for ingest routing (must stay 1 until the "
-                             "sharded store lands; the id is threaded "
-                             "through frames + telemetry now)")
+                        help="apex runtime: replay-shard count — the "
+                             "store splits into N PrioritizedHostReplay "
+                             "shards and every actor's stream lands in "
+                             "its sticky crc32 shard (ingest/router.py; "
+                             "records_by_shard in the summary proves "
+                             "the spread). N > 1 requires the zerocopy "
+                             "transport with actor priorities (or a "
+                             "recurrent config) for per-actor insert "
+                             "attribution, and the host tree sampler "
+                             "(no --device-sampling)")
     parser.add_argument("--remote-actor-mode", choices=("local", "external"),
                         default="local",
                         help="local: the service spawns its remote actors "
@@ -672,10 +683,6 @@ def main():
             if val is not None:
                 print(f"# {name} is not supported by --runtime "
                       "host-replay (prototype surface); ignored")
-        for val, name in ((args.mesh_devices != 1, "--mesh-devices"),):
-            if val:
-                print(f"# {name} is not supported by --runtime "
-                      "host-replay (prototype surface); ignored")
         if args.checkpoint_replay:
             print("# --checkpoint-replay is implied by --runtime "
                   "host-replay --checkpoint-dir: its checkpoints are "
@@ -720,7 +727,8 @@ def main():
             # None = follow cfg.replay.prioritized; --per forces it on.
             prioritized=True if args.per else None,
             checkpoint_dir=args.checkpoint_dir,
-            save_every_frames=args.save_every_frames)
+            save_every_frames=args.save_every_frames,
+            mesh_devices=args.mesh_devices)
         out.pop("history", None)
         print(json.dumps(out))
         return
@@ -729,8 +737,9 @@ def main():
             print("# --profile-dir applies to the fused runtime only; "
                   "ignored under --runtime apex")
         if args.mesh_devices != 1:
-            print("# --mesh-devices applies to the fused runtime only; "
-                  "use --learner-devices for apex batch sharding")
+            print("# --mesh-devices applies to the fused/host-replay "
+                  "runtimes; use --learner-devices for apex batch "
+                  "sharding")
         if args.stop_at_return is not None:
             print("# --stop-at-return applies to the fused runtime only; "
                   "ignored under --runtime apex")

@@ -35,7 +35,11 @@ from pathlib import Path
 SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py")
 
 #: What makes a jitted expression a train/collect entry point.
-TARGET = re.compile(r"train|collect|chunk")
+#: ``shard`` joined in ISSUE 10: the data-parallel learners wrap their
+#: train steps in closures named ``sharded`` (parallel/learner.py
+#: make_sharded_train_step), which the train/collect/chunk patterns
+#: would silently stop seeing.
+TARGET = re.compile(r"train|collect|chunk|shard")
 #: Rationale escape hatch: a nearby comment owning the decision.
 RATIONALE = re.compile(r"#.*donation:")
 

@@ -72,6 +72,30 @@ def test_host_replay_bench_smoke():
     assert row["platforms"] == "cpu"
 
 
+def test_scaling_bench_smoke():
+    """The n-chip scale-out row (ISSUE 10): dp=1 vs dp=N host-replay
+    legs with aggregate + per-chip rates, and the honest-contract JSON
+    shape the battery stage captures. Apex leg skipped — the fleet
+    spread is pinned by test_apex_integration's e2e; this smoke pins
+    the harness mechanics."""
+    proc = _run([sys.executable, "benchmarks/scaling_bench.py",
+                 "--allow-cpu", "--force-host-devices", "4", "--dp", "2",
+                 "--chunks", "4", "--skip-apex"])
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rows = _json_rows(proc.stdout)
+    bench = [r for r in rows if r.get("metric") == "dp_scaling"]
+    assert len(bench) == 1
+    row = bench[0]
+    assert row["dp_size"] == 2
+    legs = row["host_replay"]
+    assert legs["dp1"]["dp_size"] == 1 and legs["dp2"]["dp_size"] == 2
+    for leg in legs.values():
+        assert leg["grad_steps"] > 0
+        assert leg["env_steps_per_sec_per_chip"] == pytest.approx(
+            leg["env_steps_per_sec"] / leg["dp_size"], rel=0.01)
+    assert row["scaling"]["grad_steps_x"] > 0
+
+
 def test_roofline_inscan_smoke():
     """The in-scan differencing harness (VERDICT round-4 weak #3): the
     never-train variant must measure zero grad steps and the te=1/te=2

@@ -1,0 +1,340 @@
+"""Sharded host replay (ISSUE 10, replay/sharded.py) — the load-bearing
+assertions:
+
+* the 1-SHARD EQUIVALENCE pin: a ``ShardedHostReplay`` with one shard
+  must be BIT-identical to the bare ``HostTimeRing`` +
+  ``RingPrioritySampler`` on the same stream and RNG — the facade may
+  not perturb the single-chip program it wraps;
+* PER-SHARD MASS PROPORTIONALITY: cross-shard stratified draws land in
+  each shard in proportion to its sum-tree mass (P(i) = p^alpha over
+  the GLOBAL total — the single-tree distribution, sharded);
+* IS-WEIGHT CORRECTNESS across shards: facade weights equal the
+  brute-force ``(N_valid * P(i))^-beta`` computation from global
+  totals, max-normalized over the whole batch;
+* WRITE-BACK ROUTING: globally-encoded slot ids land in the right
+  shard's tree, per-shard flushes, generation guards intact;
+* ROUTER -> RING PLACEMENT under ``ingest_shards=2``: the apex store
+  puts every insert in the shard the sticky crc32 router assigned its
+  actor, and a changed shard count refuses a snapshot restore;
+* the DP MESH RUN: ``run_host_replay`` over a 4-device slice of the
+  8-device CPU mesh completes with the pmean grad-allreduce path
+  exercised, and the prefetched dp path matches the serial dp
+  reference bit-for-bit (same per-(k, shard) RNG streams).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.ingest.router import shard_for
+from dist_dqn_tpu.replay.host import PrioritizedHostReplay
+from dist_dqn_tpu.replay.host_ring import HostTimeRing, RingPrioritySampler
+from dist_dqn_tpu.replay.sharded import (ShardedHostReplay,
+                                         ShardedPrioritizedReplay)
+
+
+def _fill_ring(ring_like, shard, rng, chunks=3, C=24):
+    lanes = (ring_like.rings[shard].num_envs
+             if isinstance(ring_like, ShardedHostReplay)
+             else ring_like.num_envs)
+    for _ in range(chunks):
+        args = (rng.random((C, lanes, 5), np.float32),
+                rng.integers(0, 4, (C, lanes)).astype(np.int32),
+                rng.random((C, lanes)).astype(np.float32),
+                np.zeros((C, lanes), bool), np.zeros((C, lanes), bool))
+        if isinstance(ring_like, ShardedHostReplay):
+            ring_like.add_chunk(shard, *args)
+        else:
+            ring_like.add_chunk(*args)
+
+
+def test_one_shard_facade_bit_identical_to_bare_ring():
+    stream = np.random.default_rng(3)
+    ring = HostTimeRing(64, 8, (5,), np.float32)
+    facade = ShardedHostReplay(1, 64, 8, (5,), np.float32)
+    for target in (ring, facade):
+        _fill_ring(target, 0, np.random.default_rng(11))
+    bare = RingPrioritySampler(ring, n_step=3)
+    facade.attach_priority_samplers(n_step=3, alpha=0.6, beta=0.4,
+                                    eps=1e-6)
+
+    b1, p1 = bare.sample(np.random.default_rng(7), 32, 0.99)
+    b2, p2 = facade.sample(np.random.default_rng(7), 32, 0.99)
+    np.testing.assert_array_equal(p1.leaf, p2.leaf)
+    np.testing.assert_array_equal(p1.weights, p2.weights)
+    np.testing.assert_array_equal(p1.slot_gen, p2.slot_gen)
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(a, b)
+
+    # Write-backs route identically too (same applied/dropped counts,
+    # same post-write tree totals).
+    prios = stream.random(32)
+    r1 = bare.update_priorities(p1.leaf, prios, expected_gen=p1.slot_gen)
+    r2 = facade.update_priorities(p2.leaf, prios,
+                                  expected_gen=p2.slot_gen)
+    assert r1 == r2
+    assert bare.tree.total == facade.samplers[0].tree.total
+
+
+def test_cross_shard_draws_proportional_to_tree_mass():
+    facade = ShardedHostReplay(2, 256, 4, (5,), np.float32)
+    for s in (0, 1):
+        _fill_ring(facade, s, np.random.default_rng(20 + s), chunks=4,
+                   C=48)
+    samplers = facade.attach_priority_samplers(n_step=1, alpha=1.0,
+                                               beta=0.4, eps=1e-6)
+    # Skew the masses: shard 1 carries 4x shard 0's per-slot priority.
+    for s, p in ((0, 1.0), (1, 4.0)):
+        ring = facade.rings[s]
+        leaf = np.arange(ring.num_slots * ring.num_envs, dtype=np.int64)
+        samplers[s].update_priorities(
+            leaf, np.full(leaf.shape[0], p),
+            expected_gen=ring.slot_gen[leaf // ring.num_envs])
+    totals = np.array([s.tree.total for s in samplers])
+    counts = np.zeros(2)
+    rng = np.random.default_rng(5)
+    draws = 200
+    for _ in range(draws):
+        _, per = facade.sample(rng, 64, 0.99)
+        counts += np.bincount(per.leaf // facade.leaf_stride, minlength=2)
+    frac = counts / counts.sum()
+    expect = totals / totals.sum()
+    # Stratified draws at this volume are tight; 3% absolute slack.
+    np.testing.assert_allclose(frac, expect, atol=0.03)
+
+
+def test_cross_shard_is_weights_match_bruteforce():
+    facade = ShardedHostReplay(2, 128, 4, (5,), np.float32)
+    for s in (0, 1):
+        _fill_ring(facade, s, np.random.default_rng(30 + s), chunks=3,
+                   C=32)
+    samplers = facade.attach_priority_samplers(n_step=2, alpha=0.6,
+                                               beta=0.5, eps=1e-6)
+    # Heterogeneous priorities so the two shards' trees differ.
+    rng = np.random.default_rng(9)
+    for s in (0, 1):
+        ring = facade.rings[s]
+        leaf = np.arange(ring.num_slots * ring.num_envs, dtype=np.int64)
+        samplers[s].update_priorities(
+            leaf, rng.random(leaf.shape[0]) * (1 + 3 * s),
+            expected_gen=ring.slot_gen[leaf // ring.num_envs])
+    batch, per = facade.sample(np.random.default_rng(4), 64, 0.99)
+    # Brute force from the trees: P(i) = mass_i / global total,
+    # weights (N_valid_global * P)^-beta normalized to max 1.
+    T = sum(s.tree.total for s in samplers)
+    n_valid = sum(
+        (r.size - s.n_step - r._extra()) * r.num_envs
+        for r, s in zip(facade.rings, samplers))
+    shard_of = per.leaf // facade.leaf_stride
+    local = per.leaf % facade.leaf_stride
+    mass = np.array([samplers[int(s)].tree.get(np.array([lf]))[0]
+                     for s, lf in zip(shard_of, local)])
+    w = (n_valid * np.maximum(mass / T, 1e-12)) ** (-0.5)
+    w = (w / w.max()).astype(np.float32)
+    np.testing.assert_allclose(per.weights, w, rtol=1e-6)
+
+
+def test_writebacks_route_to_owning_shard_with_generation_guard():
+    facade = ShardedHostReplay(2, 64, 4, (5,), np.float32)
+    for s in (0, 1):
+        _fill_ring(facade, s, np.random.default_rng(40 + s))
+    samplers = facade.attach_priority_samplers(n_step=1, alpha=1.0,
+                                               beta=0.4, eps=1e-6)
+    _, per = facade.sample(np.random.default_rng(2), 32, 0.99)
+    before = [s.tree.total for s in samplers]
+    applied, dropped = facade.update_priorities(
+        per.leaf, np.full(32, 9.0), per.slot_gen)
+    assert (applied, dropped) == (32, 0)
+    after = [s.tree.total for s in samplers]
+    # Both shards' trees moved (draws touch both) and only they did.
+    shard_counts = np.bincount(per.leaf // facade.leaf_stride,
+                               minlength=2)
+    for s in (0, 1):
+        if shard_counts[s]:
+            assert after[s] != before[s]
+    # A stale generation drops rather than stamping a wrong slot.
+    applied, dropped = facade.update_priorities(
+        per.leaf, np.full(32, 1.0), per.slot_gen - 1)
+    assert (applied, dropped) == (0, 32)
+
+
+def test_apex_store_places_by_sticky_router_shard():
+    """Router -> ring placement (ISSUE 10 acceptance): every actor's
+    inserts land in the shard the crc32 sticky assignment names."""
+    store = ShardedPrioritizedReplay(2, 2048)
+    per_actor = 40
+    for actor in range(8):
+        s = shard_for(actor, 2)
+        items = {"obs": np.full((per_actor, 4), actor, np.float32),
+                 "action": np.zeros(per_actor, np.int32)}
+        store.add(items, priorities=np.ones(per_actor), shard=s)
+    assert store.added == 8 * per_actor
+    # Each sub-store holds exactly the actors routed to it.
+    for s in (0, 1):
+        expected = sum(per_actor for a in range(8)
+                       if shard_for(a, 2) == s)
+        assert len(store.shards[s]) == expected
+        assert store.added_by_shard[s] == expected
+    # Obs payloads in shard s all carry actor ids that route to s.
+    for s in (0, 1):
+        actors_here = np.unique(
+            store.shards[s]._data["obs"][:len(store.shards[s]), 0])
+        assert all(shard_for(int(a), 2) == s for a in actors_here)
+
+
+def test_apex_store_sample_update_and_snapshot_roundtrip():
+    store = ShardedPrioritizedReplay(2, 1024)
+    rng = np.random.default_rng(0)
+    for s in (0, 1):
+        store.add({"obs": rng.random((100, 4)).astype(np.float32),
+                   "action": np.zeros(100, np.int32)},
+                  priorities=rng.random(100) + 0.1, shard=s)
+    items, idx, w = store.sample(64, beta=0.4)
+    assert items["obs"].shape == (64, 4) and w.max() == 1.0
+    gen = store.generation(idx)
+    store.update_priorities(idx, rng.random(64), expected_gen=gen)
+    snap = store.state_dict()
+    clone = ShardedPrioritizedReplay(2, 1024)
+    clone.load_state_dict(snap)
+    assert len(clone) == len(store)
+    # Honest loud error on a changed shard count (resume contract).
+    with pytest.raises(ValueError, match="same shard count"):
+        ShardedPrioritizedReplay(4, 1024).load_state_dict(snap)
+
+
+def test_apex_store_unattributed_insert_refused():
+    store = ShardedPrioritizedReplay(2, 256)
+    with pytest.raises(ValueError, match="shard id"):
+        store.add({"obs": np.zeros((4, 2), np.float32)})
+
+
+def _dp_cfg(prioritized=False):
+    cfg = CONFIGS["cartpole"]
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=prioritized),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+
+
+def test_host_replay_dp_mesh_run_and_serial_equivalence():
+    """The dp acceptance run: 4 shards of the 8-device CPU mesh, the
+    shard_map + pmean train path exercised, and the prefetched dp path
+    bit-identical to the serial dp reference (per-(k, shard) RNG
+    streams make WHEN a batch is drawn irrelevant to WHAT it holds)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _dp_cfg()
+    kw = dict(total_env_steps=2400, chunk_iters=100,
+              log_fn=lambda s: None, mesh_devices=4)
+    out = run_host_replay(cfg, **kw)
+    assert out["dp_size"] == 4
+    assert out["grad_steps"] > 0
+    assert np.isfinite(out["param_checksum"])
+    serial = run_host_replay(cfg, prefetch=False, **kw)
+    assert serial["param_checksum"] == out["param_checksum"]
+    assert serial["grad_steps"] == out["grad_steps"]
+
+
+def test_host_replay_dp_per_run():
+    """PER over the dp mesh: per-shard sum-trees, write-backs applied
+    per shard, IS weights live."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple CPU devices from conftest")
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    out = run_host_replay(_dp_cfg(prioritized=True),
+                          total_env_steps=1600, chunk_iters=100,
+                          log_fn=lambda s: None, mesh_devices=2)
+    assert out["dp_size"] == 2 and out["prioritized"]
+    assert out["grad_steps"] > 0
+    assert out["prio_writeback_rows"] > 0
+    assert out["is_weight_min"] < 1.0
+    assert np.isfinite(out["param_checksum"])
+
+
+def test_sharded_scan_priorities_are_substep_major():
+    """The apex multi-learner replay-ratio scan (ISSUE 10): the sharded
+    scan (make_scan_train flatten=False under scan_train_step_specs)
+    must return priorities whose host-side reshape(-1) is SUB-STEP
+    major — i.e. ordered exactly like the single-device scan's
+    flattened priorities, which is what the service pairs with its
+    concatenated sample indices. A device-block-major regression would
+    silently misattribute every priority write-back."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple CPU devices from conftest")
+    from dist_dqn_tpu.agents.dqn import make_learner, make_scan_train
+    from dist_dqn_tpu.config import LearnerConfig
+    from dist_dqn_tpu.models.qnets import QNetwork
+    from dist_dqn_tpu.parallel import make_mesh
+    from dist_dqn_tpu.parallel.learner import (make_sharded_train_step,
+                                               scan_train_step_specs)
+    from dist_dqn_tpu.types import Transition
+
+    net = QNetwork(num_actions=3, torso="mlp", mlp_features=(16,),
+                   hidden=0)
+    lcfg = LearnerConfig(learning_rate=1e-2)
+    init_s, step_s = make_learner(net, lcfg)
+    _, step_d = make_learner(net, lcfg, axis_name="dp")
+    state = init_s(jax.random.PRNGKey(0), jnp.zeros((4,)))
+
+    N, B = 3, 8
+    rng = np.random.default_rng(1)
+    batches = Transition(
+        obs=jnp.asarray(rng.random((N, B, 4)), jnp.float32),
+        action=jnp.asarray(rng.integers(0, 3, (N, B))).astype(jnp.int32),
+        reward=jnp.asarray(rng.random((N, B)), jnp.float32),
+        discount=jnp.ones((N, B), jnp.float32) * 0.99,
+        next_obs=jnp.asarray(rng.random((N, B, 4)), jnp.float32))
+    weights = jnp.ones((N, B), jnp.float32)
+
+    single = jax.jit(make_scan_train(step_s))
+    s1, m1 = single(state, batches, weights)
+
+    mesh = make_mesh(devices=jax.devices()[:2])
+    data_specs, metric_specs = scan_train_step_specs("dp")
+    sharded = make_sharded_train_step(
+        make_scan_train(step_d, flatten=False), mesh, data_specs,
+        metric_specs)
+    s2, m2 = sharded(state, batches, weights)
+
+    # Params agree (pmean reorders the reduction: allclose, not bits).
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+    # THE ordering pin: the sharded [N, B] priorities flatten to the
+    # single scan's [N*B] order, row for row.
+    assert np.asarray(m2["priorities"]).shape == (N, B)
+    np.testing.assert_allclose(
+        np.asarray(m2["priorities"]).reshape(-1),
+        np.asarray(m1["priorities"]), rtol=2e-4, atol=1e-6)
+
+
+def test_host_replay_dp_honest_errors():
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    with pytest.raises(ValueError, match="not divisible"):
+        run_host_replay(
+            dataclasses.replace(
+                _dp_cfg(), actor=dataclasses.replace(
+                    CONFIGS["cartpole"].actor, num_envs=6)),
+            total_env_steps=100, mesh_devices=4, log_fn=lambda s: None)
+    with pytest.raises(ValueError, match="mesh-devices"):
+        run_host_replay(_dp_cfg(), total_env_steps=100, mesh_devices=2,
+                        checkpoint_dir="/tmp/nope",
+                        log_fn=lambda s: None)
