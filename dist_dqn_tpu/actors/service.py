@@ -712,6 +712,18 @@ class ApexLearnerService:
         reg.gauge(tmc.LEARNER_ACTOR_DTYPE_INFO,
                   "1 for the active actor inference dtype",
                   {**_ll, "dtype": self.actor_dtype}).set(1)
+        # Checkpoint/resume telemetry (ISSUE 12 satellite): replay-
+        # snapshot save wall + bytes; resumes/refusals count at the
+        # restore sites (docs/observability.md).
+        self._tm_ckpt_save = reg.histogram(
+            tmc.CHECKPOINT_SAVE_SECONDS,
+            "replay-snapshot save wall (flushes + npz write)", _ll)
+        self._tm_ckpt_bytes = reg.counter(
+            tmc.CHECKPOINT_BYTES,
+            "checkpoint bytes written (replay snapshot)", _ll)
+        reg.gauge(tmc.CHECKPOINT_SHARDS_SAVED,
+                  "replay shards carried by each snapshot",
+                  _ll).set(getattr(self.replay, "num_shards", 1))
         # None until the FIRST mirror exists: construction->first-refresh
         # spans the jit compile and is not mirror staleness — observing
         # it would park a false 60s+ outlier in the triage histogram.
@@ -1889,28 +1901,52 @@ class ApexLearnerService:
         self._flush_prio_writebacks(force=True)
         if not len(self.replay):
             return
+        from dist_dqn_tpu.utils.checkpoint import atomic_savez
+
         path = self._replay_snapshot_path()
-        tmp = path + ".tmp"
         t0 = time.perf_counter()
-        with open(tmp, "wb") as fh:
-            np.savez(fh, **self.replay.state_dict())
-        os.replace(tmp, path)  # atomic: a crash mid-write leaves the old one
+        # Atomic: a crash mid-write leaves the old one.
+        atomic_savez(path, **self.replay.state_dict())
+        wall = time.perf_counter() - t0
+        self._tm_ckpt_save.observe(wall)
+        self._tm_ckpt_bytes.inc(os.path.getsize(path))
         self.log.log_fn(json.dumps({
-            "replay_snapshot_s": round(time.perf_counter() - t0, 3),
+            "replay_snapshot_s": round(wall, 3),
             "replay_snapshot_mb": round(os.path.getsize(path) / 2**20, 1),
-            "replay_snapshot_items": len(self.replay)}))
+            "replay_snapshot_items": len(self.replay),
+            "replay_snapshot_shards": getattr(self.replay, "num_shards",
+                                              1)}))
 
     def _load_replay_snapshot(self) -> None:
+        """Restore the replay snapshot beside the learner checkpoint.
+        Since ISSUE 12 a snapshot written at a DIFFERENT shard count is
+        a supported migration, not a refusal: records redistribute to
+        the new layout by their global slot encoding with priorities
+        preserved (replay/sharded.py restore_replay_snapshot) — a dp=2
+        checkpoint restores at dp=1 or dp=4, every record exactly once
+        (pinned by tests/test_sharded_replay.py). Migrations are
+        statistically continuous, not bit-identical: per-slot write
+        generations reset, so deferred write-backs from the killed run
+        drop at the generation guard (the safe direction)."""
+        from dist_dqn_tpu.replay.sharded import restore_replay_snapshot
+
         path = self._replay_snapshot_path()
         if not os.path.exists(path):
             return
         t0 = time.perf_counter()
         with np.load(path) as state:
-            self.replay.load_state_dict(dict(state))
+            info = restore_replay_snapshot(self.replay, dict(state))
+        get_registry().counter(
+            tmc.CHECKPOINT_RESUMES,
+            "successful whole-state resumes",
+            {"loop": "apex"}).inc()
         self.log.log_fn(json.dumps({
             "replay_snapshot_restored_items": len(self.replay),
             "replay_snapshot_restore_s":
-                round(time.perf_counter() - t0, 3)}))
+                round(time.perf_counter() - t0, 3),
+            "replay_snapshot_resharded": bool(info["resharded"]),
+            "replay_snapshot_from_shards": info["from_shards"],
+            "replay_snapshot_to_shards": info["to_shards"]}))
 
     def _track_episode_returns(self, actor: int, reward: np.ndarray,
                                terminated: np.ndarray,
@@ -2038,6 +2074,20 @@ class ApexLearnerService:
                 save_pytree(os.path.join(self.rt.checkpoint_dir,
                                          "emergency_learner"),
                             {"learner": self.state})
+                if self.rt.checkpoint_replay and len(self.replay):
+                    # All replay shards too (ISSUE 12): the raw store
+                    # snapshot WITHOUT the quiescing flushes the
+                    # periodic save runs (those touch service state the
+                    # wedged main thread may hold) — in-flight
+                    # priorities of the newest few chunks may be
+                    # missing, honestly a salvage artifact, but every
+                    # shard's items are present instead of a
+                    # learner-only snapshot.
+                    from dist_dqn_tpu.utils.checkpoint import \
+                        atomic_savez
+                    atomic_savez(os.path.join(self.rt.checkpoint_dir,
+                                              "emergency_replay.npz"),
+                                 **self.replay.state_dict())
 
         tm_watchdog.register_emergency_hook("apex.checkpoint",
                                             _emergency_save)

@@ -48,6 +48,11 @@ SEAMS: Dict[str, Tuple[str, ...]] = {
     "checkpoint.save": ("fail", "crash_before_stamp"),
     # utils/checkpoint.py write_latest_pointer (the LATEST stamp).
     "latest.write": ("torn",),
+    # host_replay_loop.py _save_checkpoint sidecar write (ISSUE 12):
+    # "torn" lands a truncated sidecar at the final path while the
+    # orbax step still commits — resume must delete the unusable step
+    # and fall back to the previous intact one.
+    "sidecar.write": ("torn",),
     # serving/batcher.py MicroBatcher._dispatch.
     "serving.dispatch": ("slow_model", "exception"),
     # serving/model_store.py ModelStore._restore (hot-reload path).

@@ -35,6 +35,18 @@ class DMCPixelEnv:
             raise NotImplementedError(
                 "dm_control is not installed; DMC pixel configs need it"
             ) from e
+        except Exception as e:
+            # On a box without a usable headless GL stack the import
+            # itself dies DEEP inside PyOpenGL's EGL binding (an
+            # AttributeError, not an ImportError) — translate it to the
+            # documented capability error so callers/tests can gate on
+            # it instead of crashing on an unrelated-looking traceback.
+            raise NotImplementedError(
+                "dm_control's render backend failed to import — no "
+                "usable headless GL on this machine; set MUJOCO_GL=egl "
+                "(or osmesa where available) on a box with GL "
+                f"libraries. Original error: {type(e).__name__}: {e}"
+            ) from e
         self.env = suite.load(domain, task)
         spec = self.env.action_spec()
         self._dim = int(np.prod(spec.shape))

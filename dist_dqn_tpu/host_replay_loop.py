@@ -254,20 +254,30 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     write-backs into one vectorized sum-tree update (PER only; 1 =
     per-step flush), mirroring the apex service's knob.
 
-    ``checkpoint_dir`` (ISSUE 8) enables WHOLE-STATE checkpoint/resume
-    every ``save_every_frames`` env frames (0 = default cadence:
-    ``max(cfg.eval_every_steps, one chunk)`` — each save copies the
-    whole ring window, so the default never pays that per chunk):
-    learner state + collect
-    carry (orbax) plus the host ring window, pending chunk, episode
-    stats and every loop cursor (sidecar npz). Saves land at a
-    QUIESCED end-of-chunk boundary (the in-flight evacuation is fenced
-    first — idempotent, the next chunk's body re-fences for free), so
-    a run killed at chunk k and resumed continues BIT-IDENTICALLY to
-    an uninterrupted one in uniform-replay mode — the resume pin
-    tests/test_chaos.py holds against a mid-run kill. PER mode raises:
-    its sum-tree is rebuilt from appends, not checkpointed, so resume
-    could not be honest about priorities yet.
+    ``checkpoint_dir`` (ISSUE 8; sharded + PER since ISSUE 12) enables
+    WHOLE-STATE checkpoint/resume every ``save_every_frames`` env
+    frames (0 = default cadence: ``max(cfg.eval_every_steps, one
+    chunk)`` — each save copies the whole ring window, so the default
+    never pays that per chunk): learner state + collect carry (orbax)
+    plus the host ring window(s), pending chunk, episode stats and
+    every loop cursor (versioned sidecar npz — utils/ckpt_schema.py).
+    Saves land at a QUIESCED end-of-chunk boundary (every shard's
+    in-flight evacuation is fenced first — idempotent, the next
+    chunk's body re-fences for free), so a run killed at chunk k and
+    resumed continues BIT-IDENTICALLY to an uninterrupted one — the
+    resume pins in tests/test_chaos.py (dp=1 uniform) and
+    tests/test_sharded_checkpoint.py (dp>1, PER) hold against mid-run
+    kills. At dp > 1 the sidecar carries one ring snapshot PER SHARD
+    plus the mesh width; PER mode snapshots each shard's
+    RingPrioritySampler (shadow mass, exact sum-tree heap, running
+    max, deferred write-backs) so a resumed run's priorities are
+    exact, not max-seeded. The sidecar pins ``sidecar_version`` /
+    ``chunk_iters`` / ``dp`` / ``per`` and refuses a mismatched resume
+    loudly (counted in dqn_checkpoint_refused_resumes_total); a torn
+    sidecar falls back to the newest intact step, deleting the
+    unusable one. PER + prefetch resume keeps PER's documented
+    timing-dependence (above); ``--no-prefetch`` PER resume is
+    bit-identical.
 
     ``mesh_devices`` (ISSUE 10 tentpole) runs the runtime DATA-PARALLEL
     over a ``dp`` mesh of that many devices (0 = all): env lanes split
@@ -305,28 +315,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                          f"{prio_writeback_batch}")
     per_enabled = (cfg.replay.prioritized if prioritized is None
                    else prioritized)
-    if checkpoint_dir and per_enabled:
-        raise ValueError(
-            "--checkpoint-dir with prioritized host-replay sampling is "
-            "not supported yet: the sum-tree rebuilds from appends, not "
-            "from the checkpoint, so a resumed run's priorities would "
-            "silently differ. Supported checkpoint configurations: "
-            "uniform single-chip host-replay (--no-per --mesh-devices "
-            "1), or the apex runtime's --checkpoint-replay (which "
-            "snapshots sum-tree mass)")
     dp = len(jax.devices()) if mesh_devices == 0 else int(mesh_devices)
     if dp < 1:
         raise ValueError(f"mesh_devices must be >= 0, got {mesh_devices}")
     if dp > len(jax.devices()):
         raise ValueError(f"--mesh-devices {dp} requested but only "
                          f"{len(jax.devices())} devices are available")
-    if dp > 1 and checkpoint_dir:
-        raise ValueError(
-            "--checkpoint-dir with --mesh-devices > 1 is not supported "
-            "yet: the whole-state snapshot would have to restore N "
-            "per-shard rings bit-identically AND refuse a changed shard "
-            "count; checkpoint single-chip runs (--mesh-devices 1), or "
-            "run dp > 1 without checkpointing")
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -757,17 +751,43 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     grad_steps = 0
     sample_k = 0          # global batch index — the RNG-stream cursor
 
-    # -- whole-state checkpoint/resume (ISSUE 8) ---------------------------
+    # -- whole-state checkpoint/resume (ISSUE 8; sharded + PER: ISSUE 12) --
     ckpt = None
     next_save = float("inf")
     start_chunk = 0
     resumed = False
     resume_stats = resume_pending = None
+    h_ckpt_save = c_ckpt_bytes = None
     if checkpoint_dir:
         import os
 
+        from dist_dqn_tpu.utils import ckpt_schema
         from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
                                                    record_checkpoint_kind)
+        # Checkpoint telemetry (ISSUE 12 satellite): save wall/bytes/
+        # shard count, successful resumes, and every refused resume by
+        # reason — docs/observability.md "Checkpoint/resume metrics".
+        h_ckpt_save = reg.histogram(
+            tmc.CHECKPOINT_SAVE_SECONDS,
+            "whole quiesced checkpoint save wall (fence + sidecar + "
+            "orbax commit)", _labels)
+        c_ckpt_bytes = reg.counter(
+            tmc.CHECKPOINT_BYTES,
+            "checkpoint bytes written (sidecar + learner/carry tree)",
+            _labels)
+        reg.gauge(tmc.CHECKPOINT_SHARDS_SAVED,
+                  "replay shards carried by each whole-state save",
+                  _labels).set(dp)
+
+        def _count_refused(reason: str) -> None:
+            reg.counter(tmc.CHECKPOINT_REFUSED,
+                        "resume attempts refused at the sidecar pins",
+                        {**_labels, "reason": reason}).inc()
+
+        def _refuse_resume(reason: str, msg: str):
+            _count_refused(reason)
+            raise ValueError(msg)
+
         # Default cadence mirrors the fused loop's eval-period rhythm,
         # never finer than one chunk: each save copies the WHOLE ring
         # window (DRAM-sized at real configs) into the sidecar, so a
@@ -784,25 +804,116 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             return os.path.join(checkpoint_dir, f"host_loop_{step}.npz")
 
         example_tree = {"learner": state, "carry": carry}
-        restored = ckpt.restore_latest(example_tree)
-        if restored is not None:
-            step, tree = restored
-            with np.load(_sidecar_path(step)) as f:
-                side = {k: f[k] for k in f.files}
+        # Newest step whose sidecar READS wins: an orbax step whose
+        # sidecar is torn or missing is not a checkpoint — delete it
+        # loudly and fall back to the next older one, instead of
+        # failing the resume outright (the sidecar.write:torn game-day
+        # invariant, scripts/chaos_run.py sharded_ckpt_crash).
+        side = step = None
+        fell_back = False
+        import zipfile
+        for cand in sorted(ckpt.all_steps(), reverse=True):
+            try:
+                with np.load(_sidecar_path(cand)) as f:
+                    side = {k: f[k] for k in f.files}
+                step = cand
+                break
+            # Only the CONTENT-level failures a truncated npz actually
+            # produces (zip/header/pickle/key errors) plus an absent
+            # file count as torn. A transient I/O OSError (stale NFS
+            # handle, mount race) propagates instead — deleting a
+            # committed step on a transient read error would destroy
+            # valid training state.
+            except (FileNotFoundError, ValueError, EOFError, KeyError,
+                    zipfile.BadZipFile) as e:
+                fell_back = True
+                _count_refused("torn_sidecar")
+                log_fn(f"# checkpoint step {cand}: sidecar unreadable "
+                       f"({type(e).__name__}: {e}) — deleting the "
+                       "unusable step and falling back to the previous "
+                       "one")
+                ckpt.delete(cand)
+                try:
+                    os.remove(_sidecar_path(cand))
+                except OSError:
+                    pass
+        if side is not None:
+            ver = int(side.get("sidecar_version", 0))
+            if ver != ckpt_schema.SIDECAR_VERSION:
+                _refuse_resume(
+                    "sidecar_version",
+                    f"checkpoint at {checkpoint_dir!r} carries sidecar "
+                    f"schema v{ver}, this build reads "
+                    f"v{ckpt_schema.SIDECAR_VERSION} — resume with a "
+                    "matching build (utils/ckpt_schema.py documents the "
+                    "history), or start a fresh --checkpoint-dir")
             if int(side["chunk_iters"]) != chunk_iters:
                 # next_chunk/env_steps cursors are in chunk units; a
                 # different --chunk-iters would silently misinterpret
                 # them and break the bit-identical resume contract.
-                raise ValueError(
+                _refuse_resume(
+                    "chunk_iters",
                     f"checkpoint at {checkpoint_dir!r} was written with "
                     f"--chunk-iters {int(side['chunk_iters'])}, this "
                     f"run uses {chunk_iters} — resume with the same "
                     "loop shape (the ring/env config is already "
                     "validated by the snapshot shapes)")
+            if int(side["dp"]) != dp:
+                # Lane blocks are positional (shard s owns env lanes
+                # [s*L, (s+1)*L)), so a changed mesh width cannot
+                # restore the striped window bit-identically. The apex
+                # ITEM store migrates across shard counts; this lane
+                # store refuses.
+                _refuse_resume(
+                    "dp",
+                    f"checkpoint at {checkpoint_dir!r} was written at "
+                    f"--mesh-devices {int(side['dp'])}, this run uses "
+                    f"{dp} — resume with the same mesh width "
+                    "(re-sharding a lane-striped host-replay window is "
+                    "not supported; docs/fault_tolerance.md 'resuming "
+                    "a sharded run')")
+            if per_enabled and \
+                    int(side["prio_writeback_batch"]) \
+                    != prio_writeback_batch:
+                # The restored pending write-back entries flush when
+                # the list crosses prio_writeback_batch: a different
+                # cadence would apply |TD| updates on a different
+                # schedule than the killed run — silent divergence
+                # from the bit-identical contract.
+                _refuse_resume(
+                    "prio_writeback_batch",
+                    f"checkpoint at {checkpoint_dir!r} was written "
+                    f"with prio_writeback_batch="
+                    f"{int(side['prio_writeback_batch'])}, this run "
+                    f"uses {prio_writeback_batch} — resume with the "
+                    "same PER write-back cadence")
+            if bool(side["per"]) != per_enabled:
+                _refuse_resume(
+                    "per",
+                    f"checkpoint at {checkpoint_dir!r} was written with "
+                    f"prioritized={bool(side['per'])}, this run "
+                    f"configures prioritized={per_enabled} — a uniform "
+                    "snapshot cannot honestly seed a sum-tree (and vice "
+                    "versa); resume with the same sampler, or start a "
+                    "fresh --checkpoint-dir")
+            _, tree = ckpt.restore_latest(example_tree, step=step)
             state, carry = tree["learner"], tree["carry"]
-            ring.load_state_dict(
-                {k[len("ring_"):]: v for k, v in side.items()
-                 if k.startswith("ring_")})
+            ring_side = {k[len("ring_"):]: v for k, v in side.items()
+                         if k.startswith("ring_")}
+            if dp == 1:
+                ring.load_state_dict(ring_side)
+                if per_sampler is not None:
+                    # Exact priority state (ISSUE 12): shadow mass,
+                    # running max AND the sum-tree heap (incl. native
+                    # delta drift) — resumed draws see the killed run's
+                    # priorities, not max-priority amnesia.
+                    per_sampler.load_state_dict(
+                        {k[len("per_"):]: v for k, v in side.items()
+                         if k.startswith("per_")})
+            else:
+                # N per-shard rings (+ per-shard PER sampler state when
+                # attached), shard count pinned inside.
+                store.load_state_dict(ring_side)
             env_steps = int(side["env_steps"])
             grad_steps = int(side["grad_steps"])
             sample_k = int(side["sample_k"])
@@ -810,10 +921,38 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 # Per-index batch RNG: the prefetcher must continue the
                 # killed run's index sequence, not restart at 0.
                 prefetcher.seek(sample_k)
+            if prefetchers is not None:
+                # dp > 1: every shard's prefetcher shares the one batch
+                # cursor (stream (k, s) is shard s's slice of batch k).
+                for p in prefetchers:
+                    p.seek(sample_k)
             train_debt_iters = int(side["train_debt_iters"])
             start_chunk = int(side["next_chunk"])
             next_save = env_steps + save_period
             resumed = True
+            # Deferred-but-unflushed PER write-backs ride the sidecar
+            # verbatim: flushing early at save time would apply |TD|
+            # updates sooner than the uninterrupted run does, breaking
+            # the bit-identical pin — so the pending list is restored
+            # as-is and flushes on the killed run's schedule.
+            from dist_dqn_tpu.replay.host_ring import PerSample
+            for j in range(int(side.get("wb_count", 0))):
+                prios_j = np.asarray(side["wb_prios"][j], np.float64)
+
+                def _wb_aux(s: int) -> "PerSample":
+                    leaf = np.asarray(side[f"wb{s}_leaf"][j], np.int64)
+                    return PerSample(
+                        leaf=leaf,
+                        t_idx=np.zeros_like(leaf, np.int32),
+                        b_idx=np.zeros_like(leaf, np.int32),
+                        slot_gen=np.asarray(side[f"wb{s}_slot_gen"][j],
+                                            np.int64),
+                        weights=np.zeros(leaf.shape[0], np.float32),
+                        generation=0)
+
+                aux = (_wb_aux(0) if dp == 1
+                       else [_wb_aux(s) for s in range(dp)])
+                wb_pending.append((aux, prios_j))
             if bool(side["has_stats"]):
                 # Episode-stat scalars of the already-dispatched next
                 # chunk: host floats; jax.device_get at the loop's
@@ -828,10 +967,19 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     k[len("pending_"):]: v for k, v in side.items()
                     if k.startswith("pending_")}
             log_fn(json.dumps({"resumed_at_frames": env_steps,
-                               "resumed_at_chunk": start_chunk}))
+                               "resumed_at_chunk": start_chunk,
+                               "resumed_dp": dp,
+                               "resumed_per": per_enabled}))
+            reg.counter(tmc.CHECKPOINT_RESUMES,
+                        "successful whole-state resumes",
+                        _labels).inc()
             # Resuming from the checkpoint IS the recovery proof for an
-            # injected mid-run crash (in-process chaos replay).
+            # injected mid-run crash (in-process chaos replay); a
+            # resume that fell back past an injected torn sidecar
+            # proves that seam recovered too.
             chaos.mark_recovered("host_replay.chunk")
+            if fell_back:
+                chaos.mark_recovered("sidecar.write")
 
     d2h_bytes_total = 0
     fence_wait_total = 0.0
@@ -850,27 +998,59 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
 
     def _save_checkpoint(g: int) -> None:
         """Quiesced whole-state save at the end of chunk ``g``'s body.
-        The in-flight evacuation is fenced first (idempotent — the next
-        body re-waits for free) so the ring snapshot is the complete
-        window; the serial path's un-appended next-chunk records and
-        the dispatched episode-stat scalars are materialized INTO the
-        checkpoint instead of being perturbed — reads only, so the
-        continuing run stays bit-identical to an unsaved one."""
+        Every shard's in-flight evacuation is fenced first (idempotent —
+        the next body re-waits for free) so each ring snapshot is the
+        complete window; the serial path's un-appended next-chunk
+        records, the dispatched episode-stat scalars AND any deferred
+        PER write-backs are materialized INTO the checkpoint instead of
+        being perturbed — reads only, so the continuing run stays
+        bit-identical to an unsaved one."""
         nonlocal last_saved
         if env_steps <= last_saved:
             return
+        t_save = time.perf_counter()
         if pipeline and handle is not None:
             handle.wait()
-        side = {f"ring_{k}": v for k, v in ring.state_dict().items()}
+        if dp == 1:
+            side = {f"ring_{k}": v for k, v in ring.state_dict().items()}
+            if per_sampler is not None:
+                side.update({f"per_{k}": v for k, v in
+                             per_sampler.state_dict().items()})
+        else:
+            # ShardedHostReplay snapshot: per-shard rings + (when PER)
+            # per-shard sampler state, each under its own fence.
+            side = {f"ring_{k}": v for k, v in store.state_dict().items()}
         side.update(
+            sidecar_version=np.int64(ckpt_schema.SIDECAR_VERSION),
             env_steps=np.int64(env_steps),
             grad_steps=np.int64(grad_steps),
             sample_k=np.int64(sample_k),
             train_debt_iters=np.int64(train_debt_iters),
             next_chunk=np.int64(g + 1),
             chunk_iters=np.int64(chunk_iters),
+            dp=np.int64(dp),
+            per=np.bool_(per_enabled),
+            prio_writeback_batch=np.int64(prio_writeback_batch),
+            wb_count=np.int64(len(wb_pending)),
             has_stats=np.bool_(stats is not None),
             has_pending=np.bool_(records is not None))
+        if wb_pending:
+            # Deferred |TD| write-backs ride along verbatim (see the
+            # restore path's comment: an early flush would break the
+            # bit-identical pin).
+            if dp == 1:
+                side["wb0_leaf"] = np.stack(
+                    [a.leaf for a, _ in wb_pending])
+                side["wb0_slot_gen"] = np.stack(
+                    [a.slot_gen for a, _ in wb_pending])
+            else:
+                for s in range(dp):
+                    side[f"wb{s}_leaf"] = np.stack(
+                        [aux[s].leaf for aux, _ in wb_pending])
+                    side[f"wb{s}_slot_gen"] = np.stack(
+                        [aux[s].slot_gen for aux, _ in wb_pending])
+            side["wb_prios"] = np.stack(
+                [np.asarray(p, np.float64) for _, p in wb_pending])
         if stats is not None:
             s_cr, s_cc = jax.device_get(stats)
             side.update(stats_cr=np.float32(s_cr),
@@ -878,6 +1058,10 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         if records is not None:
             side.update({f"pending_{k}": np.asarray(jax.device_get(v))
                          for k, v in records.items()})
+        # Schema gate (ISSUE 12 satellite): a code path emitting a
+        # field utils/ckpt_schema.py does not name fails HERE, at save
+        # time, instead of becoming a silently-unread key at restore.
+        ckpt_schema.validate_sidecar(side.keys())
         # Sidecar BEFORE the orbax commit (atomic tmp+rename): any
         # committed step implies its sidecar exists, so a crash between
         # the two leaves the previous step as the resume point.
@@ -885,8 +1069,20 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         tmp = path + ".tmp"
         with open(tmp, "wb") as fh:
             np.savez(fh, **side)
-        os.replace(tmp, path)
-        t_save = time.perf_counter()
+        # Chaos seam (ISSUE 12): "torn" lands a truncated sidecar at
+        # the FINAL path (crash mid-write on a filesystem without
+        # atomic-rename semantics) while the orbax commit proceeds —
+        # the resume path must detect the unreadable sidecar, delete
+        # the unusable step and fall back to the previous one.
+        cev = chaos.fire("sidecar.write")
+        if cev is not None and cev.fault == "torn":
+            with open(tmp, "rb") as fh:
+                blob = fh.read()
+            with open(path, "wb") as fh:
+                fh.write(blob[: max(16, len(blob) // 7)])
+            os.remove(tmp)
+        else:
+            os.replace(tmp, path)
         ckpt.save(env_steps, {"learner": state, "carry": carry})
         ckpt.wait()
         last_saved = env_steps
@@ -903,18 +1099,31 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 continue
             if step not in keep:
                 os.remove(old)
+        wall = time.perf_counter() - t_save
+        h_ckpt_save.observe(wall)
+        c_ckpt_bytes.inc(
+            os.path.getsize(path)
+            + int(sum(getattr(leaf, "nbytes", 0) for leaf in
+                      jax.tree.leaves({"learner": state,
+                                       "carry": carry}))))
         fr.record("checkpoint", "host_replay.save", frames=env_steps,
-                  wall_s=round(time.perf_counter() - t_save, 3))
+                  wall_s=round(wall, 3), shards=dp)
         log_fn(json.dumps({"host_replay_checkpoint": env_steps,
-                           "save_s": round(
-                               time.perf_counter() - t_save, 3)}))
+                           "save_s": round(wall, 3),
+                           "shards_saved": dp}))
 
     if ckpt is not None:
-        # Emergency checkpoint on watchdog abort (ISSUE 8): the
-        # quiesced whole-state save needs main-thread fencing, so the
-        # abort path saves a LEARNER-ONLY snapshot to a side location
-        # instead — enough to redeploy/serve from, honestly not a
-        # bit-identical resume point (docs/fault_tolerance.md).
+        # Emergency checkpoint on watchdog abort (ISSUE 8; all shards
+        # since ISSUE 12): the quiesced whole-state save needs
+        # main-thread fencing, so the abort path saves a side snapshot
+        # instead — the learner tree PLUS every replay shard's ring
+        # (and PER sampler) state, each taken under its own generation
+        # fence, so the data is per-shard consistent even while the
+        # main thread is wedged. Honest limits: the loop cursors are
+        # NOT quiesced, so this is a redeploy/forensics artifact, not
+        # a bit-identical resume point (docs/fault_tolerance.md) — the
+        # emergency sidecar deliberately does NOT carry the resume
+        # schema's cursor fields.
         from dist_dqn_tpu.utils.checkpoint import save_pytree
 
         _emerg_state = {"state": state}
@@ -923,6 +1132,26 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             import os as _os
             save_pytree(_os.path.join(checkpoint_dir, "emergency_learner"),
                         {"learner": _emerg_state["state"]})
+            if dp == 1:
+                # One fence hold for ring + sampler (RLock): appends
+                # may still be in flight on the abort path, and a
+                # publish between the two snapshots would tear sampler
+                # mass against ring state.
+                with ring._fence:
+                    eside = {f"ring_{k}": v
+                             for k, v in ring.state_dict().items()}
+                    if per_sampler is not None:
+                        eside.update({f"per_{k}": v for k, v in
+                                      per_sampler.state_dict().items()})
+            else:
+                eside = {f"ring_{k}": v
+                         for k, v in store.state_dict().items()}
+            eside.update(dp=np.int64(dp), per=np.bool_(per_enabled),
+                         env_steps=np.int64(env_steps))
+            from dist_dqn_tpu.utils.checkpoint import atomic_savez
+            atomic_savez(_os.path.join(checkpoint_dir,
+                                       "emergency_sidecar.npz"),
+                         **eside)
 
         tm_watchdog.register_emergency_hook("host_replay.checkpoint",
                                             _emergency_save)
@@ -930,6 +1159,23 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     try:
         if num_chunks and not resumed:
             # Chunk 0: prologue dispatch + evacuation submit.
+            carry, records, stats = collect_jit(
+                carry, collect_params(state), chunk_iters)
+            if pipeline:
+                handle = submit_evac(records)
+                records = None
+        elif resumed and start_chunk < num_chunks \
+                and resume_stats is None and resume_pending is None:
+            # EXTENSION resume (found by driving the CLI, ISSUE 12): the
+            # checkpoint is a FINAL save — no chunk was in flight — and
+            # this run's --total-env-steps reaches past it. Run the
+            # prologue dispatch against the restored carry/ring, exactly
+            # like a fresh start. Honest contract: extension is a
+            # supported CONTINUATION, not the bit-identical-resume pin —
+            # an uninterrupted longer run would have dispatched this
+            # chunk one train event earlier (the collect-ahead
+            # schedule), so params at the boundary differ by one
+            # staleness event.
             carry, records, stats = collect_jit(
                 carry, collect_params(state), chunk_iters)
             if pipeline:

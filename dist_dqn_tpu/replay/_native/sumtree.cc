@@ -80,6 +80,24 @@ void dqn_tree_rebuild(void* h) {
   t->writes = 0;
 }
 
+// Exact state serialization (checkpoint/resume): dump/load the full node
+// heap plus the write counter. Delta propagation makes interior sums
+// PATH-DEPENDENT (bounded fp drift), so a resumed tree rebuilt from leaf
+// values alone would differ from the live one in the last ulp — enough to
+// break a bit-identical resume pin. Serializing the heap preserves the
+// drift (and, via the counter, the periodic-rebuild cadence) exactly.
+void dqn_tree_dump(void* h, double* nodes, uint64_t* writes) {
+  auto* t = static_cast<Tree*>(h);
+  for (size_t i = 0; i < t->node.size(); ++i) nodes[i] = t->node[i];
+  *writes = t->writes;
+}
+
+void dqn_tree_load(void* h, const double* nodes, uint64_t writes) {
+  auto* t = static_cast<Tree*>(h);
+  for (size_t i = 0; i < t->node.size(); ++i) t->node[i] = nodes[i];
+  t->writes = writes;
+}
+
 void dqn_tree_sample(void* h, const double* mass, int64_t* out, int64_t n) {
   auto* t = static_cast<Tree*>(h);
   for (int64_t i = 0; i < n; ++i) {

@@ -91,6 +91,10 @@ def _native_tree_lib() -> ctypes.CDLL:
             lib.dqn_tree_writes.restype = ctypes.c_uint64
             lib.dqn_tree_writes.argtypes = [ctypes.c_void_p]
             lib.dqn_tree_rebuild.argtypes = [ctypes.c_void_p]
+            lib.dqn_tree_dump.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p]
+            lib.dqn_tree_load.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_uint64]
             for name in ("dqn_tree_get", "dqn_tree_set", "dqn_tree_sample"):
                 getattr(lib, name).argtypes = [
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -143,6 +147,27 @@ class NativeSumTree:
         self._lib.dqn_tree_sample(self._h, mass.ctypes.data, out.ctypes.data,
                                   mass.shape[0])
         return out
+
+    def state_dict(self) -> dict:
+        """EXACT tree snapshot (ISSUE 12): the full interior-node heap
+        plus the delta-propagation write counter. Interior sums carry
+        path-dependent fp drift, so a bit-identical resume must restore
+        the heap as-is — a leaf-only rebuild differs in the last ulp."""
+        nodes = np.empty(2 * self.capacity, np.float64)
+        writes = ctypes.c_uint64(0)
+        self._lib.dqn_tree_dump(self._h, nodes.ctypes.data,
+                                ctypes.byref(writes))
+        return {"backend": np.bytes_(b"native"), "nodes": nodes,
+                "writes": np.uint64(writes.value)}
+
+    def load_state_dict(self, state: dict) -> None:
+        nodes = np.ascontiguousarray(state["nodes"], np.float64)
+        if nodes.shape[0] != 2 * self.capacity:
+            raise ValueError(
+                f"tree snapshot holds {nodes.shape[0] // 2} padded slots, "
+                f"this tree has {self.capacity}")
+        self._lib.dqn_tree_load(self._h, nodes.ctypes.data,
+                                ctypes.c_uint64(int(state["writes"])))
 
 
 def make_sum_tree(capacity: int, native: Optional[bool] = None):
@@ -202,6 +227,22 @@ class SumTree:
             u -= lmass * go_right
             idx = left + go_right
         return idx - self.capacity
+
+    def state_dict(self) -> dict:
+        """Exact snapshot twin of NativeSumTree.state_dict. The numpy
+        tree recomputes parents on every set (order-independent), but
+        the heap still rides along so native <-> numpy snapshots share
+        one format; ``writes`` is 0 (no delta drift to schedule away)."""
+        return {"backend": np.bytes_(b"numpy"), "nodes": self.tree.copy(),
+                "writes": np.uint64(0)}
+
+    def load_state_dict(self, state: dict) -> None:
+        nodes = np.ascontiguousarray(state["nodes"], np.float64)
+        if nodes.shape[0] != 2 * self.capacity:
+            raise ValueError(
+                f"tree snapshot holds {nodes.shape[0] // 2} padded slots, "
+                f"this tree has {self.capacity}")
+        np.copyto(self.tree, nodes)
 
 
 class DevicePrioritySampler:

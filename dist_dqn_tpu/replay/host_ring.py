@@ -515,6 +515,84 @@ class RingPrioritySampler:
                         generation=generation)
         return batch, per, mass
 
+    # -- checkpoint/resume (ISSUE 12) ---------------------------------------
+    def state_dict(self) -> dict:
+        """Snapshot of the sampler's authoritative priority state: the
+        shadow ``_mass`` array (per-slot p^alpha for EVERY slot, valid
+        or boundary-masked), the running max priority, and the
+        write-back counters. Taken under the ring fence so a concurrent
+        append's publish hook can never tear mass against ring state.
+        The sum-tree itself is NOT stored — it is a pure function of
+        ``_mass`` and the ring's valid region, rebuilt on load."""
+        with self._ring._fence:
+            out = {
+                "mass": self._mass.copy(),
+                "max_priority": np.float64(self._max_priority),
+                "alpha": np.float64(self.alpha),
+                "wb_counters": np.array(
+                    [self.writeback_flushes, self.writeback_rows,
+                     self.writeback_dropped], np.int64),
+            }
+            # Exact tree heap (native delta-propagation drift + rebuild
+            # cadence included) — what makes a PER resume bit-identical
+            # rather than merely ulp-close.
+            out.update({f"tree_{k}": v
+                        for k, v in self.tree.state_dict().items()})
+            return out
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` snapshot. The OWNING RING must
+        be restored first — the valid-region mask is recomputed from
+        the ring's restored pos/size, and the tree is rebuilt as
+        ``_mass`` with boundary slots zeroed. A changed ``alpha``
+        refuses loudly: the stored mass is p^alpha, so resuming under a
+        different exponent would silently re-weight every draw."""
+        if float(state["alpha"]) != self.alpha:
+            raise ValueError(
+                f"sampler snapshot was written with "
+                f"alpha={float(state['alpha'])}, this run configures "
+                f"alpha={self.alpha} — resume with the same "
+                "replay.priority_exponent")
+        mass = np.asarray(state["mass"], np.float64)
+        if mass.shape != self._mass.shape:
+            raise ValueError(
+                f"sampler snapshot holds {mass.shape[0]} slots, this "
+                f"ring has {self.capacity} — the checkpoint was written "
+                "under a different replay config")
+        saved_backend = bytes(np.asarray(
+            state.get("tree_backend", b""))).decode() or None
+        live_backend = ("native" if type(self.tree).__name__
+                        == "NativeSumTree" else "numpy")
+        with self._ring._fence:
+            np.copyto(self._mass, mass)
+            self._max_priority = float(state["max_priority"])
+            self._invalid_t = self._invalid_ts()
+            if saved_backend == live_backend and \
+                    "tree_nodes" in state and \
+                    np.asarray(state["tree_nodes"]).shape[0] \
+                    == 2 * self.tree.capacity:
+                # Exact heap restore: interior sums (incl. the native
+                # tree's path-dependent drift) continue bit-identically.
+                self.tree.load_state_dict(
+                    {k[len("tree_"):]: v for k, v in state.items()
+                     if k.startswith("tree_")})
+            else:
+                # Backend changed between save and resume (toolchain
+                # drift) or a pre-heap snapshot: rebuild from the shadow
+                # mass + valid-region mask — correct distribution, but
+                # interior sums may differ in the last ulp from the
+                # killed run's (documented in docs/fault_tolerance.md).
+                flat = np.arange(self.capacity, dtype=np.int64)
+                vals = self._mass.copy()
+                inv_flat = self._flat(self._invalid_t)
+                vals[inv_flat] = 0.0
+                self.tree.set(flat, vals)
+            total = self.tree.total
+        (self.writeback_flushes, self.writeback_rows,
+         self.writeback_dropped) = (int(x) for x in state["wb_counters"])
+        self._g_max_prio.set(self._max_priority)
+        self._g_mass.set(total)
+
     # -- priority write-backs ----------------------------------------------
     def update_priorities(self, leaf: np.ndarray, priorities: np.ndarray,
                           expected_gen: np.ndarray) -> Tuple[int, int]:

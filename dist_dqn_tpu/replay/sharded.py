@@ -43,6 +43,7 @@ tests/test_sharded_replay.py checks the algebra numerically.
 """
 from __future__ import annotations
 
+import re
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -146,31 +147,77 @@ class ShardedHostReplay:
                                     truncated)
 
     def state_dict(self) -> Dict[str, np.ndarray]:
-        """Whole-window snapshot, one sub-dict per shard. No production
-        caller yet — run_host_replay refuses --checkpoint-dir at dp > 1
-        until resume can be proven bit-identical; this (and the
-        shard-count pin in load_state_dict) is the half that already
-        exists for that follow-up."""
+        """Whole-window snapshot, one sub-dict per shard — the sidecar
+        payload run_host_replay checkpoints at dp > 1 (ISSUE 12). Each
+        shard's snapshot is taken under ITS OWN generation fence (a
+        shard mid-append from its evacuation worker publishes all-or-
+        nothing); cross-shard the snapshot is only as synchronized as
+        the caller's quiesce — run_host_replay fences every shard's
+        in-flight evacuation first. With samplers attached the PER
+        state (shadow mass, running max, write-back counters) rides
+        along per shard."""
         out: Dict[str, np.ndarray] = {
             "num_shards": np.int64(self.num_shards)}
         for i, r in enumerate(self.rings):
-            out.update({f"shard{i}_{k}": v
-                        for k, v in r.state_dict().items()})
+            # ONE fence hold covers the ring AND its sampler (RLock —
+            # their own state_dicts re-enter it): an append publishing
+            # between the two snapshots would otherwise tear sampler
+            # mass against ring state within the shard (the emergency
+            # path snapshots while appends are still in flight).
+            with r._fence:
+                out.update({f"shard{i}_{k}": v
+                            for k, v in r.state_dict().items()})
+                if self.samplers is not None:
+                    out.update({f"shard{i}_per_{k}": v for k, v in
+                                self.samplers[i].state_dict().items()})
         return out
 
     def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        """Restore a :meth:`state_dict` snapshot: rings first, then —
+        when samplers are attached — each shard's PER state against its
+        restored ring. A changed shard count refuses loudly: the lane
+        blocks are positional (shard s holds env lanes [s*L, (s+1)*L)),
+        so re-sharding a lane-striped window cannot preserve the
+        bit-identical resume contract (the apex ITEM store migrates;
+        this lane store does not). PER-presence mismatches refuse too —
+        a snapshot without sampler state cannot honestly seed one."""
         saved = int(state["num_shards"])
         if saved != self.num_shards:
             raise ValueError(
                 f"replay snapshot was written with {saved} shards, this "
                 f"run configures {self.num_shards} — resume with the "
-                "same shard count (re-sharding a checkpointed window is "
-                "not supported)")
+                "same shard count (re-sharding a checkpointed lane-"
+                "striped window is not supported; only the apex item "
+                "store migrates across shard counts)")
+        has_per = any(k.startswith("shard0_per_") for k in state)
+        if has_per and self.samplers is None:
+            raise ValueError(
+                "replay snapshot carries PER sampler state but this run "
+                "samples uniformly — resume with replay.prioritized "
+                "(--per), or start a fresh --checkpoint-dir")
+        if self.samplers is not None and not has_per:
+            raise ValueError(
+                "replay snapshot has no PER sampler state but this run "
+                "is prioritized — it was written by a uniform run; "
+                "resume uniform, or start a fresh --checkpoint-dir")
+        # Split keys by regex rather than prefix matching: at >= 10
+        # shards, "shard1_" is a PREFIX of "shard10_obs" and a startswith
+        # filter would silently cross-load shards.
+        ring_sub: List[Dict[str, np.ndarray]] = [
+            {} for _ in range(self.num_shards)]
+        per_sub: List[Dict[str, np.ndarray]] = [
+            {} for _ in range(self.num_shards)]
+        pat = re.compile(r"^shard(\d+)_(per_)?(.+)$")
+        for k, v in state.items():
+            m = pat.match(k)
+            if m is None:
+                continue
+            (per_sub if m.group(2) else ring_sub)[int(m.group(1))][
+                m.group(3)] = v
         for i, r in enumerate(self.rings):
-            prefix = f"shard{i}_"
-            r.load_state_dict({k[len(prefix):]: v
-                               for k, v in state.items()
-                               if k.startswith(prefix)})
+            r.load_state_dict(ring_sub[i])
+            if self.samplers is not None:
+                self.samplers[i].load_state_dict(per_sub[i])
 
     # -- cross-shard prioritized sampling -----------------------------------
     def sample(self, rng: np.random.Generator, batch_size: int,
@@ -422,7 +469,8 @@ class ShardedPrioritizedReplay:
 
     def state_dict(self) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {
-            "num_shards": np.int64(self.num_shards)}
+            "num_shards": np.int64(self.num_shards),
+            "shard_capacity": np.int64(self.shard_capacity)}
         for i, s in enumerate(self.shards):
             if len(s) == 0:
                 continue
@@ -431,16 +479,173 @@ class ShardedPrioritizedReplay:
         return out
 
     def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        """Restore a snapshot written at the SAME shard count exactly;
+        a changed shard count routes through the resharding migration
+        (:func:`restore_replay_snapshot` — records redistributed by
+        their global slot encoding, priorities preserved)."""
         saved = int(state["num_shards"])
         if saved != self.num_shards:
-            raise ValueError(
-                f"replay snapshot was written with ingest_shards={saved}, "
-                f"this run configures {self.num_shards} — resume with "
-                "the same shard count (re-sharding a checkpointed "
-                "window is not supported)")
+            restore_replay_snapshot(self, state)
+            return
         for i, s in enumerate(self.shards):
             prefix = f"shard{i}."
             sub = {k[len(prefix):]: v for k, v in state.items()
                    if k.startswith(prefix)}
             if sub:
                 s.load_state_dict(sub)
+
+
+# ---------------------------------------------------------------------------
+# Resharding restore (ISSUE 12): a dp=N apex replay checkpoint restores at
+# dp=M — the "changed-shard resume" refusal becomes a migration path.
+# ---------------------------------------------------------------------------
+
+def _live_records(sub: Dict[str, np.ndarray]
+                  ) -> Tuple[Dict[str, np.ndarray], np.ndarray, float]:
+    """(records oldest->newest, per-record p^alpha mass, max_priority)
+    of one PrioritizedHostReplay snapshot (its ``state_dict`` keys,
+    unprefixed). The ring may have wrapped, so the live region is
+    position-dependent — exactly the age order a replaying consumer
+    would have seen."""
+    pos, size = (int(x) for x in sub["meta"][:2])
+    cap = int(sub["capacity"])
+    idx = (pos - size + np.arange(size)) % cap
+    records = {k[len("data."):]: np.asarray(v)[idx]
+               for k, v in sub.items() if k.startswith("data.")}
+    mass = np.asarray(sub["mass"], np.float64)[idx]
+    return records, mass, float(sub["max_priority"])
+
+
+def _snapshot_shards(state: Dict[str, np.ndarray]
+                     ) -> List[Dict[str, np.ndarray]]:
+    """Per-source-shard sub-dicts of a snapshot — a plain
+    PrioritizedHostReplay snapshot reads as one shard; a
+    ShardedPrioritizedReplay snapshot splits on its ``shard{i}.``
+    prefixes (empty shards were skipped at save time and come back as
+    empty dicts)."""
+    if "num_shards" not in state:
+        return [dict(state)]
+    n = int(state["num_shards"])
+    subs: List[Dict[str, np.ndarray]] = [{} for _ in range(n)]
+    pat = re.compile(r"^shard(\d+)\.(.+)$")
+    for k, v in state.items():
+        m = pat.match(k)
+        if m is not None:
+            subs[int(m.group(1))][m.group(2)] = v
+    return subs
+
+
+def _insert_with_mass(store: PrioritizedHostReplay,
+                      records: Dict[str, np.ndarray],
+                      mass: np.ndarray) -> None:
+    """Append records to a (possibly fresh) shard and stamp their EXACT
+    saved p^alpha mass over the seed priorities ``add`` assigned — the
+    migration must not launder every record to max priority."""
+    n = next(iter(records.values())).shape[0]
+    if n > store.capacity:
+        # Ring semantics: only the newest capacity records survive an
+        # oversized insert — drop the oldest up front so the mass stamp
+        # below addresses the rows that actually landed.
+        records = {k: v[-store.capacity:] for k, v in records.items()}
+        mass = mass[-store.capacity:]
+        n = store.capacity
+    idx = (store._pos + np.arange(n)) % store.capacity
+    store.add(records)
+    if store.device_sampler is not None:
+        store.device_sampler.set(idx, mass.astype(np.float32))
+    else:
+        store.tree.set(idx, mass)
+
+
+def restore_replay_snapshot(replay, state: Dict[str, np.ndarray]) -> Dict:
+    """Restore ANY prioritized replay snapshot into ANY prioritized
+    store, resharding when the layouts differ (ISSUE 12).
+
+    Same layout (matching shard count, or plain -> plain) delegates to
+    the exact ``load_state_dict`` — bit-identical cursors, slot
+    generations and counters. A changed layout runs the MIGRATION:
+    every live record of every source shard is extracted in age order,
+    assigned its global slot encoding (``source_shard * shard_capacity
+    + local_slot``), and redistributed to target shard ``global_id %
+    M`` with its exact saved p^alpha mass — every record lands exactly
+    once (the resharding pin, tests/test_sharded_replay.py). What a
+    migration does NOT preserve: per-slot write generations (deferred
+    priority write-backs from the killed run drop harmlessly at the
+    generation guard) and insertion interleaving ACROSS source shards
+    (within a source shard, age order is kept). Statistically
+    continuous, not bit-identical — documented in
+    docs/fault_tolerance.md.
+
+    Returns an evidence dict: records moved, source/target shard
+    counts, and whether the exact or the resharding path ran.
+    """
+    tgt_shards = (replay.num_shards
+                  if isinstance(replay, ShardedPrioritizedReplay) else 1)
+    src_shards = int(state["num_shards"]) if "num_shards" in state else 1
+    if src_shards == tgt_shards:
+        if isinstance(replay, ShardedPrioritizedReplay):
+            saved_cap = int(state.get("shard_capacity",
+                                      replay.shard_capacity))
+            if saved_cap == replay.shard_capacity:
+                # Exact restore — bypass the migration re-dispatch.
+                for i, s in enumerate(replay.shards):
+                    prefix = f"shard{i}."
+                    sub = {k[len(prefix):]: v for k, v in state.items()
+                           if k.startswith(prefix)}
+                    if sub:
+                        s.load_state_dict(sub)
+                return {"records": len(replay), "from_shards": src_shards,
+                        "to_shards": tgt_shards, "resharded": False}
+            # Same count, different per-shard capacity: fall through to
+            # the migration (slot encodings differ).
+        else:
+            replay.load_state_dict(dict(state))
+            return {"records": len(replay), "from_shards": 1,
+                    "to_shards": 1, "resharded": False}
+
+    # -- migration ----------------------------------------------------------
+    subs = _snapshot_shards(state)
+    # Same alpha guard the exact restore enforces (host.py
+    # load_state_dict): the migrated mass is p^alpha_saved, and stamping
+    # it into a store that folds p^alpha_new on every later write would
+    # silently mix exponents in one tree.
+    tgt_alpha = float(replay.alpha)
+    for sub in subs:
+        if sub and float(sub["alpha"]) != tgt_alpha:
+            raise ValueError(
+                f"replay snapshot alpha {float(sub['alpha'])} != "
+                f"configured {tgt_alpha} — resharding cannot mix "
+                "priority exponents; resume with the same "
+                "replay.priority_exponent")
+    src_cap = (int(state["shard_capacity"]) if "shard_capacity" in state
+               else next((int(sub["capacity"]) for sub in subs if sub), 0))
+    per_target: List[List[Tuple[Dict[str, np.ndarray], np.ndarray]]] = \
+        [[] for _ in range(tgt_shards)]
+    moved = 0
+    max_prio = 1.0
+    for s_id, sub in enumerate(subs):
+        if not sub:
+            continue
+        records, mass, mp = _live_records(sub)
+        max_prio = max(max_prio, mp)
+        n = mass.shape[0]
+        moved += n
+        pos, size = (int(x) for x in sub["meta"][:2])
+        local = (pos - size + np.arange(n)) % int(sub["capacity"])
+        global_id = s_id * src_cap + local
+        route = global_id % tgt_shards
+        for t in range(tgt_shards):
+            rows = route == t
+            if rows.any():
+                per_target[t].append(
+                    ({k: v[rows] for k, v in records.items()},
+                     mass[rows]))
+    targets = (replay.shards
+               if isinstance(replay, ShardedPrioritizedReplay)
+               else [replay])
+    for t, parts in enumerate(per_target):
+        for records, mass in parts:
+            _insert_with_mass(targets[t], records, mass)
+        targets[t]._max_priority = max(targets[t]._max_priority, max_prio)
+    return {"records": moved, "from_shards": src_shards,
+            "to_shards": tgt_shards, "resharded": True}

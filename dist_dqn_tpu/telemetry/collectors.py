@@ -120,6 +120,22 @@ TRANSPORT_CORRUPT = "dqn_transport_corrupt_frames_total"
 TRANSPORT_SHED = "dqn_transport_tcp_shed_total"
 INGEST_DEGRADED = "dqn_ingest_degraded"
 
+# Checkpoint/resume (ISSUE 12): fleet-grade sharded checkpointing in
+# the data-parallel era. SAVE_SECONDS is the whole quiesced save wall
+# (fence + sidecar + orbax commit) per {loop}; BYTES counts sidecar +
+# snapshot bytes written; SHARDS_SAVED is the replay shard count each
+# save carries (1 = single ring; dp/ingest shards otherwise); RESUMES
+# counts successful whole-state restores per {loop}; REFUSED counts
+# resume attempts rejected at the pins, per {reason=
+# "sidecar_version"|"chunk_iters"|"dp"|"per"|"prio_writeback_batch"|
+# "torn_sidecar"} — the sidecar pins are enumerated in
+# docs/fault_tolerance.md.
+CHECKPOINT_SAVE_SECONDS = "dqn_checkpoint_save_seconds"
+CHECKPOINT_BYTES = "dqn_checkpoint_bytes_total"
+CHECKPOINT_SHARDS_SAVED = "dqn_checkpoint_shards_saved"
+CHECKPOINT_RESUMES = "dqn_checkpoint_resumes_total"
+CHECKPOINT_REFUSED = "dqn_checkpoint_refused_resumes_total"
+
 # Zero-copy ingest subsystem (ISSUE 9): the schema-negotiated
 # experience path (dist_dqn_tpu/ingest/). RECORDS/BYTES are labeled
 # {transport="shm"|"tcp"|"legacy"} (slot ring / zero-copy wire / the

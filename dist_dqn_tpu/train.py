@@ -432,8 +432,16 @@ def main():
                              "replay.prioritized=True enable it by "
                              "default (uniform otherwise)")
     parser.add_argument("--checkpoint-dir", default=None,
-                        help="enable learner checkpoint/resume under this "
-                             "directory (orbax; restores newest on start)")
+                        help="enable checkpoint/resume under this "
+                             "directory (orbax; restores newest on "
+                             "start). Every runtime configuration that "
+                             "trains can checkpoint: host-replay saves "
+                             "whole state at any --mesh-devices width "
+                             "and under --per (bit-identical resume, "
+                             "shard/sampler pins enforced); apex "
+                             "--checkpoint-replay snapshots survive "
+                             "--ingest-shards changes via the resharding "
+                             "migration")
     parser.add_argument("--save-every-frames", type=int, default=0,
                         help="checkpoint period in env frames "
                              "(default: eval_every_steps)")
@@ -686,8 +694,9 @@ def main():
         if args.checkpoint_replay:
             print("# --checkpoint-replay is implied by --runtime "
                   "host-replay --checkpoint-dir: its checkpoints are "
-                  "always whole-state (ring + carry + learner) so "
-                  "resume is bit-identical; flag ignored")
+                  "always whole-state (per-shard rings + PER sampler "
+                  "state + carry + learner) so resume is bit-identical "
+                  "at any --mesh-devices width; flag ignored")
         if args.save_every_frames and not args.checkpoint_dir:
             print("# --save-every-frames does nothing without "
                   "--checkpoint-dir; ignored")

@@ -153,6 +153,15 @@ class TrainCheckpointer:
         """Retained checkpoint steps (frame cursors), oldest first."""
         return tuple(sorted(self._mgr.all_steps()))
 
+    def delete(self, step: int) -> None:
+        """Remove one retained step (ISSUE 12): a committed orbax step
+        whose sidecar proved torn/unreadable is NOT a usable checkpoint
+        — the resume path deletes it so the run can fall back to the
+        previous step AND later re-save at the same frame cursor
+        without orbax's StepAlreadyExists refusal."""
+        self._join_pointer_stamp()
+        self._mgr.delete(int(step))
+
     def latest_step(self) -> Optional[int]:
         """Newest COMPLETE checkpoint step: the max of the ``LATEST``
         pointer (when present and its step dir still exists) and orbax's
@@ -575,6 +584,22 @@ def list_checkpoint_steps(directory: str) -> Tuple[int, ...]:
         return ckpt.all_steps()
     finally:
         ckpt.close()
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """np.savez to ``path`` atomically (tmp + rename): a crash mid-write
+    leaves the previous file, never a torn npz. The one shared writer
+    for replay/sidecar snapshots — the host-replay SIDECAR save is the
+    deliberate exception (it splices the ``sidecar.write`` chaos seam
+    between its tmp write and the rename)."""
+    import os
+
+    import numpy as np
+
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, path)
 
 
 def save_pytree(path: str, tree: PyTree) -> None:

@@ -21,11 +21,24 @@ running, so replayability is checkable byte-for-byte):
                   run must be BIT-IDENTICAL to an uninterrupted,
                   never-checkpointed reference, with every injected
                   trip recovered.
+  sharded_ckpt_crash  the data-parallel twin (ISSUE 12): a dp=2
+                  host-replay run takes a commit-without-stamp crash,
+                  a TORN PER-SHARD SIDECAR (truncated npz at the final
+                  path while the orbax step commits) and a hard kill
+                  at chunk k — resume must delete the unusable step,
+                  fall back to the previous intact one, and still end
+                  BIT-IDENTICAL to an uninterrupted dp=2 reference,
+                  all trips recovered.
   serving_reload  hot-reload under live load with a slowed restore and
                   a slowed + failed dispatch — every request answers
                   (the one injected failure as a structured error),
                   versions never tear or regress per client, and the
                   SIGTERM drain completes with admissions refused.
+
+Every scenario also reports its injector's ``open_trips()`` — the
+runner exits non-zero when ANY scenario ends with an unrecovered trip,
+so game days are CI-gateable on the recovery evidence itself, not only
+on each scenario's bespoke invariants.
 
 Run from the repo root (CPU is fine)::
 
@@ -51,6 +64,15 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# The sharded scenario runs a dp=2 mesh; on a CPU-only box that needs
+# the virtual-device flag BEFORE the jax backend initializes (the
+# scenarios import jax lazily, so setting it here covers them all —
+# same bootstrap as conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
 
 from dist_dqn_tpu import chaos  # noqa: E402
 from dist_dqn_tpu.chaos.plan import FaultEvent, FaultPlan  # noqa: E402
@@ -123,6 +145,26 @@ def plan_ckpt_crash(seed: int) -> FaultPlan:
     ))
 
 
+def plan_sharded_ckpt_crash(seed: int) -> FaultPlan:
+    rng = random.Random(f"{seed}:sharded_ckpt_crash")
+    # The torn sidecar and the kill share one chunk (one save per
+    # chunk at this scenario's cadence): the NEWEST step at kill time
+    # is the unusable one, so resume must exercise the fallback. A
+    # later kill would leave a newer intact step and the torn one
+    # would never be read.
+    k = 4 + rng.randrange(2)
+    return FaultPlan(seed=seed, events=(
+        # Save 2 commits its orbax step but dies before stamping LATEST.
+        FaultEvent("checkpoint.save", "crash_before_stamp", at_hit=2),
+        # Save k's per-shard sidecar lands TORN at the final path while
+        # the orbax step still commits (crash mid-write on a
+        # non-atomic-rename filesystem) — the newest step is unusable.
+        FaultEvent("sidecar.write", "torn", at_hit=k),
+        # And the run is killed right after that save.
+        FaultEvent("host_replay.chunk", "crash", at_hit=k),
+    ))
+
+
 def plan_serving_reload(seed: int) -> FaultPlan:
     rng = random.Random(f"{seed}:serving_reload")
     return FaultPlan(seed=seed, events=(
@@ -168,6 +210,7 @@ def scenario_apex_fleet(seed: int, workdir: str) -> dict:
     inj = chaos.install(plan, export_env=True, log_fn=None)
     try:
         out = run_apex(cfg, rt, log_fn=lambda s: None)
+        open_trips = inj.open_trips()
     finally:
         chaos.uninstall()
         os.environ.pop(chaos.CHAOS_PLAN_ENV, None)
@@ -189,7 +232,8 @@ def scenario_apex_fleet(seed: int, workdir: str) -> dict:
             "grad_steps": out["grad_steps"],
             "actor_restarts": out["actor_restarts"],
             "corrupt_frames_dropped": int(corrupt),
-            "parent_injections": inj.injected}
+            "parent_injections": inj.injected,
+            "open_trips": open_trips}
 
 
 def scenario_pipeline_wedge(seed: int, workdir: str) -> dict:
@@ -276,7 +320,7 @@ def scenario_pipeline_wedge(seed: int, workdir: str) -> dict:
             "env_steps": out["env_steps"], "bundles": n_bundles,
             "healthz_ever_503": not all(health_samples),
             "healthz_final_200": bool(health_samples[-1]),
-            "injections": injected}
+            "injections": injected, "open_trips": open_trips}
 
 
 def scenario_ckpt_crash(seed: int, workdir: str) -> dict:
@@ -329,7 +373,84 @@ def scenario_ckpt_crash(seed: int, workdir: str) -> dict:
     return {"scenario": "ckpt_crash", "plan": plan.to_dict(),
             "param_checksum": out["param_checksum"],
             "reference_checksum": ref["param_checksum"],
-            "bit_identical": True, "injections": injected}
+            "bit_identical": True, "injections": injected,
+            "open_trips": open_trips}
+
+
+def scenario_sharded_ckpt_crash(seed: int, workdir: str) -> dict:
+    """The ISSUE 12 game day: dp=2 host-replay under checkpoint chaos.
+    Invariants: the injected sequence equals the plan; the torn sidecar
+    forces a LOGGED fallback to the previous step; the resumed run is
+    bit-identical (param_checksum + grad steps) to an uninterrupted
+    never-checkpointed dp=2 reference; every trip recovered."""
+    import jax
+
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    if len(jax.devices()) < 2:
+        raise InvariantError(
+            "sharded_ckpt_crash needs >= 2 devices (the runner forces "
+            "2 virtual CPU devices; a site hook overrode it?)")
+    cfg = CONFIGS["cartpole"]
+    cfg = dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=False),
+        learner=dataclasses.replace(cfg.learner, batch_size=16))
+    kw = dict(total_env_steps=3200, chunk_iters=50, mesh_devices=2,
+              log_fn=lambda s: None)
+    ref = run_host_replay(cfg, **kw)
+    _check(ref["dp_size"] == 2, "reference run was not data-parallel")
+
+    plan = plan_sharded_ckpt_crash(seed)
+    ckpt_dir = os.path.join(workdir, "sharded_ckpt_crash")
+    killed = False
+    logs = []
+    with chaos.installed(plan, log_fn=None) as inj:
+        try:
+            run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                            mesh_devices=2, log_fn=lambda s: None,
+                            checkpoint_dir=ckpt_dir,
+                            save_every_frames=400)
+        except chaos.ChaosInjectedError:
+            killed = True
+        _check(killed, "the injected chunk crash never fired")
+        out = run_host_replay(cfg, total_env_steps=3200, chunk_iters=50,
+                              mesh_devices=2,
+                              log_fn=lambda s: logs.append(s),
+                              checkpoint_dir=ckpt_dir,
+                              save_every_frames=400)
+        injected = sorted((e["seam"], e["fault"], e["hit"])
+                          for e in inj.injected)
+        open_trips = inj.open_trips()
+    expected = sorted((e.seam, e.fault, e.at_hit) for e in plan.events)
+    _check(injected == expected,
+           f"injection sequence diverged from the plan: {injected} != "
+           f"{expected}")
+    fallback = [s for s in logs if "sidecar unreadable" in s]
+    _check(fallback, "the torn sidecar never forced a logged fallback")
+    resumed = [json.loads(s) for s in logs if "resumed_at_frames" in s]
+    _check(resumed and resumed[0].get("resumed_dp") == 2,
+           f"resume evidence missing/wrong: {resumed}")
+    _check(out["param_checksum"] == ref["param_checksum"],
+           "resumed dp=2 run is NOT bit-identical to the uninterrupted "
+           f"one: {out['param_checksum']} != {ref['param_checksum']}")
+    _check(out["grad_steps"] == ref["grad_steps"],
+           "resumed run trained a different number of steps")
+    _check(open_trips == [],
+           f"unrecovered trips after resume: {open_trips}")
+    return {"scenario": "sharded_ckpt_crash", "plan": plan.to_dict(),
+            "dp_size": 2, "param_checksum": out["param_checksum"],
+            "reference_checksum": ref["param_checksum"],
+            "bit_identical": True,
+            "resumed_at_frames": resumed[0]["resumed_at_frames"],
+            "torn_sidecar_fallbacks": len(fallback),
+            "injections": injected, "open_trips": open_trips}
 
 
 def scenario_serving_reload(seed: int, workdir: str) -> dict:
@@ -438,13 +559,15 @@ def scenario_serving_reload(seed: int, workdir: str) -> dict:
     return {"scenario": "serving_reload", "plan": plan.to_dict(),
             "answered": len(results), "injected_failures": len(errors),
             "steps_seen": sorted(steps_seen), "reloads": int(reloads),
-            "drained": True, "injections": injected}
+            "drained": True, "injections": injected,
+            "open_trips": open_trips}
 
 
 SCENARIOS = {
     "apex_fleet": scenario_apex_fleet,
     "pipeline_wedge": scenario_pipeline_wedge,
     "ckpt_crash": scenario_ckpt_crash,
+    "sharded_ckpt_crash": scenario_sharded_ckpt_crash,
     "serving_reload": scenario_serving_reload,
 }
 
@@ -452,6 +575,7 @@ PLANS = {
     "apex_fleet": plan_apex_fleet,
     "pipeline_wedge": lambda seed: plan_pipeline_wedge(seed, 4.0),
     "ckpt_crash": plan_ckpt_crash,
+    "sharded_ckpt_crash": plan_sharded_ckpt_crash,
     "serving_reload": plan_serving_reload,
 }
 
@@ -487,7 +611,18 @@ def main() -> int:
         try:
             result = SCENARIOS[name](args.seed, workdir)
             result["wall_s"] = round(time.perf_counter() - t0, 1)
-            result["ok"] = True
+            # CI gate (ISSUE 12 satellite): an unrecovered injection is
+            # a game-day failure even when every scenario-specific
+            # invariant held — a seam whose recovery proof never fired
+            # must fail the run, not pass silently.
+            if result.get("open_trips"):
+                failures.append(name)
+                result["ok"] = False
+                result["invariant_failed"] = (
+                    "open trips (injections without a recovery proof): "
+                    f"{result['open_trips']}")
+            else:
+                result["ok"] = True
         except InvariantError as e:
             failures.append(name)
             result = {"scenario": name, "ok": False,

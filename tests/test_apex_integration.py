@@ -288,3 +288,61 @@ def test_apex_replay_snapshot_resume(tmp_path):
     # and the shard never dropped below the restored fill.
     assert second["env_steps"] >= 2000
     assert second["replay_size"] >= first["replay_size"]
+
+
+def test_apex_replay_snapshot_resharded_resume(tmp_path):
+    """ISSUE 12 acceptance: an apex replay checkpoint written at
+    ingest_shards=2 RESUMES at ingest_shards=1 AND 4 — the changed-
+    shard refusal is a migration now. The restored store starts warm
+    (every record present: restored_items == the saved fill) and the
+    resumed service keeps training from it."""
+    import json
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096,
+                                   min_fill=200),
+        learner=dataclasses.replace(cfg.learner, batch_size=32, n_step=2),
+    )
+    d = str(tmp_path / "run")
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=4,
+                           envs_per_actor=2, total_env_steps=1200,
+                           inserts_per_grad_step=32, ingest_shards=2,
+                           checkpoint_dir=d, checkpoint_replay=True,
+                           save_every_steps=600)
+    first = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert first["replay_size"] > 400
+
+    # Each resumed run restores the snapshot its PREDECESSOR saved
+    # (2 -> 1 -> 4), so the exactly-once pin chains: restored items
+    # equal the previous run's final fill at every migration.
+    prev_size, prev_shards = first["replay_size"], 2
+    for new_shards, extra_steps in ((1, 1800), (4, 2600)):
+        rows = []
+
+        def capture(line):
+            try:
+                rows.append(json.loads(line))
+            except (TypeError, ValueError):
+                pass
+
+        rt_n = dataclasses.replace(rt, ingest_shards=new_shards,
+                                   total_env_steps=extra_steps)
+        out = run_apex(cfg, rt_n, log_fn=capture)
+        restored = [r for r in rows
+                    if "replay_snapshot_restored_items" in r]
+        assert restored, f"no snapshot restore at shards={new_shards}"
+        r0 = restored[0]
+        # Every saved record present exactly once in the new layout.
+        assert r0["replay_snapshot_resharded"] is True
+        assert r0["replay_snapshot_from_shards"] == prev_shards
+        assert r0["replay_snapshot_to_shards"] == new_shards
+        assert r0["replay_snapshot_restored_items"] == prev_size
+        assert out["env_steps"] >= extra_steps
+        assert out["grad_steps"] > 0
+        prev_size, prev_shards = out["replay_size"], new_shards

@@ -502,18 +502,156 @@ class TestResumeBitIdentical:
         losses_b = [r["loss"] for r in out_b["history"] if "loss" in r]
         assert losses_b == losses_a[len(losses_a) - len(losses_b):]
 
-    def test_per_checkpoint_combination_refused(self, tmp_path):
-        """PER resume cannot be honest about priorities yet — the
-        combination must refuse loudly up front, not drift silently."""
+    def test_per_killed_resume_bit_identical_serial(self, tmp_path):
+        """ISSUE 12: the PER twin of the resume pin. Serial PER
+        (--no-prefetch: run-to-run deterministic by design) killed at
+        chunk k and resumed must match the uninterrupted,
+        never-checkpointed run bit for bit — params, the whole loss
+        trajectory AND the priority accounting. That is only possible
+        because the sidecar snapshots the sampler EXACTLY: shadow mass,
+        running max, the sum-tree heap (incl. native delta drift) and
+        the deferred-but-unflushed write-back entries (flushed on the
+        killed run's schedule, never early)."""
         from dist_dqn_tpu.host_replay_loop import run_host_replay
 
         cfg = _tiny_cfg()
         cfg = dataclasses.replace(
             cfg, replay=dataclasses.replace(cfg.replay, prioritized=True))
-        with pytest.raises(ValueError, match="prioritized"):
-            run_host_replay(cfg, total_env_steps=800, chunk_iters=50,
+        # prio_writeback_batch chosen so a save boundary lands with the
+        # pending list NON-empty — the serialized-write-back path is
+        # exercised, not just the empty edge.
+        kw = dict(total_env_steps=3200, chunk_iters=50, prefetch=False,
+                  prio_writeback_batch=4)
+        out_a = run_host_replay(cfg, **kw, log_fn=lambda s: None)
+
+        ckpt_dir = str(tmp_path / "per_ckpt")
+        plan = chaos.FaultPlan(seed=9, events=(
+            chaos.FaultEvent("host_replay.chunk", "crash", at_hit=4),))
+        with chaos.installed(plan):
+            with pytest.raises(chaos.ChaosInjectedError,
+                               match="host_replay.chunk"):
+                run_host_replay(cfg, **kw, log_fn=lambda s: None,
+                                checkpoint_dir=ckpt_dir,
+                                save_every_frames=400)
+        out_b = run_host_replay(cfg, **kw, checkpoint_dir=ckpt_dir,
+                                save_every_frames=400,
+                                log_fn=lambda s: None)
+        assert out_b["param_checksum"] == out_a["param_checksum"]
+        assert out_b["grad_steps"] == out_a["grad_steps"]
+        losses_a = [r["loss"] for r in out_a["history"] if "loss" in r]
+        losses_b = [r["loss"] for r in out_b["history"] if "loss" in r]
+        assert losses_b == losses_a[len(losses_a) - len(losses_b):]
+        # Exact priority state: the write-back counters (restored from
+        # the sidecar + continued) reconcile with the uninterrupted
+        # run's totals — max-priority amnesia or an early flush would
+        # break this (and the loss pin above).
+        assert out_b["prio_writeback_rows"] == out_a["prio_writeback_rows"]
+        assert out_b["prio_writeback_flushes"] == \
+            out_a["prio_writeback_flushes"]
+
+    def test_extension_resume_continues_completed_run(self, tmp_path):
+        """Found by driving the CLI (ISSUE 12): resuming a COMPLETED
+        run's checkpoint with a LARGER --total-env-steps — "train
+        longer", a routine fleet operation — used to crash on the
+        missing in-flight chunk (a final save has none). It must
+        continue as a fresh prologue dispatch against the restored
+        ring/params. Honest contract: a CONTINUATION, not the
+        bit-identical pin (the collect-ahead schedule would have
+        dispatched the boundary chunk one train event earlier)."""
+        from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+        cfg = _tiny_cfg()
+        ckpt_dir = str(tmp_path / "ext_ckpt")
+        kw = dict(chunk_iters=50, checkpoint_dir=ckpt_dir,
+                  save_every_frames=400)
+        first = run_host_replay(cfg, **kw, total_env_steps=1600,
+                                log_fn=lambda s: None)
+        logs = []
+        out = run_host_replay(cfg, **kw, total_env_steps=3200,
+                              log_fn=lambda s: logs.append(s))
+        resumed = [json.loads(s) for s in logs
+                   if "resumed_at_frames" in s]
+        assert resumed and resumed[0]["resumed_at_frames"] == 1600
+        assert out["env_steps"] == 3200
+        assert out["grad_steps"] > first["grad_steps"]
+        assert np.isfinite(out["param_checksum"])
+
+    def test_mismatched_resume_refused_loudly(self, tmp_path):
+        """The sidecar pins (ISSUE 12): a checkpoint written under one
+        loop shape/mesh/sampler refuses a differently-configured resume
+        with the actual cause named — never a silently-wrong run."""
+        from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+        cfg = _tiny_cfg()
+        ckpt_dir = str(tmp_path / "pin_ckpt")
+        kw = dict(total_env_steps=1600, chunk_iters=50,
+                  log_fn=lambda s: None, checkpoint_dir=ckpt_dir,
+                  save_every_frames=400)
+        run_host_replay(cfg, **kw)
+        with pytest.raises(ValueError, match="chunk-iters"):
+            run_host_replay(cfg, total_env_steps=1600, chunk_iters=25,
                             log_fn=lambda s: None,
-                            checkpoint_dir=str(tmp_path / "d"))
+                            checkpoint_dir=ckpt_dir,
+                            save_every_frames=400)
+        per_cfg = dataclasses.replace(
+            cfg, replay=dataclasses.replace(cfg.replay, prioritized=True))
+        with pytest.raises(ValueError, match="prioritized"):
+            run_host_replay(per_cfg, **kw)
+        # PER flush-cadence pin: a checkpointed PER run refuses a
+        # different prio_writeback_batch (restored pending write-backs
+        # would flush on a different schedule — silent divergence).
+        per_dir = str(tmp_path / "per_pin")
+        run_host_replay(per_cfg, total_env_steps=1600, chunk_iters=50,
+                        prefetch=False, prio_writeback_batch=4,
+                        log_fn=lambda s: None, checkpoint_dir=per_dir,
+                        save_every_frames=400)
+        with pytest.raises(ValueError, match="write-back cadence"):
+            run_host_replay(per_cfg, total_env_steps=1600,
+                            chunk_iters=50, prefetch=False,
+                            prio_writeback_batch=2,
+                            log_fn=lambda s: None,
+                            checkpoint_dir=per_dir,
+                            save_every_frames=400)
+
+    def test_torn_sidecar_falls_back_to_previous_step(self, tmp_path):
+        """A committed orbax step whose sidecar is torn is not a
+        checkpoint: resume must delete it, fall back to the previous
+        intact step, and the continuing run must be able to RE-SAVE at
+        the same frame cursor (no StepAlreadyExists)."""
+        import glob
+
+        from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+        cfg = _tiny_cfg()
+        ckpt_dir = str(tmp_path / "torn_ckpt")
+        kw = dict(total_env_steps=3200, chunk_iters=50,
+                  checkpoint_dir=ckpt_dir, save_every_frames=400)
+        # One save per 400-frame chunk: the 4th save (1600 frames) is
+        # torn, and the run is killed right after it — so the NEWEST
+        # step is the unusable one and resume must fall back.
+        plan = chaos.FaultPlan(seed=3, events=(
+            chaos.FaultEvent("sidecar.write", "torn", at_hit=4),
+            chaos.FaultEvent("host_replay.chunk", "crash", at_hit=4),))
+        with chaos.installed(plan) as inj:
+            with pytest.raises(chaos.ChaosInjectedError):
+                run_host_replay(cfg, **kw, log_fn=lambda s: None)
+            assert sorted(e["seam"] for e in inj.injected) == \
+                ["host_replay.chunk", "sidecar.write"]
+            logs = []
+            out = run_host_replay(cfg, **kw,
+                                  log_fn=lambda s: logs.append(s))
+            # The torn newest step (save 4 = frames 1600) was deleted;
+            # resume fell back to the previous intact step (1200).
+            resumed = [json.loads(s) for s in logs
+                       if "resumed_at_frames" in s]
+            assert resumed and resumed[0]["resumed_at_frames"] == 1200
+            fallback = [s for s in logs if "sidecar unreadable" in s]
+            assert fallback, "no loud fallback log line"
+            assert inj.open_trips() == [], inj.open_trips()
+        assert out["env_steps"] == 3200
+        steps = sorted(int(p.split("_")[-1][:-4]) for p in glob.glob(
+            str(tmp_path / "torn_ckpt" / "host_loop_*.npz")))
+        assert 3200 in steps
 
 
 def test_emergency_hooks_bounded_and_snapshot_restorable(tmp_path):

@@ -1,0 +1,88 @@
+"""Tier-1 wiring for the checkpoint-sidecar schema lint
+(scripts/check_ckpt_schema.py): every sidecar field change must bump
+SIDECAR_VERSION and record its fingerprint in SIDECAR_HISTORY — so
+resume-format drift fails CI (and then fails loudly at restore via the
+sidecar's version stamp) instead of surfacing as a silently-wrong
+resume at 3am (ISSUE 12 satellite)."""
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_lint():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_ckpt_schema", REPO / "scripts" / "check_ckpt_schema.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sidecar_schema_pinned():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_ckpt_schema.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+
+
+def test_lint_catches_schema_drift(monkeypatch):
+    """The lint must bite: a field change (simulated by perturbing the
+    recorded digest — equivalent to editing SIDECAR_SCALAR_FIELDS
+    without re-recording) fails with the bump instruction."""
+    mod = _load_lint()
+    from dist_dqn_tpu.utils import ckpt_schema as cs
+
+    monkeypatch.setattr(cs, "SIDECAR_HISTORY",
+                        {v: "0" * 16 for v in cs.SIDECAR_HISTORY})
+    failures = mod.check()
+    assert failures, "drifted digest must fail"
+    assert any("bump SIDECAR_VERSION" in f for f in failures)
+
+
+def test_lint_catches_missing_version_entry(monkeypatch):
+    mod = _load_lint()
+    from dist_dqn_tpu.utils import ckpt_schema as cs
+
+    monkeypatch.setattr(
+        cs, "SIDECAR_HISTORY",
+        {v: d for v, d in cs.SIDECAR_HISTORY.items()
+         if v != cs.SIDECAR_VERSION})
+    failures = mod.check()
+    assert any("no SIDECAR_HISTORY entry" in f for f in failures)
+
+
+def test_digest_covers_every_field_class():
+    """The fingerprint must move when ANY of the three field classes
+    changes — scalars, conditionals, patterns."""
+    from dist_dqn_tpu.utils import ckpt_schema as cs
+
+    base = cs.sidecar_digest()
+    for attr in ("SIDECAR_SCALAR_FIELDS", "SIDECAR_CONDITIONAL_FIELDS",
+                 "SIDECAR_PATTERNS"):
+        saved = getattr(cs, attr)
+        try:
+            setattr(cs, attr, saved + ("zz_new_field",))
+            assert cs.sidecar_digest() != base, attr
+        finally:
+            setattr(cs, attr, saved)
+
+
+def test_validator_bites_on_unknown_and_missing_fields():
+    """The save-time gate: a writer emitting an unnamed key, or
+    dropping a required scalar, fails AT SAVE TIME with the schema
+    instruction."""
+    from dist_dqn_tpu.utils import ckpt_schema as cs
+
+    good = list(cs.SIDECAR_SCALAR_FIELDS) + [
+        "ring_obs", "ring_shard0_per_mass", "wb0_leaf", "wb_prios",
+        "pending_obs", "stats_cr"]
+    cs.validate_sidecar(good)
+    with pytest.raises(ValueError, match="does not name"):
+        cs.validate_sidecar(good + ["brand_new_unnamed_key"])
+    with pytest.raises(ValueError, match="missing required"):
+        cs.validate_sidecar([f for f in good if f != "dp"])

@@ -120,9 +120,31 @@ def test_rainbow_fused_loop_runs():
     assert np.all(np.isfinite(np.asarray(p0)))
 
 
+def _headless_gl_reason():
+    """Capability probe (ISSUE 12 satellite): on an EGL-less box the
+    dm_control render stack dies at IMPORT time with an AttributeError
+    deep inside PyOpenGL — not the clean NotImplementedError the
+    adapter raises once constructed. Probing the import up front turns
+    the two env-dependent cells into honest skips on headless boxes
+    (tier-1 fully green) while keeping them REAL tests wherever a GL
+    stack exists."""
+    import os
+
+    os.environ.setdefault("MUJOCO_GL", "egl")
+    try:
+        import dm_control.suite  # noqa: F401 — pulls the GL backend
+        return None
+    except Exception as e:  # noqa: BLE001 — any import failure means
+        # the same thing here: no usable headless GL / dm_control.
+        return f"{type(e).__name__}: {e}"
+
+
 def test_dmc_host_adapter_real_pixels():
     """Real dm_control reacher through the host adapter (EGL headless)."""
     pytest.importorskip("dm_control")
+    reason = _headless_gl_reason()
+    if reason:
+        pytest.skip(f"no headless GL: {reason}")
     from dist_dqn_tpu.envs.dmc_adapter import DMCPixelEnv
 
     try:
@@ -143,6 +165,9 @@ def test_dmc_host_adapter_real_pixels():
 
 def test_dmc_host_vector_env_registry():
     pytest.importorskip("dm_control")
+    reason = _headless_gl_reason()
+    if reason:
+        pytest.skip(f"no headless GL: {reason}")
     from dist_dqn_tpu.envs.gym_adapter import make_host_env
 
     try:

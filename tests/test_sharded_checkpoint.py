@@ -1,0 +1,187 @@
+"""Fleet-grade sharded checkpoint/resume (ISSUE 12) — the acceptance
+pins for the data-parallel era:
+
+* dp=2 KILL-AT-CHUNK-K RESUME: a host-replay run over a 2-device mesh,
+  killed by an injected crash right after a checkpoint, resumes
+  BIT-IDENTICALLY (param_checksum + full loss trajectory) to an
+  uninterrupted, never-checkpointed dp=2 run — the ISSUE 8 pin lifted
+  to the sharded era (per-shard ring snapshots, per-shard prefetcher
+  seek, mesh-width pin);
+* the same pin under PER (serial --no-prefetch mode, which is
+  deterministic by design): exact per-shard priority state — shadow
+  mass, sum-tree heap, running max and the deferred write-back entries
+  all resume exactly;
+* REFUSAL pins: a dp=2 checkpoint refuses a dp=1 resume (lane blocks
+  are positional) with the mesh width named;
+* EMERGENCY SAVE carries ALL shards: the watchdog-abort hook dumps
+  every shard's ring (not a learner-only snapshot).
+
+Needs the 8-device CPU mesh conftest.py forces.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.config import CONFIGS
+
+
+def _dp_cfg(prioritized=False):
+    cfg = CONFIGS["cartpole"]
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=prioritized),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+
+
+def _require_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs >= {n} CPU devices from conftest")
+
+
+def _killed_then_resumed(cfg, ckpt_dir, **kw):
+    """Run killed at chunk 4 by an injected crash, then resumed; returns
+    (resumed summary, resume log lines)."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    plan = chaos.FaultPlan(seed=9, events=(
+        chaos.FaultEvent("host_replay.chunk", "crash", at_hit=4),))
+    with chaos.installed(plan) as inj:
+        with pytest.raises(chaos.ChaosInjectedError,
+                           match="host_replay.chunk"):
+            run_host_replay(cfg, **kw, log_fn=lambda s: None,
+                            checkpoint_dir=ckpt_dir,
+                            save_every_frames=400)
+        assert [e["hit"] for e in inj.injected] == [4]
+        logs = []
+        out = run_host_replay(cfg, **kw, checkpoint_dir=ckpt_dir,
+                              save_every_frames=400,
+                              log_fn=lambda s: logs.append(s))
+        assert inj.open_trips() == [], inj.open_trips()
+    return out, logs
+
+
+def _pin_tail(out, ref):
+    assert out["param_checksum"] == ref["param_checksum"]
+    assert out["grad_steps"] == ref["grad_steps"]
+    losses_a = [r["loss"] for r in ref["history"] if "loss" in r]
+    losses_b = [r["loss"] for r in out["history"] if "loss" in r]
+    assert losses_b == losses_a[len(losses_a) - len(losses_b):]
+
+
+def test_dp2_killed_resume_bit_identical(tmp_path):
+    """THE sharded resume pin: dp=2, uniform, pipelined + prefetched —
+    the production shape — killed at chunk 4 and resumed, bit-identical
+    to the uninterrupted never-checkpointed reference."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _dp_cfg()
+    kw = dict(total_env_steps=2400, chunk_iters=50, mesh_devices=2)
+    ref = run_host_replay(cfg, **kw, log_fn=lambda s: None)
+    assert ref["dp_size"] == 2 and ref["grad_steps"] > 0
+
+    out, logs = _killed_then_resumed(cfg, str(tmp_path / "dp2"), **kw)
+    resumed = [json.loads(s) for s in logs if "resumed_at_frames" in s]
+    assert resumed and resumed[0]["resumed_dp"] == 2
+    assert resumed[0]["resumed_at_frames"] == 1600
+    _pin_tail(out, ref)
+
+
+def test_dp2_per_killed_resume_bit_identical(tmp_path):
+    """The PER + sharded combination (serial mode for determinism):
+    per-shard sum-tree state resumes exactly across a kill."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _dp_cfg(prioritized=True)
+    kw = dict(total_env_steps=2400, chunk_iters=50, mesh_devices=2,
+              prefetch=False, prio_writeback_batch=4)
+    ref = run_host_replay(cfg, **kw, log_fn=lambda s: None)
+    assert ref["prioritized"] and ref["prio_writeback_rows"] > 0
+
+    out, _ = _killed_then_resumed(cfg, str(tmp_path / "dp2per"), **kw)
+    _pin_tail(out, ref)
+    assert out["prio_writeback_rows"] == ref["prio_writeback_rows"]
+    assert out["prio_writeback_flushes"] == ref["prio_writeback_flushes"]
+
+
+def test_dp_mismatch_resume_refused(tmp_path):
+    """A dp=2 checkpoint names the mesh width when a dp=1 resume is
+    attempted — lane blocks are positional, so this refusal is the
+    honest surface (the apex ITEM store migrates; the lane store
+    refuses)."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _dp_cfg()
+    ckpt_dir = str(tmp_path / "dpmix")
+    kw = dict(total_env_steps=1600, chunk_iters=50,
+              checkpoint_dir=ckpt_dir, save_every_frames=400,
+              log_fn=lambda s: None)
+    run_host_replay(cfg, **kw, mesh_devices=2)
+    with pytest.raises(ValueError, match="mesh-devices"):
+        run_host_replay(cfg, **kw, mesh_devices=1)
+
+
+def test_emergency_save_carries_all_shards(tmp_path):
+    """Watchdog-abort emergency checkpoint at dp>1 (ISSUE 12): the hook
+    dumps the learner PLUS every shard's ring snapshot — driven by
+    firing the registered hooks from inside the live run (the log
+    callback runs on the loop thread, hooks armed)."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
+
+    cfg = _dp_cfg()
+    ckpt_dir = tmp_path / "emerg"
+    fired = {"done": False}
+
+    def log_hook(s):
+        if not fired["done"] and "env_frames" in s:
+            fired["done"] = True
+            tm_watchdog.run_emergency_hooks(timeout_s=60,
+                                            log_fn=lambda *_: None)
+
+    run_host_replay(cfg, total_env_steps=1600, chunk_iters=50,
+                    mesh_devices=2, checkpoint_dir=str(ckpt_dir),
+                    save_every_frames=400, log_fn=log_hook)
+    assert fired["done"]
+    assert (ckpt_dir / "emergency_learner").exists()
+    with np.load(ckpt_dir / "emergency_sidecar.npz") as f:
+        keys = set(f.files)
+        assert int(f["dp"]) == 2
+        for s in (0, 1):
+            assert f"ring_shard{s}_obs" in keys
+            assert f"ring_shard{s}_pos" in keys
+
+
+def test_sidecar_schema_stamped_and_validated(tmp_path):
+    """Every sidecar carries the schema version stamp and passes the
+    schema gate (the save path validates; this pins the on-disk
+    artifact a future build will read)."""
+    import glob
+
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.utils import ckpt_schema
+
+    cfg = _dp_cfg()
+    ckpt_dir = str(tmp_path / "schema")
+    run_host_replay(cfg, total_env_steps=1200, chunk_iters=50,
+                    checkpoint_dir=ckpt_dir, save_every_frames=400,
+                    log_fn=lambda s: None)
+    sidecars = glob.glob(ckpt_dir + "/host_loop_*.npz")
+    assert sidecars
+    with np.load(sidecars[0]) as f:
+        assert int(f["sidecar_version"]) == ckpt_schema.SIDECAR_VERSION
+        ckpt_schema.validate_sidecar(f.files)

@@ -198,15 +198,218 @@ def test_apex_store_sample_update_and_snapshot_roundtrip():
     clone = ShardedPrioritizedReplay(2, 1024)
     clone.load_state_dict(snap)
     assert len(clone) == len(store)
-    # Honest loud error on a changed shard count (resume contract).
-    with pytest.raises(ValueError, match="same shard count"):
-        ShardedPrioritizedReplay(4, 1024).load_state_dict(snap)
+    # A changed shard count is a supported MIGRATION since ISSUE 12
+    # (records redistributed by global slot encoding), no longer a
+    # refusal — the exactly-once pin lives in
+    # test_apex_store_reshards_2_to_4_and_2_to_1.
+    migrated = ShardedPrioritizedReplay(4, 1024)
+    migrated.load_state_dict(snap)
+    assert len(migrated) == len(store)
 
 
 def test_apex_store_unattributed_insert_refused():
     store = ShardedPrioritizedReplay(2, 256)
     with pytest.raises(ValueError, match="shard id"):
         store.add({"obs": np.zeros((4, 2), np.float32)})
+
+
+def test_sharded_state_dict_roundtrip_under_wraparound():
+    """ISSUE 12 satellite: the facade's whole-window snapshot
+    round-trips exactly AFTER the rings have wrapped (the live region
+    is position-dependent), PER sampler state included — subsequent
+    draws from the clone are bit-identical."""
+    rng = np.random.default_rng(0)
+    fac = ShardedHostReplay(2, 48, 4, (5,), np.float32)
+    fac.attach_priority_samplers(n_step=2, alpha=0.6, beta=0.4, eps=1e-6)
+    # 5 chunks x 24 slices = 120 rows > 48 slots: both rings wrap.
+    for s in (0, 1):
+        _fill_ring(fac, s, np.random.default_rng(60 + s), chunks=5)
+    _, per = fac.sample(np.random.default_rng(1), 32, 0.99)
+    fac.update_priorities(per.leaf, rng.random(32) * 3, per.slot_gen)
+
+    clone = ShardedHostReplay(2, 48, 4, (5,), np.float32)
+    clone.attach_priority_samplers(n_step=2, alpha=0.6, beta=0.4,
+                                   eps=1e-6)
+    clone.load_state_dict(fac.state_dict())
+    for s in (0, 1):
+        assert clone.rings[s].pos == fac.rings[s].pos
+        assert clone.rings[s].size == fac.rings[s].size
+        assert clone.rings[s].generation == fac.rings[s].generation
+        np.testing.assert_array_equal(clone.rings[s].slot_gen,
+                                      fac.rings[s].slot_gen)
+        assert clone.samplers[s].tree.total == fac.samplers[s].tree.total
+    b1, p1 = fac.sample(np.random.default_rng(7), 24, 0.99)
+    b2, p2 = clone.sample(np.random.default_rng(7), 24, 0.99)
+    np.testing.assert_array_equal(p1.leaf, p2.leaf)
+    np.testing.assert_array_equal(p1.weights, p2.weights)
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_state_dict_refusals():
+    """Shard-count and PER-presence mismatches refuse loudly — resume
+    must never silently reinterpret a striped window."""
+    fac = ShardedHostReplay(2, 32, 4, (5,), np.float32)
+    fac.attach_priority_samplers(n_step=1, alpha=0.6, beta=0.4, eps=1e-6)
+    for s in (0, 1):
+        _fill_ring(fac, s, np.random.default_rng(70 + s))
+    snap = fac.state_dict()
+    with pytest.raises(ValueError, match="same shard count"):
+        ShardedHostReplay(3, 32, 4, (5,), np.float32).load_state_dict(snap)
+    with pytest.raises(ValueError, match="prioritized"):
+        # PER snapshot into a uniform facade.
+        ShardedHostReplay(2, 32, 4, (5,), np.float32).load_state_dict(snap)
+    uni = ShardedHostReplay(2, 32, 4, (5,), np.float32)
+    for s in (0, 1):
+        _fill_ring(uni, s, np.random.default_rng(80 + s))
+    per_fac = ShardedHostReplay(2, 32, 4, (5,), np.float32)
+    per_fac.attach_priority_samplers(n_step=1, alpha=0.6, beta=0.4,
+                                     eps=1e-6)
+    with pytest.raises(ValueError, match="uniform"):
+        # Uniform snapshot into a PER facade.
+        per_fac.load_state_dict(uni.state_dict())
+
+
+def test_sharded_snapshot_consistent_while_other_shard_appends():
+    """Generation-fence consistency (ISSUE 12 satellite): a snapshot
+    taken while another shard is mid-append from a background thread
+    must be per-shard all-or-nothing — every stored slot's lanes agree
+    and match its generation stamp (appender writes chunk j with obs
+    value j == generation j+1 minus one), never a half-appended
+    slice."""
+    import threading
+
+    fac = ShardedHostReplay(2, 64, 4, (1,), np.float32)
+    # Shard 0 static; shard 1 hammered by the appender thread.
+    fac.add_chunk(0, np.zeros((8, 4, 1), np.float32),
+                  np.zeros((8, 4), np.int32), np.zeros((8, 4), np.float32),
+                  np.zeros((8, 4), bool), np.zeros((8, 4), bool))
+    stop = threading.Event()
+
+    def appender():
+        j = 0
+        while not stop.is_set():
+            C = 8
+            obs = np.full((C, 4, 1), float(j), np.float32)
+            fac.add_chunk(1, obs,
+                          np.zeros((C, 4), np.int32),
+                          np.zeros((C, 4), np.float32),
+                          np.zeros((C, 4), bool),
+                          np.zeros((C, 4), bool))
+            j += 1
+
+    t = threading.Thread(target=appender, name="chunk-appender",
+                         daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = fac.state_dict()
+            ring1 = fac.rings[1]
+            size = int(snap["shard1_size"])
+            pos = int(snap["shard1_pos"])
+            gen = int(snap["shard1_generation"])
+            obs = snap["shard1_obs"]
+            slot_gen = snap["shard1_slot_gen"]
+            if size == 0:
+                continue
+            stored_t = (pos - size + np.arange(size)) % ring1.num_slots
+            # Every stored slot: all 4 lanes equal (no torn lane rows)
+            # and the value maps to the generation that wrote it
+            # (chunk j == generation j+1).
+            vals = obs[stored_t, :, 0]
+            assert (vals == vals[:, :1]).all(), "torn lane row"
+            np.testing.assert_array_equal(vals[:, 0] + 1,
+                                          slot_gen[stored_t])
+            # Whole chunks only: the newest generation's slot count is
+            # a full chunk (8), never a partial slice.
+            newest = slot_gen[stored_t] == gen
+            assert newest.sum() in (0, 8)
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_apex_store_reshards_2_to_4_and_2_to_1():
+    """THE resharding pin (ISSUE 12 acceptance): a dp=2 apex replay
+    checkpoint restores at dp=4 and dp=1 with EVERY record present
+    exactly once, priorities preserved (total tree mass conserved, not
+    max-priority laundered)."""
+    from dist_dqn_tpu.replay.sharded import restore_replay_snapshot
+
+    rng = np.random.default_rng(0)
+    store = ShardedPrioritizedReplay(2, 1024)
+    ids = np.arange(300, dtype=np.float32)
+    pr = rng.random(300) + 0.1
+    store.add({"id": ids[:140], "action": np.zeros(140, np.int32)},
+              priorities=pr[:140], shard=0)
+    store.add({"id": ids[140:], "action": np.ones(160, np.int32)},
+              priorities=pr[140:], shard=1)
+    snap = store.state_dict()
+    src_mass = sum(s.tree.total for s in store.shards)
+
+    t4 = ShardedPrioritizedReplay(4, 1024)
+    info = restore_replay_snapshot(t4, snap)
+    assert info["resharded"] and info["records"] == 300
+    assert (info["from_shards"], info["to_shards"]) == (2, 4)
+    got = np.concatenate([s._data["id"][:len(s)] for s in t4.shards])
+    np.testing.assert_array_equal(np.sort(got), ids)   # exactly once
+    np.testing.assert_allclose(sum(s.tree.total for s in t4.shards),
+                               src_mass, rtol=1e-12)
+    # The migrated store is live: draws and write-backs work.
+    items, idx, w = t4.sample(64, beta=0.4)
+    assert items["id"].shape == (64,) and w.max() == 1.0
+    t4.update_priorities(idx, rng.random(64),
+                         expected_gen=t4.generation(idx))
+
+    t1 = PrioritizedHostReplay(1024)
+    info = restore_replay_snapshot(t1, snap)
+    assert info["resharded"] and info["to_shards"] == 1
+    np.testing.assert_array_equal(
+        np.sort(t1._data["id"][:len(t1)]), ids)
+    np.testing.assert_allclose(t1.tree.total, src_mass, rtol=1e-12)
+
+    # And up from a PLAIN snapshot (dp=1 -> dp=2).
+    t2 = ShardedPrioritizedReplay(2, 1024)
+    info = restore_replay_snapshot(t2, t1.state_dict())
+    assert info["resharded"] and info["from_shards"] == 1
+    got = np.concatenate([s._data["id"][:len(s)] for s in t2.shards])
+    np.testing.assert_array_equal(np.sort(got), ids)
+
+
+def test_apex_reshard_refuses_alpha_mismatch():
+    """The migration enforces the same alpha guard the exact restore
+    does: stamped mass is p^alpha_saved, so mixing exponents in one
+    tree would silently re-weight every draw."""
+    from dist_dqn_tpu.replay.sharded import restore_replay_snapshot
+
+    store = ShardedPrioritizedReplay(2, 512, alpha=0.6)
+    for s in (0, 1):
+        store.add({"id": np.zeros(20, np.float32)},
+                  priorities=np.ones(20), shard=s)
+    snap = store.state_dict()
+    with pytest.raises(ValueError, match="priority_exponent"):
+        restore_replay_snapshot(
+            ShardedPrioritizedReplay(4, 512, alpha=0.5), snap)
+
+
+def test_apex_store_exact_restore_still_exact():
+    """Same-layout restores stay the EXACT path (cursors, slot
+    generations and counters bit-identical — not a migration)."""
+    from dist_dqn_tpu.replay.sharded import restore_replay_snapshot
+
+    rng = np.random.default_rng(1)
+    store = ShardedPrioritizedReplay(2, 512)
+    for s in (0, 1):
+        store.add({"obs": rng.random((100, 4)).astype(np.float32)},
+                  priorities=rng.random(100) + 0.1, shard=s)
+    clone = ShardedPrioritizedReplay(2, 512)
+    info = restore_replay_snapshot(clone, store.state_dict())
+    assert not info["resharded"]
+    for s in (0, 1):
+        assert clone.shards[s]._pos == store.shards[s]._pos
+        assert clone.shards[s].added == store.shards[s].added
+        np.testing.assert_array_equal(clone.shards[s]._slot_gen,
+                                      store.shards[s]._slot_gen)
 
 
 def _dp_cfg(prioritized=False):
@@ -326,6 +529,10 @@ def test_sharded_scan_priorities_are_substep_major():
 
 
 def test_host_replay_dp_honest_errors():
+    # The dp>1 --checkpoint-dir refusal is GONE since ISSUE 12 (sharded
+    # whole-state resume is supported and pinned in
+    # tests/test_sharded_checkpoint.py); what remains honest-loud is
+    # the lane-divisibility contract.
     from dist_dqn_tpu.host_replay_loop import run_host_replay
 
     with pytest.raises(ValueError, match="not divisible"):
@@ -334,7 +541,3 @@ def test_host_replay_dp_honest_errors():
                 _dp_cfg(), actor=dataclasses.replace(
                     CONFIGS["cartpole"].actor, num_envs=6)),
             total_env_steps=100, mesh_devices=4, log_fn=lambda s: None)
-    with pytest.raises(ValueError, match="mesh-devices"):
-        run_host_replay(_dp_cfg(), total_env_steps=100, mesh_devices=2,
-                        checkpoint_dir="/tmp/nope",
-                        log_fn=lambda s: None)
