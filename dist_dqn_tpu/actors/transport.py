@@ -513,17 +513,28 @@ class TcpRecordServer:
             conn.close()
 
     def _count_corrupt(self, reason: str) -> None:
-        self.corrupt_frames += 1
+        # Under the lock: serve threads count corrupt frames
+        # concurrently; an unlocked += across threads loses updates.
+        with self._lock:
+            self.corrupt_frames += 1
         _corrupt_frame_counter(reason, side="server").inc()
 
     def _shed(self, conn_id: int) -> None:
-        self.shed_records += 1
+        # Under the lock (lock-discipline fix, ISSUE 13): _shed runs on
+        # every serve thread whose wait bound expired at once, and
+        # _shed_alarmed is reset under the lock by the push loop — the
+        # unlocked read-then-set here let concurrent shedders each see
+        # False and emit duplicate "one per episode" alarms, and the
+        # unlocked += lost shed_records increments across threads.
+        with self._lock:
+            self.shed_records += 1
+            alarm = not self._shed_alarmed
+            self._shed_alarmed = True
         self._c_shed.inc()
-        if not self._shed_alarmed:
+        if alarm:
             # One alarm per shed episode, not one per record: the
             # signal is "the drain is dead", already screamed by the
             # backlog gauge; per-record lines would swamp the log.
-            self._shed_alarmed = True
             print(json.dumps({
                 "transport_shedding": True, "conn_id": conn_id,
                 "backlog": self._max_backlog,

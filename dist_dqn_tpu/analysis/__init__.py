@@ -1,0 +1,29 @@
+"""dqnlint: the unified static-analysis framework (ISSUE 13).
+
+One substrate (``core``: repo-file discovery, cached AST walks, the
+Finding dataclass, rationale-comment parsing), one suppression story
+(``baseline``: reasoned entries only, stale entries fail), two
+reporters (``report``: text + versioned JSON), one registry
+(``registry``: a plugin per file under ``plugins/``) and one runner
+(``scripts/dqnlint.py`` -> ``runner.run_checks``).
+
+The nine checks registered today: the seven lints migrated from their
+``scripts/check_*.py`` one-offs (metrics, threads, donation, sockets,
+wire, mesh-axis, ckpt-schema) plus the two analyzers the one-off
+pattern could never support — ``lock-discipline`` (per-class guarded-
+field race inference) and ``chaos-seams`` (seam registry vs. fire/
+recovery call-site drift). Catalog: docs/static_analysis.md.
+"""
+from dist_dqn_tpu.analysis.baseline import (BaselineError,  # noqa: F401
+                                            DEFAULT_BASELINE,
+                                            apply_baseline, load_baseline,
+                                            save_baseline)
+from dist_dqn_tpu.analysis.core import (AnalysisContext,  # noqa: F401
+                                        Check, Finding, has_rationale)
+from dist_dqn_tpu.analysis.registry import (check_names,  # noqa: F401
+                                            discover, get_checks,
+                                            register)
+from dist_dqn_tpu.analysis.report import (CheckResult,  # noqa: F401
+                                          render_json, render_text)
+from dist_dqn_tpu.analysis.runner import (legacy_main,  # noqa: F401
+                                          run_checks)

@@ -233,6 +233,10 @@ class MicroBatcher:
 
     # -- admission ----------------------------------------------------------
     def queue_depth(self) -> int:
+        # Monitoring read for the SLO probe/metrics: the depth is stale
+        # the instant it returns, and taking _cond here would make
+        # every /healthz scrape contend with the dispatch hot path.
+        # lock: len() on a deque is one atomic op under the GIL.
         return len(self._queue)
 
     def _validate_obs(self, obs) -> np.ndarray:
@@ -270,6 +274,8 @@ class MicroBatcher:
         Called from HTTP handler threads (and directly by tests/bench).
         """
         obs = self._validate_obs(obs)
+        # lock: advisory fast-path read — the authoritative _draining
+        # check re-runs under _cond below, atomically with the enqueue.
         if self._draining:
             # Graceful drain (ISSUE 8): already-admitted requests
             # complete; NEW admissions are refused up front (503) so
@@ -371,6 +377,8 @@ class MicroBatcher:
             hb.close()
             self._fail_queue(ServerClosedError("server shut down"))
 
+    # lock: called only from _take_batch with self._cond already held —
+    # a cross-function hold the lexical race analysis cannot see.
     def _head_run_rows(self) -> int:
         """Rows queued for the head request's policy (stops at the
         first other-policy request — batches never mix params)."""

@@ -235,7 +235,14 @@ class ModelStore:
         """Start the hot-reload watcher thread (idempotent)."""
         if self._thread is not None:
             return
-        for entry in self._entries.values():
+        # Snapshot under the lock (lock-discipline fix, ISSUE 13):
+        # add_policy mutates _entries under the lock from whatever
+        # thread registers late tenants, and iterating the live dict
+        # here raced that with "dictionary changed size during
+        # iteration" — the same copy-then-walk poll_once uses.
+        with self._lock:
+            entries = list(self._entries.values())
+        for entry in entries:
             if entry.snapshot is not None:
                 self._version_gauge(entry.policy_id).set(
                     entry.snapshot.version)

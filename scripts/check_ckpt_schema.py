@@ -1,24 +1,11 @@
 #!/usr/bin/env python
-"""Lint: the checkpoint-sidecar schema is pinned to its version.
-
-ISSUE 12: host-replay's whole-state resume deserializes an npz sidecar
-by FIELD NAME — a renamed/dropped/added field without a version bump
-would surface at restore time (3am, on the production fleet) as a
-silently-wrong or crashing resume, not in CI. This lint makes the
-schema change mechanical, mirroring the wire codec's check_wire.py:
-
-  * it fingerprints the sidecar field registry of
-    ``dist_dqn_tpu/utils/ckpt_schema.py`` (scalars, conditionals and
-    per-shard/per-entry patterns);
-  * the digest must equal ``SIDECAR_HISTORY[SIDECAR_VERSION]``;
-  * history is append-only: every version maps to a distinct digest,
-    and the live version leads the history.
-
-Editing any sidecar field without adding a NEW (version, digest) pair
-fails CI with the expected digest printed; the resume path refuses a
-mismatched on-disk version loudly at restore. Run from the repo root:
-``python scripts/check_ckpt_schema.py``. Wired into tier-1 via
-tests/test_ckpt_schema_lint.py.
+"""Compatibility shim (ISSUE 13): the checkpoint-sidecar schema lint
+now lives in ``dist_dqn_tpu/analysis/plugins/ckpt_schema.py``,
+registered with ``scripts/dqnlint.py`` as the ``ckpt-schema`` check.
+This entry point keeps the original verdict contract —
+``python scripts/check_ckpt_schema.py`` prints ``check_ckpt_schema:
+OK``/``FAIL`` with the same exit code — and re-exports the historical
+module surface for external references.
 """
 from __future__ import annotations
 
@@ -27,59 +14,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-
-def check() -> list:
-    from dist_dqn_tpu.utils import ckpt_schema as cs
-
-    failures = []
-    digest = cs.sidecar_digest()
-    if cs.SIDECAR_VERSION not in cs.SIDECAR_HISTORY:
-        failures.append(
-            f"SIDECAR_VERSION {cs.SIDECAR_VERSION} has no SIDECAR_HISTORY "
-            f"entry — record it as {cs.SIDECAR_VERSION}: \"{digest}\"")
-    elif cs.SIDECAR_HISTORY[cs.SIDECAR_VERSION] != digest:
-        failures.append(
-            f"sidecar-schema fingerprint {digest} does not match "
-            f"SIDECAR_HISTORY[{cs.SIDECAR_VERSION}] = "
-            f"{cs.SIDECAR_HISTORY[cs.SIDECAR_VERSION]!r}: the field set "
-            f"changed — bump SIDECAR_VERSION "
-            f"(dist_dqn_tpu/utils/ckpt_schema.py) and append the new "
-            f"(version, digest) pair to SIDECAR_HISTORY; resumes then "
-            f"refuse a mismatched sidecar loudly at restore instead of "
-            f"deserializing silence")
-    if cs.SIDECAR_HISTORY and max(cs.SIDECAR_HISTORY) != cs.SIDECAR_VERSION:
-        failures.append(
-            f"SIDECAR_HISTORY records version {max(cs.SIDECAR_HISTORY)} "
-            f"but SIDECAR_VERSION is {cs.SIDECAR_VERSION} — history is "
-            "append-only and the constant must lead it")
-    digests = list(cs.SIDECAR_HISTORY.values())
-    if len(set(digests)) != len(digests):
-        failures.append(
-            "SIDECAR_HISTORY maps two versions to the same digest — a "
-            "version bump without a schema change (or a rewritten entry)")
-    # The validator itself must accept a canonical minimal sidecar —
-    # a schema whose own patterns reject its scalar fields would pass
-    # the digest check while failing every real save.
-    try:
-        cs.validate_sidecar(list(cs.SIDECAR_SCALAR_FIELDS))
-    except ValueError as e:
-        failures.append(f"validate_sidecar rejects the schema's own "
-                        f"scalar field set: {e}")
-    return failures
+from dist_dqn_tpu.analysis.plugins.ckpt_schema import check  # noqa: F401,E402
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    failures = check()
-    if failures:
-        print("check_ckpt_schema: FAIL", file=sys.stderr)
-        for f in failures:
-            print("  " + f, file=sys.stderr)
-        return 1
-    from dist_dqn_tpu.utils import ckpt_schema as cs
-
-    print(f"check_ckpt_schema: OK (sidecar v{cs.SIDECAR_VERSION}, "
-          f"digest {cs.sidecar_digest()})")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("ckpt-schema", "check_ckpt_schema")
 
 
 if __name__ == "__main__":

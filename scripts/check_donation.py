@@ -1,156 +1,29 @@
 #!/usr/bin/env python
-"""Lint: every jitted train/collect entry point must declare explicit
-``donate_argnums`` — or carry a ``donation:`` rationale comment.
-
-ISSUE 6's aliasing audit (utils/donation.py) verified the chunk
-programs donate their GB-sized carries completely (alias_bytes ==
-argument_bytes on the fused chunk); what the runtime audit cannot do is
-stop the NEXT train/collect jit from silently omitting the donation —
-the failure mode is an HBM working set doubled on a chip that used to
-fit, discovered as an OOM months later. This is the static half of the
-guard, the sibling of scripts/check_metrics.py / check_threads.py.
-
-AST-based: any ``jax.jit(...)`` call (or ``partial(jax.jit, ...)``)
-whose jitted expression mentions ``train``/``collect``/``chunk`` is a
-learner/collector entry point and must either
-
-* pass ``donate_argnums=`` explicitly, or
-* be preceded (within two lines, or on the same line) by a comment
-  containing ``donation:`` stating why nothing is donated (e.g. a
-  pure-function cast whose inputs are reused by the caller).
-
-Functions named act/eval/sample are out of scope by construction (their
-params ARE reused across calls — donating would be the bug).
-
-Run from the repo root: ``python scripts/check_donation.py``. Wired
-into tier-1 via tests/test_donation_lint.py.
+"""Compatibility shim (ISSUE 13): the buffer-donation lint now lives in
+``dist_dqn_tpu/analysis/plugins/donation.py``, registered with
+``scripts/dqnlint.py`` as the ``donation`` check. This entry point
+keeps the original verdict contract — ``python scripts/check_donation.py``
+prints ``check_donation: OK``/``FAIL`` with the same exit code — and
+re-exports the historical module surface for external references.
 """
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: What makes a jitted expression a train/collect entry point.
-#: ``shard`` joined in ISSUE 10: the data-parallel learners wrap their
-#: train steps in closures named ``sharded`` (parallel/learner.py
-#: make_sharded_train_step), which the train/collect/chunk patterns
-#: would silently stop seeing.
-TARGET = re.compile(r"train|collect|chunk|shard")
-#: Rationale escape hatch: a nearby comment owning the decision.
-RATIONALE = re.compile(r"#.*donation:")
-
-
-def _is_jit_call(node: ast.Call) -> bool:
-    """True for ``jax.jit(...)`` / ``jit(...)`` and the
-    ``partial(jax.jit, ...)`` spelling."""
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr == "jit":
-        return True
-    if isinstance(f, ast.Name) and f.id == "jit":
-        return True
-    if isinstance(f, ast.Name) and f.id == "partial" and node.args:
-        inner = node.args[0]
-        return (isinstance(inner, ast.Attribute) and inner.attr == "jit") \
-            or (isinstance(inner, ast.Name) and inner.id == "jit")
-    return False
-
-
-def _jitted_expr_text(node: ast.Call) -> str:
-    """Source text of what is being jitted (first non-jax.jit arg)."""
-    args = node.args
-    if args and isinstance(args[0], (ast.Attribute, ast.Name)) \
-            and getattr(args[0], "attr", getattr(args[0], "id", "")) \
-            == "jit":
-        args = args[1:]  # partial(jax.jit, ...) positional tail
-    try:
-        return " ".join(ast.unparse(a) for a in args)
-    except Exception:
-        return ""
-
-
-def _has_rationale(lines, lineno: int) -> bool:
-    """A ``donation:`` comment on the call line or the two above it."""
-    lo = max(lineno - 3, 0)
-    return any(RATIONALE.search(ln) for ln in lines[lo:lineno])
-
-
-def scan(repo_root: Path):
-    """[(relpath, lineno, jitted expr), ...] for violating sites."""
-    failures = []
-    for root in SCAN_ROOTS:
-        base = repo_root / root
-        files = ([base] if base.is_file()
-                 else sorted(base.rglob("*.py")) if base.is_dir() else [])
-        for f in files:
-            rel = f.relative_to(repo_root).as_posix()
-            src = f.read_text()
-            try:
-                tree = ast.parse(src)
-            except SyntaxError as e:
-                failures.append((rel, e.lineno or 0, "<unparseable>"))
-                continue
-            lines = src.splitlines()
-            decorator_calls = set()
-            # Decorator spellings: @jax.jit / @partial(jax.jit, ...) on
-            # a def — the jitted expression is the function's own name.
-            for node in ast.walk(tree):
-                if not isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                for dec in node.decorator_list:
-                    is_call = isinstance(dec, ast.Call)
-                    if is_call and _is_jit_call(dec):
-                        decorator_calls.add(id(dec))
-                        kw = {k.arg for k in dec.keywords}
-                    elif isinstance(dec, ast.Attribute) \
-                            and dec.attr == "jit":
-                        kw = set()
-                    else:
-                        continue
-                    if not TARGET.search(node.name):
-                        continue
-                    if "donate_argnums" in kw:
-                        continue
-                    if _has_rationale(lines, dec.lineno):
-                        continue
-                    failures.append((rel, dec.lineno, node.name))
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and _is_jit_call(node)) \
-                        or id(node) in decorator_calls:
-                    continue
-                expr = _jitted_expr_text(node)
-                if not TARGET.search(expr):
-                    continue
-                kw = {k.arg for k in node.keywords}
-                if "donate_argnums" in kw:
-                    continue
-                if _has_rationale(lines, node.lineno):
-                    continue
-                failures.append((rel, node.lineno, expr.split("\n")[0]))
-    return failures
+from dist_dqn_tpu.analysis.plugins.donation import (RATIONALE,  # noqa: F401,E402
+                                                    SCAN_ROOTS, TARGET,
+                                                    _is_jit_call,
+                                                    _jitted_expr_text,
+                                                    scan)
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    repo_root = Path(__file__).resolve().parent.parent
-    failures = scan(repo_root)
-    if failures:
-        print("check_donation: FAIL", file=sys.stderr)
-        for rel, lineno, expr in failures:
-            print(f"  {rel}:{lineno}: jax.jit({expr!r}) is a train/"
-                  "collect entry point without explicit donate_argnums "
-                  "— donate the carry/state (in-place HBM update) or "
-                  "add a '# donation: <why not>' rationale comment "
-                  "(docs/performance.md, learner utilization)",
-                  file=sys.stderr)
-        return 1
-    print("check_donation: OK (every jitted train/collect entry point "
-          "declares its donation or a rationale)")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("donation", "check_donation")
 
 
 if __name__ == "__main__":

@@ -1,125 +1,28 @@
 #!/usr/bin/env python
-"""Lint: mesh-parallel call sites must resolve through utils/compat.py
-and must name their mesh axis (or carry a rationale comment).
-
-Two rules, both born from the ISSUE 10 scale-out:
-
-1. **No direct ``jax.shard_map`` / ``jax.experimental.shard_map``
-   outside ``dist_dqn_tpu/utils/compat.py``.** JAX moved the API
-   between 0.4.x and 0.5 (and renamed ``check_rep`` to ``check_vma``),
-   and a direct spelling import-errors on the other side — exactly the
-   failure that carried 13 tier-1 tests on the 0.4.37 dev box. The
-   compat resolver is the one place allowed to touch either spelling.
-
-2. **Every ``shard_map``/``pjit`` call site names its axis.** The call
-   text must contain a literal axis (a ``P("dp")``-style spec or an
-   ``axis``/``axis_name`` keyword), or a ``# mesh-axis:`` comment
-   within three lines above stating where the axis lives (e.g. "the
-   specs are built by train_step_specs") — so a reader at the call
-   site can always answer "which leaves live on which axis" without
-   spelunking. docs/architecture.md's scale-out table is the prose
-   twin of this rule.
-
-Run from the repo root: ``python scripts/check_mesh_axis.py``. Wired
-into tier-1 via tests/test_mesh_lint.py, the sibling of
-check_donation.py / check_metrics.py.
+"""Compatibility shim (ISSUE 13): the mesh-axis lint now lives in
+``dist_dqn_tpu/analysis/plugins/mesh_axis.py``, registered with
+``scripts/dqnlint.py`` as the ``mesh-axis`` check. This entry point
+keeps the original verdict contract — ``python scripts/check_mesh_axis.py``
+prints ``check_mesh_axis: OK``/``FAIL`` with the same exit code — and
+re-exports the historical module surface for external references.
 """
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py", "__graft_entry__.py")
-COMPAT_MODULE = "dist_dqn_tpu/utils/compat.py"
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: Direct spellings rule 1 forbids outside the compat module.
-DIRECT = re.compile(
-    r"jax\.shard_map|jax\.experimental\.shard_map|"
-    r"from\s+jax\.experimental\.shard_map\s+import")
-#: What satisfies rule 2 inside the call text.
-AXIS_IN_CALL = re.compile(r"""P\(\s*['"]|axis_name|axis\s*=""")
-#: Rationale escape hatch for spec-variable call sites.
-RATIONALE = re.compile(r"#.*mesh-axis:")
-
-
-def _call_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return ""
-
-
-def _has_rationale(lines, lineno: int) -> bool:
-    lo = max(lineno - 4, 0)
-    return any(RATIONALE.search(ln) for ln in lines[lo:lineno])
-
-
-def scan(repo_root: Path):
-    """[(relpath, lineno, message), ...] for violating sites."""
-    failures = []
-    for root in SCAN_ROOTS:
-        base = repo_root / root
-        files = ([base] if base.is_file()
-                 else sorted(base.rglob("*.py")) if base.is_dir() else [])
-        for f in files:
-            rel = f.relative_to(repo_root).as_posix()
-            src = f.read_text()
-            lines = src.splitlines()
-            if rel != COMPAT_MODULE:
-                for i, ln in enumerate(lines, 1):
-                    if DIRECT.search(ln):
-                        failures.append(
-                            (rel, i,
-                             "direct jax.shard_map spelling — resolve "
-                             "through dist_dqn_tpu.utils.compat."
-                             "shard_map (version-adaptive)"))
-            else:
-                # The resolver itself forwards to whichever spelling
-                # exists; its axis comes from the caller's specs —
-                # rule 2 applies at call sites, not here.
-                continue
-            try:
-                tree = ast.parse(src)
-            except SyntaxError as e:
-                failures.append((rel, e.lineno or 0, "<unparseable>"))
-                continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                if _call_name(node) not in ("shard_map", "pjit"):
-                    continue
-                try:
-                    call_text = ast.get_source_segment(src, node) or ""
-                except Exception:
-                    call_text = ""
-                if AXIS_IN_CALL.search(call_text):
-                    continue
-                if _has_rationale(lines, node.lineno):
-                    continue
-                failures.append(
-                    (rel, node.lineno,
-                     f"{_call_name(node)}(...) names no mesh axis — "
-                     "put a literal axis spec in the call or a "
-                     "'# mesh-axis: <where the specs name it>' comment "
-                     "above it"))
-    return failures
+from dist_dqn_tpu.analysis.plugins.mesh_axis import (AXIS_IN_CALL,  # noqa: F401,E402
+                                                     COMPAT_MODULE,
+                                                     DIRECT, RATIONALE,
+                                                     SCAN_ROOTS, scan)
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    repo_root = Path(__file__).resolve().parent.parent
-    failures = scan(repo_root)
-    if failures:
-        print("check_mesh_axis: FAIL", file=sys.stderr)
-        for rel, lineno, msg in failures:
-            print(f"  {rel}:{lineno}: {msg}", file=sys.stderr)
-        return 1
-    print("check_mesh_axis: OK (shard_map resolves through compat and "
-          "every mesh call site names its axis)")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("mesh-axis", "check_mesh_axis")
 
 
 if __name__ == "__main__":

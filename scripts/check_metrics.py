@@ -1,175 +1,30 @@
 #!/usr/bin/env python
-"""Lint: no NEW JSON-line metric emission bypassing the telemetry registry,
-and no ``dqn_*`` metric family undocumented in docs/observability.md.
-
-ISSUE 1 unified metrics behind ``dist_dqn_tpu/telemetry`` — new code
-should record through the registry (and let MetricLogger / the /metrics
-endpoint do the emitting), not grow more ad-hoc ``print(json.dumps(...))``
-/ ``log_fn(json.dumps(...))`` call sites that scrapers can't see.
-
-The legacy sites that existed when the registry landed are grandfathered
-in the allowlist below (several are load-bearing CLI output contracts —
-bench.py's single contract line, train.py's log rows). The lint fails
-when a file GROWS new call sites or a new file starts emitting directly;
-shrinking is always allowed (update the allowlist in the same PR).
-
-ISSUE 5 added the docs-drift half: every ``dqn_*`` family name that
-appears at a registry registration site (``.counter(/.gauge(/
-.histogram(`` with a literal name) or as a canonical constant in
-``telemetry/collectors.py`` must appear in docs/observability.md, so
-the naming table can no longer silently lag the code. Names that are
-deliberately undocumented live in DOCS_ALLOWLIST with a rationale;
-dynamically composed names (f-strings) are out of scope by
-construction.
-
-Run from the repo root: ``python scripts/check_metrics.py``. Wired into
-tier-1 via tests/test_metrics_lint.py.
+"""Compatibility shim (ISSUE 13): the metric-emission + docs-drift lint
+now lives in ``dist_dqn_tpu/analysis/plugins/metrics.py``, registered
+with ``scripts/dqnlint.py`` as the ``metrics`` check. This entry point
+keeps the original verdict contract — ``python scripts/check_metrics.py``
+prints ``check_metrics: OK``/``FAIL`` with the same exit code — and
+re-exports the historical module surface for external references.
 """
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-PATTERN = re.compile(r"(?:print|log_fn)\(json\.dumps")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: Registry registration with a literal family name. ``\s`` spans
-#: newlines, so multi-line calls are covered.
-REGISTRATION = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*[\"'](dqn_[a-z0-9_]+)[\"']")
-#: Canonical name constants in telemetry/collectors.py (including the
-#: ``NAME = \`` + next-line-string spelling).
-CONSTANT = re.compile(
-    r"^[A-Z0-9_]+\s*=\s*(?:\\\s*)?[\"'](dqn_[a-z0-9_]+)[\"']", re.M)
-
-#: dqn_* families allowed to be absent from docs/observability.md,
-#: each with the reason it stays undocumented.
-DOCS_ALLOWLIST = {
-    # Internal plumbing of the span tracer: a scratch gauge the
-    # MetricLogger uses to mirror counter-style extras; not a scrape
-    # surface anyone should alert on (utils/trace.py).
-    "dqn_trace_counter",
-}
-
-#: file (repo-relative, posix) -> call sites grandfathered at ISSUE 1.
-ALLOWLIST = {
-    "bench.py": 1,
-    "benchmarks/ale_learning.py": 2,
-    "benchmarks/apex_feeder_bench.py": 1,
-    "benchmarks/apex_split_bench.py": 2,
-    "benchmarks/bench_sweep.py": 4,
-    "benchmarks/cli_e2e.py": 3,
-    "benchmarks/host_replay_bench.py": 1,
-    "benchmarks/learner_bench.py": 3,
-    "benchmarks/pong_learning.py": 4,
-    "benchmarks/r2d2_pixel_learning.py": 1,
-    "benchmarks/roofline_inscan.py": 1,
-    "benchmarks/sampler_bench.py": 2,
-    # ISSUE 7: the per-arm BENCH row line (the contract line goes
-    # through bench.ContractEmitter, counted under bench.py) — CLI
-    # output contracts; the serving metrics themselves go through the
-    # registry (dqn_serving_*).
-    "benchmarks/serving_bench.py": 1,
-    "benchmarks/tpu_battery.py": 5,
-    "dist_dqn_tpu/actors/remote.py": 1,
-    # +2 at ISSUE 8: the ingest_degraded alarm transitions (one line
-    # per episode edge, state changes — the continuous signal is the
-    # dqn_ingest_degraded gauge).
-    "dist_dqn_tpu/actors/service.py": 5,
-    # ISSUE 8: the one-per-episode transport shedding alarm (the
-    # per-record stream is dqn_transport_tcp_shed_total).
-    "dist_dqn_tpu/actors/transport.py": 1,
-    "dist_dqn_tpu/atari57.py": 7,
-    # +1 at ISSUE 4: the telemetry_port announcement line (a CLI output
-    # contract like train.py's, not a metric — the metrics themselves go
-    # through the registry the flag exposes).
-    "dist_dqn_tpu/evaluate.py": 2,
-    # +2 at ISSUE 8: the resumed_at_frames and per-save checkpoint
-    # announcement lines (run-lifecycle output contracts, mirroring
-    # train.py's resume line; the chaos/crash metrics go through the
-    # registry).
-    "dist_dqn_tpu/host_replay_loop.py": 3,
-    # ISSUE 7: the serving CLI's startup announcements (serving_port +
-    # optional telemetry_port) — output contracts like train.py's; act
-    # metrics go through the registry. +1 at ISSUE 8: the shutdown
-    # serving_drained line (graceful-drain outcome contract).
-    "dist_dqn_tpu/serving/__main__.py": 3,
-    # +1 at ISSUE 4: the one-per-run {"manifest": ...} provenance line
-    # (telemetry/manifest.py) — run identity, not a metric stream.
-    "dist_dqn_tpu/train.py": 11,
-    "dist_dqn_tpu/utils/metrics.py": 1,  # MetricLogger.flush itself
-}
-
-SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py", "__graft_entry__.py")
-
-
-def scan(repo_root: Path):
-    counts = {}
-    for root in SCAN_ROOTS:
-        path = repo_root / root
-        files = ([path] if path.is_file()
-                 else sorted(path.rglob("*.py")) if path.is_dir() else [])
-        for f in files:
-            rel = f.relative_to(repo_root).as_posix()
-            if rel.startswith("dist_dqn_tpu/telemetry/"):
-                continue  # the registry itself is the sanctioned emitter
-            n = len(PATTERN.findall(f.read_text()))
-            if n:
-                counts[rel] = n
-    return counts
-
-
-def scan_metric_names(repo_root: Path):
-    """Every dqn_* family name the package registers or canonicalizes."""
-    names = set()
-    pkg = repo_root / "dist_dqn_tpu"
-    for f in sorted(pkg.rglob("*.py")):
-        names.update(REGISTRATION.findall(f.read_text()))
-    names.update(CONSTANT.findall(
-        (pkg / "telemetry" / "collectors.py").read_text()))
-    return names
-
-
-def check_docs(repo_root: Path):
-    """Names registered in code but absent from docs/observability.md
-    (minus the rationale'd allowlist). Whole-name match: a family that
-    is merely a prefix of a documented longer name (dqn_foo vs
-    dqn_foo_seconds) still counts as undocumented."""
-    doc = (repo_root / "docs" / "observability.md").read_text()
-    return sorted(
-        n for n in scan_metric_names(repo_root)
-        if not re.search(rf"{re.escape(n)}(?![a-z0-9_])", doc)
-        and n not in DOCS_ALLOWLIST)
+from dist_dqn_tpu.analysis.plugins.metrics import (ALLOWLIST,  # noqa: F401,E402
+                                                   CONSTANT,
+                                                   DOCS_ALLOWLIST,
+                                                   PATTERN, REGISTRATION,
+                                                   SCAN_ROOTS, check_docs,
+                                                   scan, scan_metric_names)
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    repo_root = Path(__file__).resolve().parent.parent
-    counts = scan(repo_root)
-    failures = []
-    for rel, n in sorted(counts.items()):
-        allowed = ALLOWLIST.get(rel, 0)
-        if n > allowed:
-            failures.append(
-                f"{rel}: {n} direct JSON-metric emission call sites "
-                f"(allowlist: {allowed}). New metrics must go through "
-                f"dist_dqn_tpu/telemetry (registry counters/gauges/"
-                f"histograms); see docs/observability.md.")
-    undocumented = check_docs(repo_root)
-    for name in undocumented:
-        failures.append(
-            f"{name}: registered in dist_dqn_tpu/ but missing from the "
-            f"docs/observability.md naming table. Document the family "
-            f"(or add it to DOCS_ALLOWLIST with a rationale).")
-    if failures:
-        print("check_metrics: FAIL", file=sys.stderr)
-        for f in failures:
-            print("  " + f, file=sys.stderr)
-        return 1
-    print(f"check_metrics: OK ({sum(counts.values())} grandfathered "
-          f"call sites in {len(counts)} files; "
-          f"{len(scan_metric_names(repo_root))} dqn_* families "
-          f"documented)")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("metrics", "check_metrics")
 
 
 if __name__ == "__main__":

@@ -1,91 +1,29 @@
 #!/usr/bin/env python
-"""Lint: every socket acquisition site in dist_dqn_tpu/ must bound its
-blocking behavior — set a timeout nearby or carry a rationale comment.
-
-ISSUE 8: the chaos harness's whole disconnect/partition fault class
-turns into a silent process wedge the moment one socket blocks forever
-(the round-1 tunnel incident was exactly an unbounded wait nobody knew
-existed). This lint makes the policy mechanical: wherever a socket is
-CREATED or ACCEPTED (``socket.socket(``, ``socket.create_connection(``,
-``.accept()``), one of the following must hold within
-``CONTEXT_LINES`` lines of the call:
-
-  * a ``settimeout(`` / ``timeout=`` appears (the socket is bounded), or
-  * a ``# socket:`` rationale comment explains why unbounded blocking
-    is safe here (e.g. a daemon thread whose close() path shuts the fd
-    down out from under it).
-
-Stdlib ``http.server``/``socketserver`` internals are out of scope —
-the lint covers this repo's own call sites: every package under
-``dist_dqn_tpu/`` including the zero-copy ingest subsystem
-(``dist_dqn_tpu/ingest/``, ISSUE 9 — its shm slot ring is socket-free
-by design, and this lint is what keeps a future wire helper there
-honest). REQUIRED_SUBPACKAGES makes the coverage explicit: the lint
-FAILS if a listed tree goes missing rather than silently scanning
-nothing. Run from the repo root: ``python scripts/check_sockets.py``.
-Wired into tier-1 via tests/test_sockets_lint.py.
+"""Compatibility shim (ISSUE 13): the socket-hygiene lint now lives in
+``dist_dqn_tpu/analysis/plugins/sockets.py``, registered with
+``scripts/dqnlint.py`` as the ``sockets`` check. This entry point keeps
+the original verdict contract — ``python scripts/check_sockets.py``
+prints ``check_sockets: OK``/``FAIL`` with the same exit code — and
+re-exports the historical module surface for external references.
 """
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-#: How far (in lines, both directions) evidence may sit from the call.
-CONTEXT_LINES = 6
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-ACQUIRE = re.compile(
-    r"socket\.socket\(|socket\.create_connection\(|\.accept\(\)")
-EVIDENCE = re.compile(r"settimeout\(|timeout\s*=|#\s*socket:")
-
-
-#: Subtrees the scan must actually see (guards against a refactor
-#: moving socket code out from under the rglob): the transport-bearing
-#: packages today.
-REQUIRED_SUBPACKAGES = ("actors", "ingest", "serving", "telemetry")
-
-
-def scan(repo_root: Path):
-    failures = []
-    pkg = repo_root / "dist_dqn_tpu"
-    # Coverage guard only for the real repo (the lint tests scan
-    # synthetic single-file trees, which legitimately lack subpackages).
-    if (repo_root / "scripts" / "check_sockets.py").exists():
-        for sub in REQUIRED_SUBPACKAGES:
-            if pkg.is_dir() and not (pkg / sub).is_dir():
-                failures.append(
-                    f"dist_dqn_tpu/{sub}/: expected subpackage missing "
-                    f"— update REQUIRED_SUBPACKAGES if it moved")
-    for f in sorted(pkg.rglob("*.py")):
-        lines = f.read_text().splitlines()
-        for i, line in enumerate(lines):
-            if not ACQUIRE.search(line):
-                continue
-            lo = max(0, i - CONTEXT_LINES)
-            hi = min(len(lines), i + CONTEXT_LINES + 1)
-            window = "\n".join(lines[lo:hi])
-            if not EVIDENCE.search(window):
-                rel = f.relative_to(repo_root).as_posix()
-                failures.append(
-                    f"{rel}:{i + 1}: socket acquired without a nearby "
-                    f"timeout or '# socket:' rationale comment: "
-                    f"{line.strip()}")
-    return failures
+from dist_dqn_tpu.analysis.plugins.sockets import (ACQUIRE,  # noqa: F401,E402
+                                                   CONTEXT_LINES,
+                                                   EVIDENCE,
+                                                   REQUIRED_SUBPACKAGES,
+                                                   scan)
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    repo_root = Path(__file__).resolve().parent.parent
-    failures = scan(repo_root)
-    if failures:
-        print("check_sockets: FAIL", file=sys.stderr)
-        for f in failures:
-            print("  " + f, file=sys.stderr)
-        print("  Bound the socket (settimeout) or add a '# socket: "
-              "<why unbounded blocking is safe>' comment within "
-              f"{CONTEXT_LINES} lines.", file=sys.stderr)
-        return 1
-    print("check_sockets: OK")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("sockets", "check_sockets")
 
 
 if __name__ == "__main__":

@@ -1,83 +1,27 @@
 #!/usr/bin/env python
-"""Lint: every ``threading.Thread(...)`` in ``dist_dqn_tpu/`` must pass
-explicit ``name=`` AND ``daemon=``.
-
-ISSUE 4 added all-thread stack dumps to the forensics bundles and
-``/debug/stacks`` (telemetry/watchdog.py ``format_stacks``): the stacks
-are labeled by THREAD NAME, so an unnamed thread prints as ``Thread-7``
-and the one dump you get from a wedged production run points nowhere.
-Explicit ``daemon=`` is required for the same post-mortem reason — shut
-down behavior must be a decision visible at the call site, not an
-inherited default someone has to go look up.
-
-AST-based (no regex false positives on comments/strings): flags any
-``threading.Thread(...)`` or bare ``Thread(...)`` call whose keywords do
-not include both ``name`` and ``daemon``. ``threading.Timer`` is out of
-scope — its constructor takes neither.
-
-Run from the repo root: ``python scripts/check_threads.py``. Wired into
-tier-1 via tests/test_threads_lint.py (the sibling of the metric-
-emission lint, scripts/check_metrics.py).
+"""Compatibility shim (ISSUE 13): the thread-hygiene lint now lives in
+``dist_dqn_tpu/analysis/plugins/threads.py``, registered with
+``scripts/dqnlint.py`` as the ``threads`` check. This entry point keeps
+the original verdict contract — ``python scripts/check_threads.py``
+prints ``check_threads: OK``/``FAIL`` with the same exit code — and
+re-exports the historical module surface for external references.
 """
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-SCAN_ROOTS = ("dist_dqn_tpu",)
-REQUIRED_KEYWORDS = ("name", "daemon")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-
-def _is_thread_call(func: ast.expr) -> bool:
-    if isinstance(func, ast.Attribute) and func.attr == "Thread":
-        return isinstance(func.value, ast.Name) \
-            and func.value.id == "threading"
-    # ``from threading import Thread`` style — not current repo idiom,
-    # but the lint must bite if it appears.
-    return isinstance(func, ast.Name) and func.id == "Thread"
-
-
-def scan(repo_root: Path):
-    """[(relpath, lineno, missing keywords), ...] for violating sites."""
-    failures = []
-    for root in SCAN_ROOTS:
-        base = repo_root / root
-        files = ([base] if base.is_file()
-                 else sorted(base.rglob("*.py")) if base.is_dir() else [])
-        for f in files:
-            rel = f.relative_to(repo_root).as_posix()
-            try:
-                tree = ast.parse(f.read_text())
-            except SyntaxError as e:
-                failures.append((rel, e.lineno or 0, ["<unparseable>"]))
-                continue
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and _is_thread_call(node.func)):
-                    continue
-                kw = {k.arg for k in node.keywords}
-                missing = [r for r in REQUIRED_KEYWORDS if r not in kw]
-                if missing:
-                    failures.append((rel, node.lineno, missing))
-    return failures
+from dist_dqn_tpu.analysis.plugins.threads import (REQUIRED_KEYWORDS,  # noqa: F401,E402
+                                                   SCAN_ROOTS,
+                                                   _is_thread_call, scan)
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    repo_root = Path(__file__).resolve().parent.parent
-    failures = scan(repo_root)
-    if failures:
-        print("check_threads: FAIL", file=sys.stderr)
-        for rel, lineno, missing in failures:
-            wanted = ", ".join(f"{m}=" for m in missing)
-            print(f"  {rel}:{lineno}: threading.Thread(...) without "
-                  f"explicit {wanted} — unnamed/implicit threads make "
-                  "forensics stack dumps unreadable "
-                  "(docs/observability.md)", file=sys.stderr)
-        return 1
-    print("check_threads: OK (every Thread call site names itself and "
-          "declares daemon-ness)")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("threads", "check_threads")
 
 
 if __name__ == "__main__":

@@ -1,91 +1,25 @@
 #!/usr/bin/env python
-"""Lint: the zero-copy wire format is pinned to its protocol version.
-
-ISSUE 9: before the explicit version field existed, a codec change
-surfaced as CRC/desync noise mid-stream. The version handshake makes a
-mismatch fail at connect — but only if every header change actually
-BUMPS the constant. This lint makes that mechanical:
-
-  * it fingerprints the frame-header layout (``WIRE_HEADER_FIELDS`` —
-    names + struct formats), the record-kind registry and the flag
-    registry of ``dist_dqn_tpu/ingest/codec.py``;
-  * the digest must equal ``WIRE_HISTORY[PROTOCOL_VERSION]``;
-  * history is append-only: every version maps to a distinct digest.
-
-So editing any frame-header field without adding a NEW
-``(PROTOCOL_VERSION, digest)`` pair — i.e. without bumping the
-version — fails CI with the expected digest printed. Run from the repo
-root: ``python scripts/check_wire.py``. Wired into tier-1 via
-tests/test_wire_lint.py.
+"""Compatibility shim (ISSUE 13): the wire-format lint now lives in
+``dist_dqn_tpu/analysis/plugins/wire.py``, registered with
+``scripts/dqnlint.py`` as the ``wire`` check. This entry point keeps
+the original verdict contract — ``python scripts/check_wire.py`` prints
+``check_wire: OK``/``FAIL`` with the same exit code — and re-exports
+the historical module surface for external references.
 """
 from __future__ import annotations
 
-import hashlib
-import json
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-
-def wire_digest() -> str:
-    """Canonical fingerprint of everything a peer must agree on to
-    parse a frame header."""
-    from dist_dqn_tpu.ingest import codec
-
-    spec = {
-        "struct": codec._HDR.format,
-        "fields": [list(f) for f in codec.WIRE_HEADER_FIELDS],
-        "kinds": dict(codec.WIRE_KINDS),
-        "flags": dict(codec.WIRE_FLAGS),
-    }
-    return hashlib.sha256(
-        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
-
-
-def check() -> list:
-    from dist_dqn_tpu.ingest import codec
-    from dist_dqn_tpu.ingest.schema import PROTOCOL_VERSION
-
-    failures = []
-    digest = wire_digest()
-    if PROTOCOL_VERSION not in codec.WIRE_HISTORY:
-        failures.append(
-            f"PROTOCOL_VERSION {PROTOCOL_VERSION} has no WIRE_HISTORY "
-            f"entry — record it as {PROTOCOL_VERSION}: \"{digest}\"")
-    elif codec.WIRE_HISTORY[PROTOCOL_VERSION] != digest:
-        failures.append(
-            f"wire-format fingerprint {digest} does not match "
-            f"WIRE_HISTORY[{PROTOCOL_VERSION}] = "
-            f"{codec.WIRE_HISTORY[PROTOCOL_VERSION]!r}: the frame "
-            f"header changed — bump PROTOCOL_VERSION "
-            f"(dist_dqn_tpu/ingest/schema.py) and append the new "
-            f"(version, digest) pair to WIRE_HISTORY; peers then fail "
-            f"loudly at connect instead of desyncing mid-stream")
-    if codec.WIRE_HISTORY and max(codec.WIRE_HISTORY) != PROTOCOL_VERSION:
-        failures.append(
-            f"WIRE_HISTORY records version {max(codec.WIRE_HISTORY)} "
-            f"but PROTOCOL_VERSION is {PROTOCOL_VERSION} — history is "
-            f"append-only and the constant must lead it")
-    digests = list(codec.WIRE_HISTORY.values())
-    if len(set(digests)) != len(digests):
-        failures.append(
-            "WIRE_HISTORY maps two versions to the same digest — a "
-            "version bump without a wire change (or a rewritten entry)")
-    return failures
+from dist_dqn_tpu.analysis.plugins.wire import check, wire_digest  # noqa: F401,E402
+from dist_dqn_tpu.analysis.runner import legacy_main  # noqa: E402
 
 
 def main() -> int:
-    failures = check()
-    if failures:
-        print("check_wire: FAIL", file=sys.stderr)
-        for f in failures:
-            print("  " + f, file=sys.stderr)
-        return 1
-    print(f"check_wire: OK (protocol "
-          f"{__import__('dist_dqn_tpu.ingest.schema', fromlist=['x']).PROTOCOL_VERSION}, "
-          f"digest {wire_digest()})")
-    return 0
+    """The historical module-level entry point."""
+    return legacy_main("wire", "check_wire")
 
 
 if __name__ == "__main__":

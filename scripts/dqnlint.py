@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""dqnlint: the one runner for every static check (ISSUE 13).
+
+Replaces seven disconnected ``scripts/check_*.py`` invocations with one
+in-process run over a shared file/AST cache::
+
+    python scripts/dqnlint.py --all              # human report
+    python scripts/dqnlint.py --all --json       # CI findings artifact
+    python scripts/dqnlint.py --check threads --check lock-discipline
+    python scripts/dqnlint.py --list             # registered checks
+
+Exit code 0 iff no unsuppressed findings (baselined findings and their
+reasons are reported, never silently dropped; a STALE baseline entry is
+itself a failure). Suppression surfaces, in triage order: fix the code;
+own it with the check's rationale comment at the site (``# lock:`` /
+``# donation:`` / ``# socket:`` / ``# mesh-axis:``); or add a reasoned
+entry to scripts/dqnlint_baseline.json. Catalog + plugin how-to:
+docs/static_analysis.md. The legacy ``scripts/check_*.py`` entry points
+remain as shims with their original verdicts.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    from dist_dqn_tpu.analysis import (BaselineError, check_names,
+                                       get_checks, render_json,
+                                       render_text, run_checks)
+
+    parser = argparse.ArgumentParser(
+        prog="dqnlint", description="unified static analysis runner")
+    parser.add_argument("--all", action="store_true",
+                        help="run every registered check (default when "
+                             "no --check is given)")
+    parser.add_argument("--check", action="append", default=[],
+                        metavar="NAME",
+                        help="run one named check (repeatable)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable findings "
+                             "artifact on stdout instead of the human "
+                             "report")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered checks and exit")
+    parser.add_argument("--baseline", metavar="PATH", default=None,
+                        help="baseline file (default: "
+                             "scripts/dqnlint_baseline.json)")
+    parser.add_argument("--root", metavar="DIR", default=str(REPO),
+                        help="repo root to analyze (default: this repo)")
+    parser.add_argument("--verbose", action="store_true",
+                        help="also report baselined findings with their "
+                             "reasons")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for check in get_checks():
+            tag = f"  [suppress: '# {check.rationale_tag} <reason>']" \
+                if check.rationale_tag else ""
+            print(f"{check.name}: {check.description}{tag}")
+        return 0
+
+    names = args.check or None
+    if args.all:
+        names = None
+    try:
+        results = run_checks(
+            Path(args.root), names=names,
+            baseline_path=Path(args.baseline) if args.baseline else None)
+    except BaselineError as e:
+        print(f"dqnlint: invalid baseline — {e}", file=sys.stderr)
+        return 2
+    except KeyError as e:
+        print(f"dqnlint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    ok = all(r.ok for r in results)
+    if args.json:
+        print(json.dumps(render_json(results), indent=1, sort_keys=True))
+    else:
+        out = render_text(results, verbose=args.verbose)
+        print(out, file=sys.stdout if ok else sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,10 @@
-"""Tier-1 wiring for the checkpoint-sidecar schema lint
-(scripts/check_ckpt_schema.py): every sidecar field change must bump
-SIDECAR_VERSION and record its fingerprint in SIDECAR_HISTORY — so
-resume-format drift fails CI (and then fails loudly at restore via the
-sidecar's version stamp) instead of surfacing as a silently-wrong
-resume at 3am (ISSUE 12 satellite)."""
+"""Thin compatibility shim (ISSUE 13, one release): the
+checkpoint-sidecar schema lint migrated into
+``dist_dqn_tpu/analysis/plugins/ckpt_schema.py`` and its bite tests
+into tests/test_dqnlint.py (the validator/digest property tests stayed
+here — they pin utils/ckpt_schema.py itself, not the lint wiring).
+This file keeps the historical test names + the legacy entry point's
+verdict pinned so external references don't break."""
 import subprocess
 import sys
 from pathlib import Path
@@ -13,47 +14,11 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _load_lint():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "check_ckpt_schema", REPO / "scripts" / "check_ckpt_schema.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def test_sidecar_schema_pinned():
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "check_ckpt_schema.py")],
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr or proc.stdout
-
-
-def test_lint_catches_schema_drift(monkeypatch):
-    """The lint must bite: a field change (simulated by perturbing the
-    recorded digest — equivalent to editing SIDECAR_SCALAR_FIELDS
-    without re-recording) fails with the bump instruction."""
-    mod = _load_lint()
-    from dist_dqn_tpu.utils import ckpt_schema as cs
-
-    monkeypatch.setattr(cs, "SIDECAR_HISTORY",
-                        {v: "0" * 16 for v in cs.SIDECAR_HISTORY})
-    failures = mod.check()
-    assert failures, "drifted digest must fail"
-    assert any("bump SIDECAR_VERSION" in f for f in failures)
-
-
-def test_lint_catches_missing_version_entry(monkeypatch):
-    mod = _load_lint()
-    from dist_dqn_tpu.utils import ckpt_schema as cs
-
-    monkeypatch.setattr(
-        cs, "SIDECAR_HISTORY",
-        {v: d for v, d in cs.SIDECAR_HISTORY.items()
-         if v != cs.SIDECAR_VERSION})
-    failures = mod.check()
-    assert any("no SIDECAR_HISTORY entry" in f for f in failures)
 
 
 def test_digest_covers_every_field_class():
@@ -70,6 +35,7 @@ def test_digest_covers_every_field_class():
             assert cs.sidecar_digest() != base, attr
         finally:
             setattr(cs, attr, saved)
+    assert cs.sidecar_digest() == base
 
 
 def test_validator_bites_on_unknown_and_missing_fields():

@@ -1,11 +1,8 @@
-"""Tier-1 wiring for the buffer-donation lint (scripts/check_donation
-.py, ISSUE 6): every jitted train/collect entry point in the package,
-the benchmarks and bench.py must declare explicit ``donate_argnums`` or
-a ``donation:`` rationale comment. The runtime aliasing audit
-(utils/donation.py, exercised by tests/test_replay_ratio.py) proves the
-existing chunk programs donate completely; this static half stops the
-next entry point from silently dropping it.
-"""
+"""Thin compatibility shim (ISSUE 13, one release): the buffer-donation
+lint migrated into ``dist_dqn_tpu/analysis/plugins/donation.py`` and
+its bite tests into tests/test_dqnlint.py. This file keeps the
+historical test name + the legacy entry point's verdict pinned so
+external references don't break."""
 import subprocess
 import sys
 from pathlib import Path
@@ -13,79 +10,8 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _load_lint():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "check_donation", REPO / "scripts" / "check_donation.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def test_every_train_entry_point_donates():
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "check_donation.py")],
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr or proc.stdout
-
-
-def test_lint_recognizes_the_real_entry_points():
-    """The OK verdict must come from coverage, not blindness: the scan
-    has to see the known jitted train/collect sites (train.py's chunk
-    runner, host_replay's collect + train, the service's train step)."""
-    import ast
-
-    mod = _load_lint()
-    seen = set()
-    for root in mod.SCAN_ROOTS:
-        base = REPO / root
-        files = ([base] if base.is_file() else sorted(base.rglob("*.py")))
-        for f in files:
-            try:
-                tree = ast.parse(f.read_text())
-            except SyntaxError:
-                continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call) and mod._is_jit_call(node) \
-                        and mod.TARGET.search(mod._jitted_expr_text(node)):
-                    seen.add(f.relative_to(REPO).as_posix())
-    for expected in ("dist_dqn_tpu/train.py",
-                     "dist_dqn_tpu/host_replay_loop.py",
-                     "dist_dqn_tpu/actors/service.py",
-                     "benchmarks/learner_bench.py", "bench.py"):
-        assert expected in seen, (expected, sorted(seen))
-
-
-def test_lint_catches_a_donationless_train_jit(tmp_path):
-    """The lint must bite: a synthetic jitted train step with no
-    donate_argnums and no rationale fails; adding either passes."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "rogue.py").write_text(
-        "import jax\n"
-        "train_step = lambda s, b: s\n"
-        "bad = jax.jit(train_step)\n"
-        "good = jax.jit(train_step, donate_argnums=0)\n"
-        "# donation: nothing donatable, state is reused by the caller\n"
-        "excused = jax.jit(train_step)\n"
-        "act = jax.jit(lambda p, o: o)\n")
-    failures = mod.scan(tmp_path)
-    assert [(rel, line) for rel, line, _ in failures] == [
-        ("dist_dqn_tpu/rogue.py", 3)]
-
-
-def test_lint_covers_partial_jit_spelling(tmp_path):
-    """``partial(jax.jit, ...)`` decorators must not dodge the lint."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "rogue.py").write_text(
-        "import jax\n"
-        "from functools import partial\n"
-        "@partial(jax.jit)\n"
-        "def run_chunk_train(c):\n"
-        "    return c\n")
-    failures = mod.scan(tmp_path)
-    assert len(failures) == 1 and failures[0][0] == "dist_dqn_tpu/rogue.py"

@@ -1,6 +1,8 @@
-"""Tier-1 wiring for the metric-emission lint (scripts/check_metrics.py):
-new code must record through the telemetry registry, not grow ad-hoc
-``print(json.dumps(...))`` metric call sites."""
+"""Thin compatibility shim (ISSUE 13, one release): the metric-emission
+lint migrated into ``dist_dqn_tpu/analysis/plugins/metrics.py`` and its
+bite tests into tests/test_dqnlint.py. This file keeps the historical
+test name + the legacy entry point's verdict pinned so external
+references (CI configs, docs) don't break."""
 import subprocess
 import sys
 from pathlib import Path
@@ -11,72 +13,5 @@ REPO = Path(__file__).resolve().parent.parent
 def test_no_new_direct_metric_emission():
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "check_metrics.py")],
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr or proc.stdout
-
-
-def _load_lint():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "check_metrics", REPO / "scripts" / "check_metrics.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_lint_catches_a_new_call_site(tmp_path):
-    """The lint must actually bite: a synthetic tree with an unlisted
-    emission site fails."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "rogue.py").write_text("print(json.dumps({'m': 1}))\n")
-    counts = mod.scan(tmp_path)
-    assert counts == {"dist_dqn_tpu/rogue.py": 1}
-    assert counts["dist_dqn_tpu/rogue.py"] > mod.ALLOWLIST.get(
-        "dist_dqn_tpu/rogue.py", 0)
-
-
-def test_docs_drift_check_catches_undocumented_family(tmp_path):
-    """ISSUE 5 satellite: a dqn_* family registered in code but absent
-    from docs/observability.md must fail the lint — including the
-    multi-line constant spelling collectors.py uses."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    tele = pkg / "telemetry"
-    tele.mkdir(parents=True)
-    (tele / "collectors.py").write_text(
-        'DOCUMENTED = "dqn_documented_total"\n'
-        'WRAPPED = \\\n    "dqn_wrapped_but_undocumented_total"\n')
-    (pkg / "loopy.py").write_text(
-        'c = reg.counter(\n    "dqn_registered_elsewhere_total",\n'
-        '    "help text")\n'
-        'g = reg.gauge("dqn_documented", "a PREFIX of the doc name")\n')
-    docs = tmp_path / "docs"
-    docs.mkdir()
-    (docs / "observability.md").write_text(
-        "only `dqn_documented_total` is in the table\n")
-    names = mod.scan_metric_names(tmp_path)
-    assert names == {"dqn_documented", "dqn_documented_total",
-                     "dqn_wrapped_but_undocumented_total",
-                     "dqn_registered_elsewhere_total"}
-    # dqn_documented is a substring of the documented dqn_documented_
-    # total but is NOT itself documented — whole-name matching must
-    # still flag it.
-    missing = mod.check_docs(tmp_path)
-    assert missing == ["dqn_documented",
-                       "dqn_registered_elsewhere_total",
-                       "dqn_wrapped_but_undocumented_total"]
-
-
-def test_docs_allowlist_entries_are_real():
-    """Every DOCS_ALLOWLIST entry must still be registered somewhere —
-    a stale entry means the family was removed or documented and the
-    allowlist should shrink."""
-    mod = _load_lint()
-    names = mod.scan_metric_names(REPO)
-    for allowed in mod.DOCS_ALLOWLIST:
-        assert allowed in names, (
-            f"{allowed} is allowlisted but no longer registered — "
-            "drop it from DOCS_ALLOWLIST")

@@ -483,3 +483,59 @@ def test_serving_bench_ab_smoke(tmp_path):
     contract = [r for r in rows if r.get("metric") == "serving_acts_per_sec"]
     assert contract and "speedup_vs_serial" in contract[0]
     assert contract[0]["telemetry"], "contract row must embed telemetry"
+
+
+def test_model_store_start_vs_registration_race():
+    """Regression for the ISSUE 13 lock-discipline race fix:
+    ModelStore.start() iterated the LIVE _entries dict outside the
+    store lock while add_policy mutates it under the lock from whatever
+    thread registers late tenants — "dictionary changed size during
+    iteration" on a startup path (reproduced ~1/3 of trials pre-fix
+    with this exact harness). Fixed by snapshotting under the lock, the
+    same copy-then-walk poll_once always used."""
+    from dist_dqn_tpu.serving.model_store import ModelStore
+
+    class _Gauge:
+        def set(self, v):
+            pass
+
+    class _Reg:
+        def gauge(self, *a, **k):
+            return _Gauge()
+
+        counter = gauge
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        for _trial in range(30):
+            store = ModelStore(example_params=None,
+                               poll_interval_s=60.0, log_fn=None)
+            store._reg = _Reg()
+            store._tm_version.clear()
+            for i in range(3000):
+                store._entries[str(i)] = SimpleNamespace(
+                    policy_id=str(i),
+                    snapshot=SimpleNamespace(version=1))
+            stop = threading.Event()
+
+            def register_late(store=store, stop=stop):
+                i = 3000
+                while not stop.is_set():
+                    with store._lock:   # what add_policy does
+                        store._entries[str(i)] = SimpleNamespace(
+                            policy_id=str(i), snapshot=None)
+                    i += 1
+
+            t = threading.Thread(target=register_late,
+                                 name="late-registrar", daemon=True)
+            t.start()
+            try:
+                store.start()   # pre-fix: RuntimeError (dict mutated)
+            finally:
+                stop.set()
+                t.join()
+                store._entries.clear()   # skip 3000+ ckpt.close calls
+                store.close()
+    finally:
+        sys.setswitchinterval(old_interval)

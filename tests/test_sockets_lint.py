@@ -1,10 +1,8 @@
-"""Tier-1 wiring for the socket-hygiene lint (scripts/check_sockets.py):
-every socket acquisition site in ``dist_dqn_tpu/`` must bound its
-blocking behavior (a ``settimeout``/``timeout=`` nearby) or carry a
-``# socket:`` rationale comment. ISSUE 8: the chaos harness's whole
-disconnect/partition fault class turns into a silent process wedge the
-moment one socket blocks forever.
-"""
+"""Thin compatibility shim (ISSUE 13, one release): the socket-hygiene
+lint migrated into ``dist_dqn_tpu/analysis/plugins/sockets.py`` and its
+bite tests into tests/test_dqnlint.py. This file keeps the historical
+test name + the legacy entry point's verdict pinned so external
+references don't break."""
 import subprocess
 import sys
 from pathlib import Path
@@ -12,49 +10,8 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _load_lint():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "check_sockets", REPO / "scripts" / "check_sockets.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def test_no_unbounded_sockets():
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "check_sockets.py")],
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr or proc.stdout
-
-
-def test_lint_catches_an_unbounded_socket(tmp_path):
-    """The lint must actually bite: a synthetic tree with a bare
-    ``socket.socket()`` and no timeout/rationale within the context
-    window fails, naming the site."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "rogue.py").write_text(
-        "import socket\n"
-        + "\n" * (mod.CONTEXT_LINES + 1)       # push evidence-free gap
-        + "s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)\n"
-        + "\n" * (mod.CONTEXT_LINES + 1)
-        + "c = socket.create_connection(('h', 1), timeout=2.0)\n"  # ok
-        + "conn, _ = s.accept()  # socket: close() shuts the fd down\n")
-    failures = mod.scan(tmp_path)
-    assert len(failures) == 1
-    assert "rogue.py" in failures[0]
-    assert "socket.socket(" in failures[0]
-
-
-def test_lint_accepts_nearby_evidence(tmp_path):
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "fine.py").write_text(
-        "import socket\n"
-        "s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)\n"
-        "s.settimeout(0.2)\n")
-    assert mod.scan(tmp_path) == []

@@ -1,9 +1,8 @@
-"""Tier-1 wiring for the thread-hygiene lint (scripts/check_threads.py):
-every ``threading.Thread(...)`` call site in ``dist_dqn_tpu/`` must pass
-explicit ``name=`` and ``daemon=`` — the forensics stack dumps (ISSUE 4,
-telemetry/watchdog.py) label stacks by thread name, and an anonymous
-``Thread-7`` frame in the one dump a wedged run produces points nowhere.
-"""
+"""Thin compatibility shim (ISSUE 13, one release): the thread-hygiene
+lint migrated into ``dist_dqn_tpu/analysis/plugins/threads.py`` and its
+bite tests into tests/test_dqnlint.py. This file keeps the historical
+test name + the legacy entry point's verdict pinned so external
+references don't break."""
 import subprocess
 import sys
 from pathlib import Path
@@ -11,49 +10,8 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _load_lint():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "check_threads", REPO / "scripts" / "check_threads.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def test_no_anonymous_threads():
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "check_threads.py")],
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr or proc.stdout
-
-
-def test_lint_catches_an_anonymous_thread(tmp_path):
-    """The lint must actually bite: a synthetic tree with an unnamed /
-    non-daemon-declared Thread call site fails, naming the missing
-    keywords."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "rogue.py").write_text(
-        "import threading\n"
-        "t = threading.Thread(target=print, daemon=True)\n"     # no name
-        "u = threading.Thread(target=print, name='ok')\n"       # no daemon
-        "v = threading.Thread(target=print, name='ok', daemon=True)\n")
-    failures = mod.scan(tmp_path)
-    assert [(rel, line, missing) for rel, line, missing in failures] == [
-        ("dist_dqn_tpu/rogue.py", 2, ["name"]),
-        ("dist_dqn_tpu/rogue.py", 3, ["daemon"]),
-    ]
-
-
-def test_lint_catches_bare_thread_import(tmp_path):
-    """``from threading import Thread`` must not dodge the lint."""
-    mod = _load_lint()
-    pkg = tmp_path / "dist_dqn_tpu"
-    pkg.mkdir()
-    (pkg / "rogue.py").write_text(
-        "from threading import Thread\n"
-        "t = Thread(target=print)\n")
-    failures = mod.scan(tmp_path)
-    assert failures == [("dist_dqn_tpu/rogue.py", 2, ["name", "daemon"])]

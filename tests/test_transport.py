@@ -207,3 +207,50 @@ def test_tcp_record_transport():
         client.close()
     finally:
         server.close()
+
+
+def test_shed_bookkeeping_is_threadsafe(capsys):
+    """Regression for the ISSUE 13 lock-discipline race fix: _shed runs
+    on every serve thread whose backpressure wait expired at once, and
+    the unlocked read-then-set of _shed_alarmed let concurrent shedders
+    each see False and emit duplicate "once per episode" alarms (while
+    the unlocked += lost shed_records increments). Under the lock the
+    invariants are exact: N concurrent sheds -> N counted records, ONE
+    alarm line per episode."""
+    import json as _json
+    import sys as _sys
+    import threading
+
+    server = TcpRecordServer()
+    n_threads = 16
+    old_interval = _sys.getswitchinterval()
+    _sys.setswitchinterval(1e-6)  # make the lost-update window huge
+    try:
+        start = threading.Barrier(n_threads)
+
+        def shed():
+            start.wait()
+            for _ in range(50):
+                server._shed(0)
+
+        workers = [threading.Thread(target=shed, name=f"shed-{i}",
+                                    daemon=True)
+                   for i in range(n_threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+    finally:
+        _sys.setswitchinterval(old_interval)
+        server.close()
+    assert server.shed_records == n_threads * 50
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if "transport_shedding" in ln]
+    assert len(lines) == 1, lines
+    assert _json.loads(lines[0])["transport_shedding"] is True
+    # A successful append resets the episode under the lock; the NEXT
+    # shed alarms again (one alarm PER EPISODE, not one per process).
+    with server._lock:
+        server._shed_alarmed = False
+    server._shed(0)
+    assert "transport_shedding" in capsys.readouterr().out
