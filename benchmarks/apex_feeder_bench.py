@@ -132,7 +132,8 @@ def _emit(row: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Transport A/B (ISSUE 9): legacy JSON codec vs zero-copy wire vs shm ring
+# Transport A/B (ISSUE 9 + 14): legacy JSON codec vs zero-copy wire vs
+# shm ring vs frame-dedup plane vs batched slot publishes
 # ---------------------------------------------------------------------------
 
 #: (obs_shape, obs_dtype, default A/B record count) per variant. Pixel
@@ -142,29 +143,98 @@ _AB_SPECS = {
     "vector": ((4,), "float32", 4000),
 }
 
+#: records coalesced per slot publish in the shm_batched arm.
+_AB_SHM_BATCH = 8
+
+
+def _ab_pool(variant: str, lanes: int):
+    """Per-record (arrays, q_sel, q_max) source stream for the A/B.
+
+    Pixel streams are FRAME-STACKED like the real actor path (ISSUE 14):
+    a cyclic ring of random frames, each record's stacks shifted by one
+    frame from the previous — the redundancy every stacked pixel env
+    actually ships, which the dedup plane exists to strip and which
+    zlib cannot see (frames are spatially random and interleaved at
+    stride ``frame_stack``). ``obs`` and ``next_obs`` are the SAME
+    stack per record (the HostVectorEnv steady-state contract). Vector
+    streams keep the independent-random pool (no frame axis — the
+    dedup negotiation declines them, honestly).
+
+    Returns (pool list, frame_stack or 0). Record i = pool[i % len].
+    Cycling is seamless for dedup: stack windows over a cyclic frame
+    ring keep shifting by one at the wrap.
+    """
+    import numpy as np
+
+    obs_shape, obs_dtype, _ = _AB_SPECS[variant]
+    obs_dtype = np.dtype(obs_dtype)
+    rng = np.random.default_rng(0)
+    pool = []
+    if len(obs_shape) == 3 and obs_shape[-1] > 1:
+        fs = obs_shape[-1]
+        F = 48
+        frames = rng.integers(
+            0, 256, (F, lanes) + obs_shape[:-1]).astype(obs_dtype)
+        for t in range(F):
+            stack = np.stack([frames[(t + k) % F] for k in range(fs)],
+                             axis=-1)
+            pool.append((
+                {"obs": stack,
+                 "reward": rng.normal(size=(lanes,)).astype(np.float32),
+                 "terminated": np.zeros((lanes,), np.uint8),
+                 "truncated": np.zeros((lanes,), np.uint8),
+                 "next_obs": stack},
+                rng.normal(size=(lanes,)).astype(np.float32),
+                rng.normal(size=(lanes,)).astype(np.float32)))
+        return pool, fs
+
+    def obs_batch():
+        if obs_dtype == np.uint8:
+            return rng.integers(0, 256, (lanes,) + obs_shape
+                                ).astype(np.uint8)
+        return rng.normal(size=(lanes,) + obs_shape).astype(obs_dtype)
+
+    for _ in range(16):
+        pool.append((
+            {"obs": obs_batch(),
+             "reward": rng.normal(size=(lanes,)).astype(np.float32),
+             "terminated": np.zeros((lanes,), np.uint8),
+             "truncated": np.zeros((lanes,), np.uint8),
+             "next_obs": obs_batch()},
+            rng.normal(size=(lanes,)).astype(np.float32),
+            rng.normal(size=(lanes,)).astype(np.float32)))
+    return pool, 0
+
 
 def _transport_ab(variant: str, records: int, lanes: int):
     """Measure the EXPERIENCE PATH in isolation — encode -> transport ->
-    decode, no learner — for three arms:
+    decode, no learner — one arm per codec/transport combination:
 
-      * ``legacy``   — today's remote-actor path exactly: JSON-header
+      * ``legacy``      — today's remote-actor path exactly: JSON-header
         codec (compress="auto": pixel records ride zlib-1) over the
         CRC-framed TCP loopback;
-      * ``zerocopy`` — the same TCP framing, zero-copy payloads
+      * ``zerocopy``    — the same TCP framing, zero-copy payloads
         (schema-negotiated raw bytes + q planes);
-      * ``shm``      — zero-copy records through the seqlock slot ring
-        (the same-host path; no socket stack at all).
+      * ``shm``         — zero-copy records through the seqlock slot
+        ring (the same-host path; no socket stack at all);
+      * ``dedup``       — the ISSUE 14 frame-dedup plane over TCP
+        (pixel variants only: one novel frame per record, stacks
+        reconstructed at decode);
+      * ``shm_dedup``   — dedup records through the slot ring;
+      * ``shm_batched`` — zero-copy records, ``_AB_SHM_BATCH`` per slot
+        publish (the seqlock-handshake amortization arm).
 
     Producer encodes live in a thread (what an actor does every step),
     the consumer decodes every record; both share this box's core, so
     rates reflect the full per-record CPU the codec costs each side.
-    Returns one row dict per arm: trajectories/sec (1 record = one
-    vector-env step batch), bytes on the wire, and the consumer's
-    decode CPU-seconds.
+    Per-arm row: trajectories/sec (1 record = one vector-env step
+    batch), bytes on the wire, the consumer's decode CPU-seconds
+    (``decode_cpu_s`` — for dedup arms this INCLUDES the stack
+    reconstruction; the plain arms' equivalent byte movement happens in
+    the transport copy instead, which is why ``trajectories_per_sec``
+    is the end-to-end number), and the dedup savings counters.
     """
     import threading
-
-    import numpy as np
 
     from dist_dqn_tpu import ingest
     from dist_dqn_tpu.actors.transport import (_FRAME_HDR,
@@ -174,33 +244,13 @@ def _transport_ab(variant: str, records: int, lanes: int):
                                                encode_arrays)
 
     obs_shape, obs_dtype, _ = _AB_SPECS[variant]
-    obs_dtype = np.dtype(obs_dtype)
-    rng = np.random.default_rng(0)
-    # Raw-array twin of actors/feeder.py _build_pool (which returns
-    # per-transport ENCODED payloads; the A/B needs the raw arrays to
-    # encode per arm). A step-record FIELD change must land in both —
-    # the schema-driven encoder below fails loudly if they drift.
-    pool_n = 16
-
-    def obs_batch():
-        if obs_dtype == np.uint8:
-            return rng.integers(0, 256, (lanes,) + obs_shape
-                                ).astype(np.uint8)
-        return rng.normal(size=(lanes,) + obs_shape).astype(obs_dtype)
-
-    pool = []
-    for _ in range(pool_n):
-        pool.append((
-            {"obs": obs_batch(),
-             "reward": rng.normal(size=(lanes,)).astype(np.float32),
-             "terminated": np.zeros((lanes,), np.uint8),
-             "truncated": np.zeros((lanes,), np.uint8),
-             "next_obs": obs_batch()},
-            rng.normal(size=(lanes,)).astype(np.float32),
-            rng.normal(size=(lanes,)).astype(np.float32)))
+    pool, fs = _ab_pool(variant, lanes)
+    pool_n = len(pool)
     schema = ingest.step_schema(obs_shape, obs_dtype, lanes)
     enc = ingest.StepEncoder(schema)
     dec = ingest.StepDecoder(schema)
+    dedup_enc = ingest.DedupStepEncoder(schema, fs) if fs else None
+    dedup_dec = [None]      # fresh per arm (stateful ring)
 
     def encode_legacy(i):
         arrays, _, _ = pool[i % pool_n]
@@ -211,6 +261,11 @@ def _transport_ab(variant: str, records: int, lanes: int):
         arrays, q_sel, q_max = pool[i % pool_n]
         return enc.encode_step(arrays, actor=0, t=i + 1,
                                q_sel=q_sel, q_max=q_max)
+
+    def encode_dedup(i):
+        arrays, q_sel, q_max = pool[i % pool_n]
+        return dedup_enc.encode_step(arrays, actor=0, t=i + 1,
+                                     q_sel=q_sel, q_max=q_max)
 
     decode_cpu = [0.0]
 
@@ -223,6 +278,17 @@ def _transport_ab(variant: str, records: int, lanes: int):
         t0 = time.perf_counter()
         dec.decode(payload)
         decode_cpu[0] += time.perf_counter() - t0
+
+    def decode_dedup(payload):
+        t0 = time.perf_counter()
+        dedup_dec[0].decode(payload)
+        decode_cpu[0] += time.perf_counter() - t0
+
+    def fresh_dedup_arm():
+        """Fresh encoder chain + decoder ring per arm (dedup state is a
+        per-session chain; arms must not share it)."""
+        dedup_enc.reset()
+        dedup_dec[0] = ingest.DedupStepDecoder(schema, fs, t0=0)
 
     def tcp_arm(encode_one, decode_one):
         server = TcpRecordServer()
@@ -258,19 +324,34 @@ def _transport_ab(variant: str, records: int, lanes: int):
         server.close()
         return wall, sent[0], decode_cpu[0]
 
-    def shm_arm():
+    def shm_arm(encode_one, decode_one, batch: int = 1,
+                slot_size: int = 0):
+        slot = slot_size or ingest.max_record_bytes(schema)
+        if batch > 1:
+            from dist_dqn_tpu.ingest.shm_ring import batch_bytes
+            slot = batch_bytes([slot] * batch)
         ring = ingest.ShmSlotRing(
-            f"ab_{os.getpid()}_{variant}",
-            slot_size=ingest.max_record_bytes(schema), nslots=64,
+            f"ab_{os.getpid()}_{variant}", slot_size=slot, nslots=64,
             create=True)
         att = ingest.ShmSlotRing(f"ab_{os.getpid()}_{variant}")
         sent = [0]
         try:
             def produce():
-                for i in range(records):
-                    payload = encode_zc(i)
-                    sent[0] += len(payload)
-                    att.push_wait(payload)
+                if batch > 1:
+                    i = 0
+                    while i < records:
+                        group = []
+                        for k in range(min(batch, records - i)):
+                            p = bytes(encode_one(i + k))
+                            sent[0] += len(p)
+                            group.append(p)
+                        att.push_batch_wait(group)
+                        i += len(group)
+                else:
+                    for i in range(records):
+                        payload = encode_one(i)
+                        sent[0] += len(payload)
+                        att.push_wait(payload)
 
             th = threading.Thread(target=produce, daemon=True,
                                   name="ab-producer")
@@ -286,7 +367,7 @@ def _transport_ab(variant: str, records: int, lanes: int):
                     # on pixel records — 5 ms GIL switch interval).
                     time.sleep(0)
                     continue
-                decode_zc(payload)
+                decode_one(payload)
                 got += 1
             wall = time.perf_counter() - t0
             th.join(timeout=10)
@@ -296,13 +377,30 @@ def _transport_ab(variant: str, records: int, lanes: int):
             ring.close()
             ring.unlink()
 
+    arms = [
+        ("legacy", lambda: tcp_arm(encode_legacy, decode_legacy)),
+        ("zerocopy", lambda: tcp_arm(encode_zc, decode_zc)),
+        ("shm", lambda: shm_arm(encode_zc, decode_zc)),
+        ("shm_batched", lambda: shm_arm(encode_zc, decode_zc,
+                                        batch=_AB_SHM_BATCH)),
+    ]
+    if fs:
+        def dedup_tcp():
+            fresh_dedup_arm()
+            return tcp_arm(encode_dedup, decode_dedup)
+
+        def dedup_shm():
+            fresh_dedup_arm()
+            return shm_arm(encode_dedup, decode_dedup,
+                           slot_size=ingest.max_dedup_record_bytes(
+                               schema, fs))
+
+        arms += [("dedup", dedup_tcp), ("shm_dedup", dedup_shm)]
+
     rows = []
-    for arm, run in (("legacy", lambda: tcp_arm(encode_legacy,
-                                                decode_legacy)),
-                     ("zerocopy", lambda: tcp_arm(encode_zc, decode_zc)),
-                     ("shm", shm_arm)):
+    for arm, run in arms:
         wall, sent, cpu = run()
-        rows.append({
+        row = {
             "bench": "apex_feeder", "phase": "ab", "variant": variant,
             "arm": arm, "transport": arm, "records": records,
             "lanes_per_record": lanes,
@@ -310,7 +408,13 @@ def _transport_ab(variant: str, records: int, lanes: int):
             "bytes_on_wire": int(sent),
             "bytes_per_record": round(sent / records, 1),
             "decode_cpu_s": round(cpu, 4),
-            "wall_s": round(wall, 3)})
+            "dedup_bytes_saved": 0,
+            "dedup_frames_reused": 0,
+            "wall_s": round(wall, 3)}
+        if arm in ("dedup", "shm_dedup"):
+            row["dedup_bytes_saved"] = int(dedup_dec[0].bytes_saved)
+            row["dedup_frames_reused"] = int(dedup_dec[0].frames_reused)
+        rows.append(row)
     return rows
 
 
@@ -375,19 +479,58 @@ def main() -> int:
             for row in ab_rows:
                 _emit(row)
             by_arm = {r["arm"]: r for r in ab_rows}
-            _emit({"bench": "apex_feeder", "variant": variant,
-                   "phase": "ab_summary",
-                   "zerocopy_speedup_vs_legacy": round(
-                       by_arm["zerocopy"]["trajectories_per_sec"]
-                       / max(by_arm["legacy"]["trajectories_per_sec"],
-                             1e-9), 3),
-                   "shm_speedup_vs_legacy": round(
-                       by_arm["shm"]["trajectories_per_sec"]
-                       / max(by_arm["legacy"]["trajectories_per_sec"],
-                             1e-9), 3),
-                   "zerocopy_wire_bytes_vs_legacy": round(
-                       by_arm["zerocopy"]["bytes_on_wire"]
-                       / max(by_arm["legacy"]["bytes_on_wire"], 1), 3)})
+            summary = {
+                "bench": "apex_feeder", "variant": variant,
+                "phase": "ab_summary",
+                "zerocopy_speedup_vs_legacy": round(
+                    by_arm["zerocopy"]["trajectories_per_sec"]
+                    / max(by_arm["legacy"]["trajectories_per_sec"],
+                          1e-9), 3),
+                "shm_speedup_vs_legacy": round(
+                    by_arm["shm"]["trajectories_per_sec"]
+                    / max(by_arm["legacy"]["trajectories_per_sec"],
+                          1e-9), 3),
+                "zerocopy_wire_bytes_vs_legacy": round(
+                    by_arm["zerocopy"]["bytes_on_wire"]
+                    / max(by_arm["legacy"]["bytes_on_wire"], 1), 3),
+                # Batched slot publishes (ISSUE 14): the seqlock-
+                # handshake amortization, read against the per-record
+                # shm arm.
+                "shm_batched_speedup_vs_shm": round(
+                    by_arm["shm_batched"]["trajectories_per_sec"]
+                    / max(by_arm["shm"]["trajectories_per_sec"],
+                          1e-9), 3),
+            }
+            if "dedup" in by_arm:
+                # Frame-dedup plane (ISSUE 14): wire bytes + decode CPU
+                # against BOTH incumbent codecs, and the throughput
+                # read on the same-host ring.
+                summary.update({
+                    "dedup_wire_bytes_vs_legacy": round(
+                        by_arm["dedup"]["bytes_on_wire"]
+                        / max(by_arm["legacy"]["bytes_on_wire"], 1), 3),
+                    "dedup_wire_bytes_vs_zerocopy": round(
+                        by_arm["dedup"]["bytes_on_wire"]
+                        / max(by_arm["zerocopy"]["bytes_on_wire"], 1),
+                        3),
+                    "dedup_decode_cpu_vs_legacy": round(
+                        by_arm["dedup"]["decode_cpu_s"]
+                        / max(by_arm["legacy"]["decode_cpu_s"], 1e-9),
+                        3),
+                    "dedup_decode_cpu_vs_zerocopy": round(
+                        by_arm["dedup"]["decode_cpu_s"]
+                        / max(by_arm["zerocopy"]["decode_cpu_s"],
+                              1e-9), 3),
+                    "dedup_speedup_vs_legacy": round(
+                        by_arm["dedup"]["trajectories_per_sec"]
+                        / max(by_arm["legacy"]["trajectories_per_sec"],
+                              1e-9), 3),
+                    "shm_dedup_speedup_vs_shm": round(
+                        by_arm["shm_dedup"]["trajectories_per_sec"]
+                        / max(by_arm["shm"]["trajectories_per_sec"],
+                              1e-9), 3),
+                })
+            _emit(summary)
 
         # Phase 1 — fixed small probe: pays every compile, measures the
         # saturated ingest rate on this host.

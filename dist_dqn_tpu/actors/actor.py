@@ -118,23 +118,46 @@ def _step_and_encode_zc(env, actions, enc: "ingest.StepEncoder",
 
 
 def _hello_meta(actor_id: int, t: int, transport: str,
-                schema=None) -> dict:
+                schema=None, dedup_stack: int = 0) -> dict:
     """Hello metadata with the explicit protocol-version field (ISSUE 9
     satellite): the service rejects a mismatched version AT CONNECT —
     a codec drift fails as one loud hello error instead of mid-stream
     CRC/desync noise. Zero-copy hellos also declare the trajectory
-    schema (the one-time negotiation every later frame relies on)."""
+    schema (the one-time negotiation every later frame relies on).
+
+    ``dedup_stack`` (ISSUE 14) is a CAPABILITY, not a version: a
+    dedup-capable actor declares its frame-stack depth and ships
+    FLAG_DEDUP frames; an actor that omits it (vector obs, unknown
+    stream contract, --no-wire-dedup) joins the same dedup-capable
+    service on the plain zero-copy layout."""
     meta = {"kind": "hello", "actor": actor_id, "t": t,
             "proto": ingest.PROTOCOL_VERSION, "transport": transport}
     if schema is not None:
         meta["schema"] = schema.to_dict()
+    if dedup_stack:
+        meta["dedup"] = int(dedup_stack)
     return meta
+
+
+def _negotiate_dedup(env, obs: np.ndarray, transport: str,
+                     dedup: bool) -> int:
+    """Frame-stack depth to declare in the hello, or 0: dedup engages
+    only when the env adapter DECLARES the stacked-stream contract
+    (``frame_stack`` attribute) and the obs layout matches it."""
+    if transport != "zerocopy" or not dedup:
+        return 0
+    fs = int(getattr(env, "frame_stack", 0) or 0)
+    if fs < 2:
+        return 0
+    if obs.ndim < 3 or obs.shape[-1] != fs:
+        return 0
+    return fs
 
 
 def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
               req_ring: str, act_box: str, stop_path: str,
               max_env_steps: int = 10 ** 12,
-              transport: str = "legacy") -> None:
+              transport: str = "legacy", dedup: bool = True) -> None:
     """Entry point for one actor process (multiprocessing 'spawn' target).
 
     ``transport="zerocopy"`` (ISSUE 9): trajectories publish into this
@@ -143,6 +166,10 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     replies arrive as zero-copy frames whose q planes ride the next
     step record — the actor-side priority loop. ``"legacy"`` keeps the
     JSON-codec records over the shared C++ ring, bit-pinned.
+
+    ``dedup`` (ISSUE 14): on frame-stacked pixel envs the zerocopy
+    records additionally ship each physical frame ONCE (the dedup
+    plane); False (--no-wire-dedup) keeps the plain zero-copy layout.
     """
     env = make_host_env(env_name, num_envs, seed=seed)
     obs = env.reset()
@@ -151,10 +178,13 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     shard = 0
     if transport == "zerocopy":
         schema = ingest.step_schema(obs.shape[1:], obs.dtype, num_envs)
-        enc = ingest.StepEncoder(schema)
+        fs = _negotiate_dedup(env, obs, transport, dedup)
+        enc = (ingest.DedupStepEncoder(schema, fs) if fs
+               else ingest.StepEncoder(schema))
         ring = ingest.ShmSlotRing(f"{req_ring}_zc_{actor_id}")
         payload = encode_arrays(
-            {"obs": obs}, _hello_meta(actor_id, t, transport, schema))
+            {"obs": obs}, _hello_meta(actor_id, t, transport, schema,
+                                      dedup_stack=fs))
     else:
         ring = ShmRing(req_ring)
         payload = encode_arrays({"obs": obs},
@@ -211,7 +241,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
                      max_env_steps: int = 10 ** 12,
                      max_consecutive_failures: int = 60,
                      reconnect_backoff_s: float = 0.5,
-                     transport: str = "legacy") -> None:
+                     transport: str = "legacy", dedup: bool = True) -> None:
     """Actor on another host: same stepping loop, DCN (TCP) transport.
 
     Lock-step protocol per actor: push an observation record, block on the
@@ -243,11 +273,18 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         np.random.SeedSequence(seed, spawn_key=(0x6A17,)))
     enc = None
     schema = None
+    dedup_fs = 0
 
     def connect_and_hello(obs, t):
         client = TcpRecordClient(tuple(address))
+        if enc is not None and hasattr(enc, "reset"):
+            # Reconnect = fresh hello = fresh dedup chain: the service
+            # rebuilds its decoder on the hello, so the id streams must
+            # restart together (ISSUE 14).
+            enc.reset()
         client.push(encode_arrays(
-            {"obs": obs}, _hello_meta(actor_id, t, transport, schema),
+            {"obs": obs}, _hello_meta(actor_id, t, transport, schema,
+                                      dedup_stack=dedup_fs),
             compress="auto"))
         return client
 
@@ -261,7 +298,9 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     shard = 0
     if transport == "zerocopy":
         schema = ingest.step_schema(obs.shape[1:], obs.dtype, num_envs)
-        enc = ingest.StepEncoder(schema)
+        dedup_fs = _negotiate_dedup(env, obs, transport, dedup)
+        enc = (ingest.DedupStepEncoder(schema, dedup_fs) if dedup_fs
+               else ingest.StepEncoder(schema))
     failures = 0
     client = None                    # first connect goes through the retry
     steps = 0                        # path too (learner may not be up yet)

@@ -143,7 +143,7 @@ def _build_pool(rng: np.random.Generator, actor_id: int, lanes: int,
 def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
                req_ring: str, act_box: str, stop_path: str,
                max_env_steps: int = 10 ** 12,
-               transport: str = "legacy") -> None:
+               transport: str = "legacy", shm_batch: int = 1) -> None:
     """Entry point for one feeder process (multiprocessing 'spawn' target).
 
     Signature mirrors ``actor.run_actor`` so the service spawns either
@@ -151,6 +151,13 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
     accepted (the service still writes computed actions there) but only
     read for the first hello reply — feeders do not rate-limit on
     inference replies.
+
+    ``shm_batch`` (ISSUE 14): on the zerocopy slot ring, coalesce this
+    many step records into ONE slot publish so the seqlock handshake
+    amortizes across the batch — feeders are the unthrottled producer
+    the batching exists for (real actors are lock-step, batch 1). The
+    service sizes the ring's slots for the batch; 1 is the bit-pinned
+    pre-batching wire.
     """
     obs_shape, obs_dtype, _ = parse_feeder_spec(spec)
     rng = np.random.default_rng(seed)
@@ -204,19 +211,31 @@ def run_feeder(actor_id: int, spec: str, num_envs: int, seed: int,
             if ver >= 1:
                 break
             time.sleep(0.001)
+        batching = shm_batch > 1 and transport == "zerocopy"
+        last_mark = 0
         while steps < max_env_steps and not stop:
-            if ring.push(pool[i % POOL_RECORDS]):
-                i += 1
-                steps += num_envs
+            if batching:
+                batch = [pool[(i + k) % POOL_RECORDS]
+                         for k in range(shm_batch)]
+                pushed = ring.push_batch(batch)
+            else:
+                pushed = ring.push(pool[i % POOL_RECORDS])
+            if pushed:
+                n = shm_batch if batching else 1
+                i += n
+                steps += num_envs * n
                 # Stop checks cost a stat syscall each — off the per-push
                 # hot path (this pump shares the core with the service
                 # under measurement); the ring-full branch still checks
                 # every retry, so shutdown latency stays bounded either
                 # way. The records counter batches onto the same cadence
                 # to keep the pump a pure memcpy between checkpoints.
-                if i % 256 == 0:
+                # (>= threshold, not modulo: batched pushes advance i by
+                # shm_batch and may step over any single value.)
+                if i - last_mark >= 256:
                     stop = os.path.exists(stop_path)
-                    c_records.inc(256)
+                    c_records.inc(i - last_mark)
+                    last_mark = i
                     g_heartbeat.set(time.time())
                     hb.beat()
             else:

@@ -43,6 +43,13 @@ def main():
                              "fallback. Must match the service's "
                              "--transport (a zerocopy hello against a "
                              "legacy service fails loudly at connect)")
+    parser.add_argument("--no-wire-dedup", action="store_true",
+                        help="disable the frame-stack dedup plane "
+                             "(ISSUE 14) for this worker — full stacks "
+                             "ship on the plain zero-copy layout even "
+                             "on frame-stacked pixel envs (dedup is a "
+                             "per-actor hello capability, so mixed "
+                             "fleets are fine)")
     parser.add_argument("--telemetry-port", type=int, default=None,
                         help="serve this worker's /metrics (Prometheus "
                              "text) on this port; 0 = ephemeral. Worker "
@@ -74,7 +81,8 @@ def main():
                      (host, int(port)), args.stop_file,
                      max_env_steps=args.max_env_steps,
                      max_consecutive_failures=args.max_reconnect_failures,
-                     transport=args.transport)
+                     transport=args.transport,
+                     dedup=not args.no_wire_dedup)
 
 
 if __name__ == "__main__":

@@ -174,8 +174,30 @@ class ApexRuntimeConfig:
     # ships raw-array frames — seqlock shm slot rings for same-host
     # actors (no socket stack), length-prefixed zero-copy frames under
     # the ISSUE 8 CRC framing on TCP. "legacy" keeps the bit-pinned
-    # JSON-header codec everywhere (the A/B baseline).
+    # JSON-header codec everywhere (the A/B baseline) — DEPRECATED
+    # since ISSUE 14: scheduled for removal after one release of A/B
+    # parity (docs/ingest_pipeline.md §7 records the criterion).
     transport: str = "zerocopy"
+    # Frame-stack dedup plane (ISSUE 14): actors on frame-stacked pixel
+    # envs ship each physical frame ONCE per episode stream (novel
+    # frame + back-references; the service reconstructs full stacks at
+    # append time). Negotiated per actor at hello — a non-dedup actor
+    # joins a dedup-capable service on the plain zero-copy layout.
+    # False (--no-wire-dedup) disables the capability fleet-wide.
+    wire_dedup: bool = True
+    # Batched shm slot publishes (ISSUE 14): feeder processes coalesce
+    # this many step records into one seqlock slot publish (the
+    # handshake amortization lever for unthrottled producers). Sizes
+    # the slot rings accordingly; 1 = the bit-pinned per-record wire.
+    # Real rollout actors are lock-step and always publish per record.
+    shm_batch: int = 1
+    # Ingest-side per-shard sampling (ISSUE 14, requires ingest_shards
+    # > 1): per-shard worker threads run the stratified draw + gather
+    # where the data lives and hand the learner pre-packed batches
+    # through a bounded queue — train events stop paying sample time
+    # on the learner thread. Draw math pinned bit-identical to the
+    # facade draw (replay/sharded.py ShardSampleService).
+    shard_sampling: bool = False
     # Actor-side priority pre-computation (ISSUE 9 piece 3, zerocopy
     # only): act replies carry the inference-time q planes, actors echo
     # them on their step frames, and insertion priorities are computed
@@ -229,6 +251,21 @@ class ApexLearnerService:
         if rt.ingest_shards < 1:
             raise ValueError(
                 f"ingest_shards must be >= 1, got {rt.ingest_shards}")
+        if rt.shm_batch < 1:
+            raise ValueError(f"shm_batch must be >= 1, got "
+                             f"{rt.shm_batch}")
+        if rt.shard_sampling and rt.ingest_shards < 2:
+            raise ValueError(
+                "shard_sampling requires ingest_shards > 1: the "
+                "per-shard sampling threads live where the sharded "
+                "store's data lives — a single store has no shard "
+                "workers to move the draw into")
+        if rt.transport == "legacy":
+            log_fn("# DEPRECATION: --transport legacy is the bit-pinned"
+                   " A/B fallback only and is scheduled for removal "
+                   "after one release of zerocopy A/B parity "
+                   "(docs/ingest_pipeline.md §7; apex_feeder_bench "
+                   "--ab rows are the parity evidence)")
         if rt.ingest_shards > 1:
             if rt.device_sampling:
                 raise ValueError(
@@ -274,6 +311,12 @@ class ApexLearnerService:
         probe = make_host_env(rt.host_env, 1)
         self.num_actions = probe.num_actions
         obs_example = probe.reset()[0]
+        # Dedup capability probe (ISSUE 14): the env's declared
+        # frame-stack depth sizes the slot rings for dedup boundary
+        # records (worst case ~2x a plain record — every frame slot of
+        # both stacks inline plus tables).
+        self._probe_frame_stack = int(getattr(probe, "frame_stack", 0)
+                                      or 0)
         del probe
 
         # Zero-copy ingest (ISSUE 9): sticky-shard router + per-local-
@@ -297,12 +340,26 @@ class ApexLearnerService:
             self._expected_schema = ingest.step_schema(
                 obs_example.shape, obs_example.dtype, rt.envs_per_actor)
             # Slot must fit the larger of a step record and the legacy-
-            # coded hello ([lanes, obs] + JSON header) with headroom.
-            slot = max(ingest.max_record_bytes(self._expected_schema),
+            # coded hello ([lanes, obs] + JSON header) with headroom;
+            # dedup-capable fleets also fit the dedup worst case
+            # (boundary record with every frame inline + tables), and
+            # batching feeders fit shm_batch records per slot.
+            base = max(ingest.max_record_bytes(self._expected_schema),
                        rt.envs_per_actor * obs_example.nbytes + 4096)
+            if rt.wire_dedup and self._probe_frame_stack >= 2:
+                try:
+                    base = max(base, ingest.max_dedup_record_bytes(
+                        self._expected_schema, self._probe_frame_stack))
+                except ValueError:
+                    pass    # obs layout doesn't match the declared
+                    #         stack: actors won't negotiate dedup either
+            if rt.shm_batch > 1:
+                from dist_dqn_tpu.ingest.shm_ring import batch_bytes
+                base = max(base,
+                           batch_bytes([base] * rt.shm_batch))
             for i in range(rt.num_actors):
                 self._zc_rings[i] = ingest.ShmSlotRing(
-                    f"req_{self.run_id}_zc_{i}", slot_size=slot,
+                    f"req_{self.run_id}_zc_{i}", slot_size=base,
                     nslots=8, create=True)
         elif rt.transport != "legacy":
             raise ValueError(f"unknown transport {rt.transport!r} "
@@ -530,6 +587,15 @@ class ApexLearnerService:
                 cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
                 priority_eps=cfg.replay.priority_eps,
                 sampler="device" if rt.device_sampling else "tree")
+        # Ingest-side per-shard sampling (ISSUE 14): the stratified
+        # draw + gather move into per-shard worker threads; the learner
+        # pops pre-packed batches (config validated at the top of
+        # __init__ — requires the sharded store above).
+        self._shard_sampler = None
+        if rt.shard_sampling:
+            from dist_dqn_tpu.replay.sharded import ShardSampleService
+            self._shard_sampler = ShardSampleService(
+                self.replay, depth=max(rt.pipeline_depth, 1))
         # Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i/(N-1)*alpha).
         n_act = max(self.total_actors - 1, 1)
         self.actor_eps = np.array([
@@ -678,6 +744,19 @@ class ApexLearnerService:
             tmc.INGEST_ACTOR_PRIO_TRANSITIONS,
             "transitions inserted with actor-shipped |TD| priorities "
             "(zero learner-side bootstrap dispatches)")
+        # Frame-dedup plane (ISSUE 14): reused frame slots + wire bytes
+        # saved, swept from the per-actor decoders' plain-int counters
+        # on the log cadence (no registry calls on the decode path).
+        self._tm_dedup_frames = reg.counter(
+            tmc.INGEST_DEDUP_FRAMES_REUSED,
+            "frame-stack slots served by dedup back-references instead "
+            "of wire bytes")
+        self._tm_dedup_bytes = reg.counter(
+            tmc.INGEST_DEDUP_BYTES_SAVED,
+            "wire bytes the dedup plane avoided vs the undeduped "
+            "zero-copy layout")
+        self._dedup_swept = (0, 0)
+        self._dedup_retired = (0, 0)   # counters of replaced decoders
         self._tm_ring_dropped = reg.gauge(
             "dqn_transport_ring_dropped",
             "records the shm ring dropped (producer overrun)")
@@ -728,6 +807,27 @@ class ApexLearnerService:
         # spans the jit compile and is not mirror staleness — observing
         # it would park a false 60s+ outlier in the triage histogram.
         self._last_param_refresh = None
+
+    def _dedup_totals(self):
+        """(frames_reused, bytes_saved) summed over every LIVE dedup
+        decoder plus the retired accumulator — a re-hello replaces an
+        actor's decoder with zeroed counters, so the old one's totals
+        fold into ``_dedup_retired`` first (_validate_hello); keeping
+        the sum monotone is what lets the sweep emit deltas safely."""
+        frames, saved = self._dedup_retired
+        for dec in self._decoders.values():
+            frames += getattr(dec, "frames_reused", 0)
+            saved += getattr(dec, "bytes_saved", 0)
+        return frames, saved
+
+    def _sweep_dedup_counters(self):
+        frames, saved = self._dedup_totals()
+        seen_f, seen_b = self._dedup_swept
+        if frames > seen_f:
+            self._tm_dedup_frames.inc(frames - seen_f)
+        if saved > seen_b:
+            self._tm_dedup_bytes.inc(saved - seen_b)
+        self._dedup_swept = (frames, saved)
 
     def _actor_alive_gauge(self, actor_id: int):
         g = self._tm_actor_alive.get(actor_id)
@@ -793,17 +893,23 @@ class ApexLearnerService:
         if actor_id < self.rt.num_actors:
             # feeder:<spec> host envs swap the rollout actor for the
             # in-RAM trajectory feeder (actors/feeder.py) — identical
-            # spawn contract, no emulator in the loop.
+            # spawn contract, no emulator in the loop. Feeders take the
+            # slot-batching knob (unthrottled producers); actors take
+            # the dedup capability switch (lock-step, batch 1).
             target = run_actor
+            kwargs = {"transport": self.rt.transport,
+                      "dedup": self.rt.wire_dedup}
             if self.rt.host_env.startswith("feeder:"):
                 from dist_dqn_tpu.actors.feeder import run_feeder
                 target = run_feeder
+                kwargs = {"transport": self.rt.transport,
+                          "shm_batch": self.rt.shm_batch}
             p = ctx.Process(
                 target=target,
                 args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
                       1000 + 7 * actor_id, f"req_{self.run_id}",
                       f"act_{self.run_id}_{actor_id}", self.stop_path),
-                kwargs={"transport": self.rt.transport},
+                kwargs=kwargs,
                 daemon=True)
         else:
             p = ctx.Process(
@@ -811,7 +917,8 @@ class ApexLearnerService:
                 args=(actor_id, self.rt.host_env, self.rt.envs_per_actor,
                       1000 + 7 * actor_id,
                       ("127.0.0.1", self.tcp_address[1]), self.stop_path),
-                kwargs={"transport": self.rt.transport},
+                kwargs={"transport": self.rt.transport,
+                        "dedup": self.rt.wire_dedup},
                 daemon=True)
         p.start()
         return p
@@ -864,6 +971,9 @@ class ApexLearnerService:
                 {"ingest_degraded": False, "env_steps": self.env_steps}))
 
     def shutdown(self):
+        if self._shard_sampler is not None:
+            self._shard_sampler.close()
+        self._sweep_dedup_counters()   # final partial-period deltas
         with open(self.stop_path, "w") as f:
             f.write("stop")
         for p in getattr(self, "procs", {}).values():
@@ -1171,7 +1281,54 @@ class ApexLearnerService:
                 self._hello_reject(
                     f"actor {actor} declared a non-canonical step "
                     f"schema {schema.to_dict()}", conn_id)
-            self._decoders[actor] = StepDecoder(schema)
+            # Frame-dedup capability (ISSUE 14): declared per actor at
+            # hello — the service is always dedup-CAPABLE, so mixed
+            # fleets (dedup pixel actors + plain vector actors + legacy
+            # JSON actors) coexist; only the DECLARED layout must be
+            # internally consistent, or the hello rejects.
+            old_dec = self._decoders.get(actor)
+            if old_dec is not None and getattr(old_dec, "bytes_saved",
+                                               None) is not None:
+                # Retire the replaced decoder's savings so the
+                # monotone-total sweep cannot lose them (re-hello
+                # rebuilds decoders with zeroed counters).
+                rf, rb = self._dedup_retired
+                self._dedup_retired = (rf + old_dec.frames_reused,
+                                       rb + old_dec.bytes_saved)
+            dedup_fs = int(meta.get("dedup", 0) or 0)
+            if dedup_fs and not self.rt.wire_dedup:
+                # --no-wire-dedup must hold fleet-wide (it is the
+                # dedup-off A/B arm): an EXTERNAL worker that did not
+                # get its own --no-wire-dedup is told to re-hello
+                # plain rather than silently contaminating the arm.
+                self._hello_reject(
+                    f"actor {actor} declared frame dedup but the "
+                    f"service runs --no-wire-dedup — restart the "
+                    f"worker with --no-wire-dedup", conn_id)
+            if dedup_fs:
+                from dist_dqn_tpu.ingest import (DedupStepDecoder,
+                                                 validate_dedup_stack)
+                try:
+                    validate_dedup_stack(schema, dedup_fs)
+                except ValueError as e:
+                    self._hello_reject(
+                        f"actor {actor} declared frame dedup the "
+                        f"schema cannot carry: {e}", conn_id)
+                # History sizing: decoded stacks are VIEWS into the
+                # rolling frame ring; the deepest holder is the n-step
+                # (or sequence) assembler, so the ring must outlive its
+                # maximum window by a margin. Sized for the WORST case
+                # of every record being a boundary (general) record,
+                # each of which consumes frame_stack slots (a reseed),
+                # not the canonical path's one.
+                hold = (self.seq_len + (self.cfg.replay.sequence_stride
+                                        or self.cfg.replay.unroll_length)
+                        if self.recurrent else self.cfg.learner.n_step)
+                self._decoders[actor] = DedupStepDecoder(
+                    schema, dedup_fs, t0=int(meta["t"]),
+                    history=max(32, (hold + 4) * dedup_fs + 2 * dedup_fs))
+            else:
+                self._decoders[actor] = StepDecoder(schema)
             asm = self.assemblers[actor]
             cur_lanes = getattr(asm, "num_lanes", None) \
                 or len(getattr(asm, "lanes", ()))
@@ -1553,12 +1710,23 @@ class ApexLearnerService:
                            next_obs=items["next_obs"]),
                 np.asarray(weights, np.float32))
 
+    def _sample_replay(self, batch_size: int, beta: float):
+        """One replay draw -> (items, idx, weights, generations):
+        through the ingest-side per-shard sampling service when armed
+        (the learner thread then only pops a pre-packed batch whose
+        generations were snapshotted at draw time, under the shard
+        locks), else the facade's inline draw."""
+        if self._shard_sampler is not None:
+            return self._shard_sampler.sample(batch_size, beta)
+        items, idx, weights = self.replay.sample(batch_size, beta)
+        return items, idx, weights, self.replay.generation(idx)
+
     def _stage_batch(self, batch_size: int, beta: float) -> None:
         """Sample one batch and begin its H2D upload (replay/staging.py):
         the sample+copy+upload for step g+1 runs while step g trains."""
         with self.tracer.span("replay.sample", batch=batch_size):
-            items, idx, weights = self.replay.sample(batch_size, beta)
-            gen = self.replay.generation(idx)
+            items, idx, weights, gen = self._sample_replay(batch_size,
+                                                           beta)
         with self.tracer.span("h2d.stage", batch=batch_size):
             self._stager.stage(self._host_train_args(items, weights),
                                aux=(idx, gen))
@@ -1574,11 +1742,12 @@ class ApexLearnerService:
         with self.tracer.span("replay.sample", batch=batch_size,
                               substeps=self.replay_ratio):
             for _ in range(self.replay_ratio):
-                items, idx, weights = self.replay.sample(batch_size, beta)
+                items, idx, weights, gen = self._sample_replay(
+                    batch_size, beta)
                 items_l.append(items)
                 idx_l.append(idx)
                 w_l.append(np.asarray(weights, np.float32))
-                gen_l.append(self.replay.generation(idx))
+                gen_l.append(gen)
         batch = Transition(*(np.stack([it[k] for it in items_l])
                              for k in ("obs", "action", "reward",
                                        "discount", "next_obs")))
@@ -1713,9 +1882,8 @@ class ApexLearnerService:
                     self._stage_batch(batch_size, beta)
             else:
                 with self.tracer.span("replay.sample", batch=batch_size):
-                    items, idx, weights = self.replay.sample(batch_size,
-                                                             beta)
-                    gen = self.replay.generation(idx)
+                    items, idx, weights, gen = self._sample_replay(
+                        batch_size, beta)
                 with self.tracer.span("train_step.dispatch"):
                     if self.recurrent:
                         sample = self._sequence_sample(items, weights)
@@ -2149,6 +2317,7 @@ class ApexLearnerService:
                     self._tm_ring_dropped.set(self.req_ring.dropped)
                     self._tm_ring_pending.set(self.req_ring.pending_bytes)
                     self._tm_record_age.set(now - self._last_record)
+                    self._sweep_dedup_counters()
                     self.tracer.counter("replay_size", len(self.replay))
                     self.tracer.counter("env_steps", self.env_steps)
                     self.tracer.flush()
@@ -2184,6 +2353,7 @@ class ApexLearnerService:
             hb_learner.close()
             self.tracer.close()
             self.shutdown()
+        dedup_frames, dedup_saved = self._dedup_totals()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
                 # Zero-copy ingest provenance (ISSUE 9): which transport
                 # carried the run, what it cost on the wire, and where
@@ -2193,6 +2363,15 @@ class ApexLearnerService:
                 "ingest_bytes": dict(self.router.bytes_by_transport),
                 "bytes_on_wire": int(
                     sum(self.router.bytes_by_transport.values())),
+                # Near-data experience plane (ISSUE 14): what the dedup
+                # wire avoided shipping, how slots batched, and whether
+                # sampling ran ingest-side.
+                "dedup_frames_reused": int(dedup_frames),
+                "dedup_bytes_saved": int(dedup_saved),
+                "shm_batch": self.rt.shm_batch,
+                "shard_sampling": self._shard_sampler is not None,
+                "shard_sample_batches": (self._shard_sampler.batches
+                                         if self._shard_sampler else 0),
                 "records_by_shard": dict(self.router.records_by_shard),
                 "replay_added_by_shard": dict(
                     getattr(self.replay, "added_by_shard", {}) or {}),

@@ -104,6 +104,15 @@ class AtariPreprocessing:
     def num_actions(self) -> int:
         return int(self.env.action_space.n)
 
+    @property
+    def frame_stack(self) -> int:
+        """Frames stacked on the obs last axis — the dedup negotiation
+        input (ISSUE 14). This adapter GUARANTEES the stream contract
+        the dedup codec relies on: each step shifts the stack by one
+        frame and a reset repeats the first frame (pinned by
+        tests/test_ingest_dedup.py)."""
+        return self.stack
+
     def _obs(self, frame: np.ndarray) -> np.ndarray:
         processed = _area_resize_84(_to_gray(frame))
         self._frames = np.concatenate(
@@ -169,6 +178,13 @@ class HostVectorEnv:
         return (e.num_actions if hasattr(e, "num_actions")
                 else int(e.action_space.n))
 
+    @property
+    def frame_stack(self) -> int:
+        """Per-env frame-stack depth, 0 when the underlying env does
+        not declare one (dedup negotiation then stays off — the safe
+        default for envs whose stream contract is unknown)."""
+        return int(getattr(self.envs[0], "frame_stack", 0) or 0)
+
     def reset(self) -> np.ndarray:
         obs = [self._reset_one(e, self._seed + i)
                for i, e in enumerate(self.envs)]
@@ -203,6 +219,50 @@ class HostVectorEnv:
         return (np.stack(obs_l), np.stack(next_l),
                 np.asarray(r_l, np.float32), np.asarray(te_l),
                 np.asarray(tr_l))
+
+
+class SynthStackedEnv:
+    """Tiny synthetic frame-stacked pixel env ("synthstack"): random
+    8x8 uint8 frames stacked 4 deep with EXACTLY the AtariPreprocessing
+    stream semantics — step shifts the stack by one novel frame, reset
+    repeats a fresh frame. Exists so the frame-dedup wire path
+    (ISSUE 14) has an end-to-end actor/service exercise on boxes
+    without ale-py: real ``run_actor`` processes negotiate dedup
+    against it and the service reconstructs stacks at append time.
+    Rewards encode a trivial signal (+1 for action matching a frame
+    parity bit) so learning-rate smoke assertions stay meaningful."""
+
+    H = W = 8
+    STACK = 4
+    num_actions = 4
+    frame_stack = STACK
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._frames = np.zeros((self.H, self.W, self.STACK), np.uint8)
+        self._t = 0
+
+    def _frame(self) -> np.ndarray:
+        return self._rng.integers(0, 256, (self.H, self.W)
+                                  ).astype(np.uint8)
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        f = self._frame()
+        self._frames = np.repeat(f[:, :, None], self.STACK, axis=2)
+        self._t = 0
+        return self._frames.copy(), {}
+
+    def step(self, action):
+        f = self._frame()
+        self._frames = np.concatenate(
+            [self._frames[:, :, 1:], f[:, :, None]], axis=2)
+        self._t += 1
+        reward = float(int(action) % 2 == int(f[0, 0]) % 2)
+        terminated = bool(self._rng.random() < 1 / 150.0)
+        truncated = not terminated and self._t >= 400
+        return self._frames.copy(), reward, terminated, truncated, {}
 
 
 # Injection point for the ale: branch (VERDICT round 1, missing #1): a
@@ -266,6 +326,9 @@ def make_host_env(name: str, num_envs: int, seed: int = 0,
 
         return HostVectorEnv(lambda: FeederSpecEnv(name), num_envs,
                              seed=seed)
+
+    if name == "synthstack":
+        return HostVectorEnv(SynthStackedEnv, num_envs, seed=seed)
 
     if name == "pong":
         from dist_dqn_tpu.envs.host_pong import HostPixelPong

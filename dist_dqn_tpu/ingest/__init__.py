@@ -20,15 +20,19 @@ the TCP framing and chaos seams, so corruption handling is identical.
 Package contract: stdlib + numpy only — importable from jax-free actor
 processes.
 """
-from dist_dqn_tpu.ingest.codec import (FLAG_HAS_Q, KIND_REPLY,  # noqa: F401
-                                       KIND_STEP, ProtocolMismatchError,
+from dist_dqn_tpu.ingest.codec import (FLAG_DEDUP,  # noqa: F401
+                                       FLAG_DEDUP_CANON, FLAG_HAS_Q,
+                                       KIND_REPLY, KIND_STEP,
+                                       DedupStepDecoder, DedupStepEncoder,
+                                       ProtocolMismatchError,
                                        StepDecoder, StepEncoder,
                                        WireFormatError, decode_reply,
                                        encode_reply, is_zc,
+                                       max_dedup_record_bytes,
                                        max_record_bytes, peek_header)
 from dist_dqn_tpu.ingest.router import (StickyShardRouter,  # noqa: F401
                                         shard_for)
 from dist_dqn_tpu.ingest.schema import (PROTOCOL_VERSION,  # noqa: F401
                                         FieldSpec, TrajectorySchema,
-                                        step_schema)
+                                        step_schema, validate_dedup_stack)
 from dist_dqn_tpu.ingest.shm_ring import ShmSlotRing  # noqa: F401

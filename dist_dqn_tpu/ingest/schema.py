@@ -31,8 +31,13 @@ import numpy as np
 #: mid-stream. ``scripts/check_wire.py`` pins the frame-header layout to
 #: this constant — changing header fields without bumping it fails CI.
 #: v1 = the implicit JSON-header codec era (no version on the wire);
-#: v2 = the zero-copy frame format (ingest/codec.py).
-PROTOCOL_VERSION = 2
+#: v2 = the zero-copy frame format (ingest/codec.py);
+#: v3 = the frame-stack dedup lanes (ISSUE 14: FLAG_DEDUP /
+#: FLAG_DEDUP_CANON step records — each physical frame ships once per
+#: episode stream). Dedup itself is a HELLO CAPABILITY, not drift: a
+#: v3 actor that does not (or cannot) dedup simply never sets the
+#: flags, and the service decodes both layouts.
+PROTOCOL_VERSION = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,6 +101,32 @@ class TrajectorySchema:
     @classmethod
     def from_json(cls, s: str) -> "TrajectorySchema":
         return cls.from_dict(json.loads(s))
+
+
+def validate_dedup_stack(schema: TrajectorySchema, frame_stack: int
+                         ) -> None:
+    """Gate for the frame-stack dedup negotiation (ISSUE 14): the obs
+    and next_obs fields must actually BE stacks of ``frame_stack``
+    frames on their last axis, or the dedup codec would slice garbage.
+    Raises ``ValueError`` with the reason (the service converts it into
+    a hello rejection)."""
+    if frame_stack < 2:
+        raise ValueError(
+            f"frame dedup needs frame_stack >= 2, got {frame_stack}")
+    by_name = {f.name: f for f in schema.fields}
+    for name in ("obs", "next_obs"):
+        f = by_name.get(name)
+        if f is None:
+            raise ValueError(f"dedup schema has no {name!r} field")
+        if len(f.shape) < 2:
+            raise ValueError(
+                f"dedup {name} field shape {f.shape} has no frame axis "
+                f"(need at least [frame..., stack])")
+        if f.shape[-1] != frame_stack:
+            raise ValueError(
+                f"dedup {name} field stacks {f.shape[-1]} frames on its "
+                f"last axis but the hello declared frame_stack="
+                f"{frame_stack}")
 
 
 def step_schema(obs_shape: Sequence[int], obs_dtype,

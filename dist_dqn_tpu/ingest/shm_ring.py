@@ -24,24 +24,51 @@ belt-and-braces detector for a producer that died mid-write (or a chaos
 ``shm.publish: torn`` injection): the record is dropped and counted
 (``dqn_ingest_shm_torn_reads_total``), never decoded.
 
+Batched slot publishes (ISSUE 14 tentpole piece 2): the lock-step actor
+protocol keeps one record in flight, but an UNTHROTTLED feeder pays the
+full stamp/length/seq handshake (and the consumer its stamp re-check)
+per record even when records are tiny. :meth:`ShmSlotRing.push_batch`
+coalesces up to N records into ONE slot publish — one odd/even stamp
+cycle, one ``write_seq`` advance, one torn-read re-check for the whole
+batch. A batched slot sets the high bit of its length word
+(``BATCH_FLAG``) and its payload is ``u32 n | (u32 len_i | bytes_i)*n``;
+``pop`` unbatches transparently (consumer-side pending queue), so the
+drain path cannot tell feeders and actors apart. ``push`` (batch = 1)
+is byte-identical to the pre-batching wire — the bit-pinned default —
+and a torn batched publish drops the WHOLE batch (one seqlock covers
+one slot; counted once per slot like any torn read).
+
 Stdlib + numpy only (actors are jax-free).
 """
 from __future__ import annotations
 
+import struct
 import time
+from collections import deque
 from multiprocessing import shared_memory
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
 from dist_dqn_tpu import chaos
 from dist_dqn_tpu.telemetry import get_registry
-from dist_dqn_tpu.telemetry.collectors import INGEST_SHM_TORN
+from dist_dqn_tpu.telemetry.collectors import (INGEST_SHM_BATCH_FANIN,
+                                               INGEST_SHM_TORN,
+                                               SHM_FANIN_BUCKETS)
 
 HEADER_BYTES = 32
 SLOT_HEADER_BYTES = 16
 # Header u64 indices.
 _NSLOTS, _SLOT_SIZE, _WRITE_SEQ, _READ_SEQ = 0, 1, 2, 3
+#: High bit of a slot's length word: the payload is a batch
+#: (``u32 n | (u32 len_i | bytes_i) * n``), not one record.
+BATCH_FLAG = 0x80000000
+
+
+def batch_bytes(payload_sizes) -> int:
+    """Slot bytes one batched publish of these record sizes needs —
+    the slot-sizing input for batching feeders."""
+    return 4 + sum(4 + int(n) for n in payload_sizes)
 
 
 class ShmSlotRing:
@@ -94,6 +121,14 @@ class ShmSlotRing:
             INGEST_SHM_TORN,
             "shm slot-ring records dropped on a stamp mismatch "
             "(producer died mid-write or injected torn publish)")
+        self._h_fanin = get_registry().histogram(
+            INGEST_SHM_BATCH_FANIN,
+            "records delivered per slot publish (1 = unbatched)",
+            buckets=SHM_FANIN_BUCKETS)
+        # Consumer-side unbatching queue: records of an already-popped
+        # batched slot awaiting delivery (SPSC: only the consumer
+        # touches it).
+        self._pending_pop: "deque[bytes]" = deque()
 
     def _slot_data(self, i: int) -> memoryview:
         off = HEADER_BYTES + i * self._stride + SLOT_HEADER_BYTES
@@ -143,11 +178,70 @@ class ShmSlotRing:
             time.sleep(poll_s)
         return True
 
+    def push_batch(self, payloads: Sequence) -> bool:
+        """Publish up to N records in ONE slot (ISSUE 14): one seqlock
+        stamp cycle and one ``write_seq`` advance amortize over the
+        batch. False when the ring is full (caller retries whole).
+        A single-record batch takes the plain ``push`` path, so
+        batch=1 stays byte-identical to the pre-batching wire."""
+        if len(payloads) == 1:
+            return self.push(payloads[0])
+        if not payloads:
+            return True
+        total = 4 + sum(4 + len(p) for p in payloads)
+        if total > self.slot_size:
+            raise ValueError(
+                f"batch of {len(payloads)} records needs {total} bytes, "
+                f"exceeds slot_size {self.slot_size}")
+        ev = chaos.fire("shm.publish")
+        if ev is not None:
+            if ev.fault == "drop":
+                return True
+            if ev.fault == "stall":
+                chaos.sleep_for(ev)
+        w = int(self._hdr[_WRITE_SEQ])
+        if w - int(self._hdr[_READ_SEQ]) >= self.nslots:
+            return False
+        i = w % self.nslots
+        self._stamps[i][0] = 2 * w + 1          # odd: write in flight
+        self._lengths[i][0] = total | BATCH_FLAG
+        slot = self._slot_data(i)
+        struct.pack_into("<I", slot, 0, len(payloads))
+        off = 4
+        for p in payloads:
+            struct.pack_into("<I", slot, off, len(p))
+            off += 4
+            slot[off:off + len(p)] = p
+            off += len(p)
+        if ev is not None and ev.fault == "torn":
+            # Die-mid-write semantics: the WHOLE batch must be dropped
+            # by the consumer's stamp check — one seqlock covers one
+            # slot, so partial delivery of a torn batch cannot happen.
+            self._hdr[_WRITE_SEQ] = w + 1
+            return True
+        self._stamps[i][0] = 2 * w + 2          # even: published
+        self._hdr[_WRITE_SEQ] = w + 1
+        chaos.mark_recovered("shm.publish")
+        return True
+
+    def push_batch_wait(self, payloads: Sequence, stop=lambda: False,
+                        poll_s: float = 0.0005) -> bool:
+        while not self.push_batch(payloads):
+            if stop():
+                return False
+            time.sleep(poll_s)
+        return True
+
     # -- consumer ----------------------------------------------------------
     def pop(self) -> Optional[bytes]:
         """Next record as an OWNED bytes copy (the one copy of the shm
         path — ownership transfer out of the reusable slot), or None
-        when empty. Torn records are counted and skipped."""
+        when empty. Torn slots are counted and skipped whole (for a
+        batched slot that means the whole batch — one seqlock covers
+        one slot). Batched slots unbatch transparently: records queue
+        consumer-side and later ``pop`` calls drain them in order."""
+        if self._pending_pop:
+            return self._pending_pop.popleft()
         r = int(self._hdr[_READ_SEQ])
         if r >= int(self._hdr[_WRITE_SEQ]):
             return None
@@ -159,6 +253,8 @@ class ShmSlotRing:
             self._hdr[_READ_SEQ] = r + 1
             return None
         n = int(self._lengths[i][0])
+        batched = bool(n & BATCH_FLAG)
+        n &= ~BATCH_FLAG
         out = bytes(self._slot_data(i)[:n])
         if self._stamps[i][0] != want:          # torn during the copy
             self.torn_reads += 1
@@ -166,11 +262,31 @@ class ShmSlotRing:
             self._hdr[_READ_SEQ] = r + 1
             return None
         self._hdr[_READ_SEQ] = r + 1
-        return out
+        if not batched:
+            self._h_fanin.observe(1.0)
+            return out
+        (count,) = struct.unpack_from("<I", out, 0)
+        self._h_fanin.observe(float(count))
+        off = 4
+        first = None
+        for _ in range(count):
+            (ln,) = struct.unpack_from("<I", out, off)
+            off += 4
+            rec = out[off:off + ln]
+            off += ln
+            if first is None:
+                first = rec
+            else:
+                self._pending_pop.append(rec)
+        return first
 
     @property
     def pending(self) -> int:
-        return int(self._hdr[_WRITE_SEQ]) - int(self._hdr[_READ_SEQ])
+        """Records awaiting drain. Batched slots still in shm count as
+        one until popped (their fan-in is unknown without reading the
+        slot); unbatched-but-undelivered records count exactly."""
+        return (int(self._hdr[_WRITE_SEQ]) - int(self._hdr[_READ_SEQ])
+                + len(self._pending_pop))
 
     def close(self) -> None:
         # Drop every numpy/memoryview alias BEFORE SharedMemory.close():

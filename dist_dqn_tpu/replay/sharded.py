@@ -43,7 +43,10 @@ tests/test_sharded_replay.py checks the algebra numerically.
 """
 from __future__ import annotations
 
+import queue
 import re
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -363,6 +366,12 @@ class ShardedPrioritizedReplay:
                                   seed=seed + 7 * i, native=native)
             for i in range(self.num_shards)
         ]
+        # Per-shard locks (ISSUE 14): the ingest-side sampling service
+        # reads trees/items from its shard worker threads while the
+        # service main thread keeps inserting and writing priorities
+        # back — each shard's mutations and draws serialize on ITS
+        # lock only, so shards stay independent under concurrency.
+        self._locks = [threading.Lock() for _ in range(self.num_shards)]
         self._rng = np.random.default_rng(seed)
         self.sampled = 0
         self.added_by_shard: Dict[int, int] = {}
@@ -393,7 +402,8 @@ class ShardedPrioritizedReplay:
         batch = next(iter(items.values())).shape[0]
         self.added_by_shard[shard] = \
             self.added_by_shard.get(shard, 0) + batch
-        self.shards[shard].add(items, priorities=priorities)
+        with self._locks[shard]:
+            self.shards[shard].add(items, priorities=priorities)
 
     def sample(self, batch_size: int, beta: float
                ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
@@ -413,14 +423,40 @@ class ShardedPrioritizedReplay:
         p_sel = np.empty(batch_size, np.float64)
         out: Optional[Dict[str, np.ndarray]] = None
         for s_id in range(self.num_shards):
-            rows = shard_of == s_id
-            if not rows.any():
-                continue
-            s = self.shards[s_id]
+            out = self._shard_draw(s_id, shard_of == s_id, local_mass, T,
+                                   batch_size, idx_g, p_sel, out)
+        weights = (size * np.maximum(p_sel, 1e-12)) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        self.sampled += batch_size
+        return out, idx_g, weights
+
+    def _shard_draw(self, s_id: int, rows: np.ndarray,
+                    local_mass: np.ndarray, T: float, batch_size: int,
+                    idx_g: np.ndarray, p_sel: np.ndarray,
+                    out: Optional[Dict[str, np.ndarray]],
+                    gen: Optional[np.ndarray] = None
+                    ) -> Optional[Dict[str, np.ndarray]]:
+        """One shard's slice of a stratified draw: tree sample + item
+        gather into the caller's preallocated batch rows, under the
+        shard's lock. The unit the ingest-side sampling service's
+        per-shard worker threads execute — extracting it is what PINS
+        the facade draw and the threaded draw to the same math.
+        ``gen`` (the sampling service's path) snapshots the drawn
+        slots' write generations UNDER THE SAME LOCK HOLD as the
+        gather, so a batch that waits in the pre-packed queue while
+        inserts overwrite its slots still fails the write-back
+        generation guard (reading generations at pop time would pick
+        up the overwriting item's stamp and defeat the guard)."""
+        if not rows.any():
+            return out
+        s = self.shards[s_id]
+        with self._locks[s_id]:
             idx = s.tree.sample(local_mass[rows])
             idx = np.minimum(idx, max(len(s), 1) - 1)
             p_sel[rows] = s.tree.get(idx) / max(T, 1e-300)
             idx_g[rows] = idx + s_id * self.shard_capacity
+            if gen is not None:
+                gen[rows] = s.generation(idx)
             if out is None:
                 out = {k: np.empty((batch_size,) + v.shape[1:], v.dtype)
                        for k, v in s._data.items()}
@@ -434,10 +470,7 @@ class ShardedPrioritizedReplay:
             s.sampled += n_rows
             s._c_sampled.inc(n_rows)
             s._g_mass.set(s.tree.total)
-        weights = (size * np.maximum(p_sel, 1e-12)) ** (-beta)
-        weights = (weights / weights.max()).astype(np.float32)
-        self.sampled += batch_size
-        return out, idx_g, weights
+        return out
 
     def generation(self, idx: np.ndarray) -> np.ndarray:
         idx = np.asarray(idx, np.int64)
@@ -446,8 +479,9 @@ class ShardedPrioritizedReplay:
         for s_id in range(self.num_shards):
             rows = shard_of == s_id
             if rows.any():
-                out[rows] = self.shards[s_id].generation(
-                    idx[rows] - s_id * self.shard_capacity)
+                with self._locks[s_id]:
+                    out[rows] = self.shards[s_id].generation(
+                        idx[rows] - s_id * self.shard_capacity)
         return out
 
     def update_priorities(self, idx: np.ndarray, priorities: np.ndarray,
@@ -462,10 +496,12 @@ class ShardedPrioritizedReplay:
             rows = shard_of == s_id
             if not rows.any():
                 continue
-            self.shards[s_id].update_priorities(
-                idx[rows] - s_id * self.shard_capacity, priorities[rows],
-                expected_gen=(None if expected_gen is None
-                              else np.asarray(expected_gen)[rows]))
+            with self._locks[s_id]:
+                self.shards[s_id].update_priorities(
+                    idx[rows] - s_id * self.shard_capacity,
+                    priorities[rows],
+                    expected_gen=(None if expected_gen is None
+                                  else np.asarray(expected_gen)[rows]))
 
     def state_dict(self) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {
@@ -493,6 +529,199 @@ class ShardedPrioritizedReplay:
                    if k.startswith(prefix)}
             if sub:
                 s.load_state_dict(sub)
+
+
+# ---------------------------------------------------------------------------
+# Ingest-side per-shard sampling (ISSUE 14 tentpole piece 3)
+# ---------------------------------------------------------------------------
+
+class ShardSamplerError(RuntimeError):
+    """A sampling thread died; re-raised on the learner thread at the
+    next ``sample`` (tombstone semantics, like EvacuationWorker)."""
+
+
+class ShardSampleService:
+    """Run the stratified draw + gather where the data lives: one
+    worker thread per replay shard plus a coordinator, handing the
+    learner PRE-PACKED batches through a bounded queue.
+
+    This is the ``SamplePrefetcher`` pattern (PR 5) moved from the
+    learner's thread to the shards' (ISSUE 14, arXiv:2110.13506): the
+    coordinator draws the ONE global stratified mass ladder from the
+    facade's rng, splits it by tree mass, and each shard's worker
+    executes ITS slice of :meth:`ShardedPrioritizedReplay._shard_draw`
+    — the exact function the facade's inline draw runs, under the same
+    per-shard lock — concurrently with the other shards and with the
+    service's inserts. With inserts quiesced, ``sample`` is therefore
+    BIT-IDENTICAL to ``replay.sample`` at batch parity (pinned by
+    tests/test_ingest_dedup.py); live, batches are drawn up to
+    ``depth`` train events ahead against the replay content of that
+    moment — the standard async-learner staleness the PR 5 prefetcher
+    documented, with priorities still written back through the
+    generation-guarded path.
+
+    ``beta`` rides each request, so a queued batch's IS exponent lags
+    the learner by at most ``depth`` draws (beta anneals over an entire
+    run; the lag is measurement noise). ``batch_size`` must stay
+    constant across a service's lifetime — the apex learner's is.
+    """
+
+    def __init__(self, replay: ShardedPrioritizedReplay, depth: int = 2,
+                 name: str = "apex"):
+        from dist_dqn_tpu.telemetry import collectors as tmc
+        from dist_dqn_tpu.telemetry import get_registry
+
+        self.replay = replay
+        self.depth = max(1, int(depth))
+        self._requests: "queue.Queue" = queue.Queue()
+        self._results: "queue.Queue" = queue.Queue()
+        self._tasks: List["queue.Queue"] = [
+            queue.Queue() for _ in range(replay.num_shards)]
+        self._done: "queue.Queue" = queue.Queue()
+        self._outstanding = 0
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        reg = get_registry()
+        self._h_draw = {
+            s_id: reg.histogram(
+                tmc.REPLAY_SHARD_SAMPLE_SECONDS,
+                "per-shard ingest-side stratified draw + gather wall",
+                labels={"shard": str(s_id)})
+            for s_id in range(replay.num_shards)}
+        self._h_wait = reg.histogram(
+            tmc.REPLAY_SHARD_SAMPLE_WAIT,
+            "learner wait on the pre-packed per-shard block queue")
+        self.batches = 0
+        self._workers = [
+            threading.Thread(target=self._shard_loop, args=(s_id,),
+                             name=f"{name}-shard-sampler-{s_id}",
+                             daemon=True)
+            for s_id in range(replay.num_shards)]
+        self._coord = threading.Thread(target=self._coord_loop,
+                                       name=f"{name}-sample-coord",
+                                       daemon=True)
+        for w in self._workers:
+            w.start()
+        self._coord.start()
+
+    # -- threads ------------------------------------------------------------
+    def _shard_loop(self, s_id: int) -> None:
+        h = self._h_draw[s_id]
+        while True:
+            task = self._tasks[s_id].get()
+            if task is None:
+                return
+            rows, local_mass, T, batch, idx_g, p_sel, out, gen = task
+            t0 = time.perf_counter()
+            try:
+                self.replay._shard_draw(s_id, rows, local_mass, T, batch,
+                                        idx_g, p_sel, out, gen=gen)
+                h.observe(time.perf_counter() - t0)
+                self._done.put(None)
+            except BaseException as e:  # noqa: BLE001 — tombstoned
+                self._done.put(e)
+
+    def _coord_loop(self) -> None:
+        replay = self.replay
+        while True:
+            req = self._requests.get()
+            if req is None:
+                return
+            batch, beta = req
+            try:
+                size = len(replay)
+                if size == 0:
+                    raise ValueError("sample() on an empty replay shard")
+                totals = np.array([s.tree.total for s in replay.shards],
+                                  np.float64)
+                T = float(totals.sum())
+                mass = stratified_mass(replay._rng, batch, T)
+                shard_of, local_mass = _map_mass_to_shards(mass, totals)
+                idx_g = np.empty(batch, np.int64)
+                p_sel = np.empty(batch, np.float64)
+                gen = np.empty(batch, np.int64)
+                # Pre-allocate the packed batch from the first shard
+                # that holds data (the facade allocates lazily inside
+                # its serial loop; workers run concurrently, so the
+                # buffer must exist before dispatch).
+                out = None
+                for s in replay.shards:
+                    if s._data is not None:
+                        out = {k: np.empty((batch,) + v.shape[1:],
+                                           v.dtype)
+                               for k, v in s._data.items()}
+                        break
+                if out is None:
+                    raise ValueError(
+                        "sample() before any shard holds data")
+                active = 0
+                for s_id in range(replay.num_shards):
+                    rows = shard_of == s_id
+                    if not rows.any():
+                        continue
+                    self._tasks[s_id].put((rows, local_mass, T, batch,
+                                           idx_g, p_sel, out, gen))
+                    active += 1
+                errs = []
+                for _ in range(active):
+                    e = self._done.get()
+                    if e is not None:
+                        errs.append(e)
+                if errs:
+                    raise errs[0]
+                weights = (size * np.maximum(p_sel, 1e-12)) ** (-beta)
+                weights = (weights / weights.max()).astype(np.float32)
+                replay.sampled += batch
+                self._results.put((out, idx_g, weights, gen))
+            except BaseException as e:  # noqa: BLE001 — tombstoned
+                self._results.put(e)
+                return
+
+    # -- learner API --------------------------------------------------------
+    def sample(self, batch_size: int, beta: float):
+        """-> (items, idx, weights, generations): posts requests to
+        keep up to ``depth`` pre-packed batches in flight and pops the
+        oldest completed one (blocking only when the shard workers are
+        behind — the residual wait the telemetry histogram records).
+        Generations were snapshotted at DRAW time under the shard
+        locks, so the learner's deferred priority write-backs keep
+        their overwrite guard despite the queue delay."""
+        if self._err is not None:
+            raise ShardSamplerError(
+                f"shard sampling service died: {self._err!r}") \
+                from self._err
+        while self._outstanding < self.depth:
+            self._requests.put((int(batch_size), float(beta)))
+            self._outstanding += 1
+        t0 = time.perf_counter()
+        while True:
+            try:
+                res = self._results.get(timeout=5.0)
+                break
+            except queue.Empty:
+                if not self._coord.is_alive():
+                    self._err = ShardSamplerError(
+                        "sample coordinator thread died silently")
+                    raise self._err
+        self._outstanding -= 1
+        self._h_wait.observe(time.perf_counter() - t0)
+        if isinstance(res, BaseException):
+            self._err = res
+            raise ShardSamplerError(
+                f"shard sampling failed: {res!r}") from res
+        self.batches += 1
+        return res
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._requests.put(None)
+        for q in self._tasks:
+            q.put(None)
+        self._coord.join(timeout=5)
+        for w in self._workers:
+            w.join(timeout=5)
 
 
 # ---------------------------------------------------------------------------

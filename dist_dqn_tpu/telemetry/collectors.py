@@ -156,6 +156,25 @@ INGEST_SHM_TORN = "dqn_ingest_shm_torn_reads_total"
 INGEST_ACTOR_PRIO_TRANSITIONS = \
     "dqn_ingest_actor_priority_transitions_total"
 
+# Near-data experience plane (ISSUE 14): DEDUP_FRAMES_REUSED counts
+# frame-stack slots served by back-references into the per-lane frame
+# ring instead of wire bytes, DEDUP_BYTES_SAVED the wire bytes those
+# references avoided (vs the undeduped zero-copy layout, tables
+# already netted out); SHM_BATCH_FANIN is records per slot publish
+# (1 = the unbatched lock-step actor path); SHARD_SAMPLE_SECONDS is
+# the per-{shard} ingest-side stratified-draw + gather wall and
+# SHARD_SAMPLE_WAIT the learner's residual wait on the pre-packed
+# block queue (near zero when the per-shard samplers keep ahead).
+INGEST_DEDUP_FRAMES_REUSED = "dqn_ingest_dedup_frames_reused_total"
+INGEST_DEDUP_BYTES_SAVED = "dqn_ingest_dedup_bytes_saved_total"
+INGEST_SHM_BATCH_FANIN = "dqn_ingest_shm_batch_fanin"
+REPLAY_SHARD_SAMPLE_SECONDS = "dqn_replay_shard_sample_seconds"
+REPLAY_SHARD_SAMPLE_WAIT = "dqn_replay_shard_sample_wait_seconds"
+
+#: Slot-publish fan-in buckets: a feeder batch is bounded by slot
+#: sizing well below the act-dispatch fan-ins FANIN_BUCKETS covers.
+SHM_FANIN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
 # Flight recorder / stall watchdog / crash forensics (ISSUE 4): stage
 # heartbeats are labeled {stage="host_replay.collect"|"apex.ingest"|...}
 # (the full stage table is in docs/observability.md), divergence trips

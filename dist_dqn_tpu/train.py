@@ -593,6 +593,28 @@ def main():
                              "recurrent config) for per-actor insert "
                              "attribution, and the host tree sampler "
                              "(no --device-sampling)")
+    parser.add_argument("--no-wire-dedup", action="store_true",
+                        help="apex runtime (ISSUE 14): disable the "
+                             "frame-stack dedup wire plane — actors on "
+                             "frame-stacked pixel envs then ship full "
+                             "stacks on the plain zero-copy layout "
+                             "(the dedup-off A/B arm)")
+    parser.add_argument("--shm-batch", type=int, default=1,
+                        help="apex runtime (ISSUE 14): feeder processes "
+                             "coalesce this many step records into one "
+                             "seqlock slot publish (amortizes the "
+                             "publish/consume handshake for unthrottled "
+                             "producers; 1 = bit-pinned per-record "
+                             "publishes; rollout actors are lock-step "
+                             "and unaffected)")
+    parser.add_argument("--shard-sampling", action="store_true",
+                        help="apex runtime (ISSUE 14, requires "
+                             "--ingest-shards > 1): run the stratified "
+                             "draw + gather in per-shard worker threads "
+                             "and hand the learner pre-packed batches "
+                             "through a bounded queue — train events "
+                             "stop paying sample time on the learner "
+                             "thread")
     parser.add_argument("--remote-actor-mode", choices=("local", "external"),
                         default="local",
                         help="local: the service spawns its remote actors "
@@ -798,14 +820,20 @@ def main():
             transport=args.transport,
             actor_priorities=not args.no_actor_priorities,
             ingest_shards=args.ingest_shards,
+            wire_dedup=not args.no_wire_dedup,
+            shm_batch=args.shm_batch,
+            shard_sampling=args.shard_sampling,
             telemetry_port=args.telemetry_port,
             telemetry_host=args.telemetry_host)
         print(json.dumps(run_apex(cfg, rt)))
         return
     if args.transport != parser.get_default("transport") \
             or args.no_actor_priorities \
-            or args.ingest_shards != parser.get_default("ingest_shards"):
-        print("# --transport/--no-actor-priorities/--ingest-shards apply "
+            or args.ingest_shards != parser.get_default("ingest_shards") \
+            or args.no_wire_dedup or args.shard_sampling \
+            or args.shm_batch != parser.get_default("shm_batch"):
+        print("# --transport/--no-actor-priorities/--ingest-shards/"
+              "--no-wire-dedup/--shm-batch/--shard-sampling apply "
               "to --runtime apex only (the fused/host-replay runtimes "
               "have no actor transport); ignored")
     if args.no_double_buffer:

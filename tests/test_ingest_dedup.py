@@ -1,0 +1,699 @@
+"""Near-data experience plane (ISSUE 14): frame-dedup wire codec,
+batched shm slot publishes, ingest-side per-shard sampling.
+
+The load-bearing pins:
+
+* BIT-EXACTNESS — a frame-stacked stream encoded on the dedup plane
+  (both the trusting default encoder and the hash-everything verify
+  encoder) decodes byte-identical to the source arrays, through resets
+  and truncations, exactly like the undeduped zero-copy codec.
+* REJECT WHOLE + RE-HELLO RECOVERY — a lost/corrupted record breaks the
+  dedup chain: every subsequent record rejects (``WireFormatError``, a
+  back-reference can never be bridged silently) until a fresh hello
+  rebuilds both ends, after which decoding is bit-exact again.
+* BATCH SEQLOCK DISCIPLINE — batched slot publishes survive wraparound
+  and a concurrent hammer in order; a torn batched publish drops the
+  WHOLE batch (one seqlock covers one slot), never partially delivers.
+* SHARD-SAMPLING EQUIVALENCE — the per-shard sampling service's draws
+  are bit-identical to the facade's inline draw at batch parity.
+* END TO END — real actor processes negotiate dedup against a stacked
+  env and the apex service reconstructs stacks at append time with zero
+  decode errors; per-shard sampling trains an apex run.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu import chaos, ingest
+from dist_dqn_tpu.config import CONFIGS
+
+LANES, H, W, FS = 4, 12, 10, 4
+
+
+class _StackedStream:
+    """Synthetic frame-stacked vector-env stream honoring the
+    HostVectorEnv contract the dedup encoder's default mode trusts:
+    ``next_obs`` = previous acted-on stack shifted by one novel frame
+    (also at episode ends — the true pre-reset successor), ``obs`` ==
+    ``next_obs`` on non-done lanes and a repeated-frame reset stack on
+    done lanes."""
+
+    def __init__(self, seed: int, lanes: int = LANES, h: int = H,
+                 w: int = W, fs: int = FS, p_done: float = 0.0):
+        self.rng = np.random.default_rng(seed)
+        self.lanes, self.h, self.w, self.fs = lanes, h, w, fs
+        self.p_done = p_done
+        self.stacks = np.stack([self._reset_stack()
+                                for _ in range(lanes)])
+
+    def _frame(self):
+        return self.rng.integers(0, 256, (self.h, self.w)
+                                 ).astype(np.uint8)
+
+    def _reset_stack(self):
+        return np.repeat(self._frame()[:, :, None], self.fs, axis=2)
+
+    def step(self):
+        nxt = np.concatenate(
+            [self.stacks[:, :, :, 1:],
+             np.stack([self._frame() for _ in range(self.lanes)]
+                      )[:, :, :, None]], axis=3)
+        done = self.rng.random(self.lanes) < self.p_done
+        term = done & (self.rng.random(self.lanes) < 0.7)
+        trunc = done & ~term
+        obs = nxt.copy()
+        for lane in np.nonzero(done)[0]:
+            obs[lane] = self._reset_stack()
+        self.stacks = obs
+        return {"obs": obs,
+                "reward": self.rng.normal(size=self.lanes
+                                          ).astype(np.float32),
+                "terminated": term.astype(np.uint8),
+                "truncated": trunc.astype(np.uint8),
+                "next_obs": nxt}
+
+
+def _schema(lanes=LANES, h=H, w=W, fs=FS):
+    return ingest.step_schema((h, w, fs), np.uint8, lanes)
+
+
+# ---------------------------------------------------------------------------
+# Dedup codec: bit-exactness, savings, negotiation gates
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("verify", [False, True])
+def test_dedup_roundtrip_bit_exact_through_resets(verify):
+    """THE acceptance pin: dedup decode == source arrays, byte for
+    byte, across steady stretches, terminations, truncations and the
+    decoder's rolling-history wraparound — for both the contract-
+    trusting default encoder and the hash-everything verify encoder."""
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS, verify=verify)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    plain = ingest.StepEncoder(schema)
+    pdec = ingest.StepDecoder(schema)
+    st = _StackedStream(1, p_done=0.15)
+    for t in range(150):
+        arrays = st.step()
+        q = st.rng.normal(size=LANES).astype(np.float32)
+        out, meta = dec.decode(bytes(enc.encode_step(
+            arrays, actor=3, t=t + 1, shard=1, q_sel=q, q_max=q + 1)))
+        ref, _ = pdec.decode(bytes(plain.encode_step(
+            arrays, actor=3, t=t + 1, shard=1, q_sel=q, q_max=q + 1)))
+        for k in arrays:
+            assert np.array_equal(out[k], arrays[k]), (t, k)
+            assert out[k].tobytes() == ref[k].tobytes(), (t, k)
+            assert out[k].dtype == ref[k].dtype
+            assert out[k].shape == ref[k].shape
+        assert np.array_equal(meta["q_sel"], q)
+        assert np.array_equal(meta["q_max"], q + 1)
+        assert (meta["actor"], meta["t"], meta["shard"]) == (3, t + 1, 1)
+    assert dec.records_general > 0
+    if verify:
+        # The paranoid encoder never emits the canonical shorthand —
+        # every record carries explicit refs, decoded identically.
+        assert dec.records_canon == 0
+    else:
+        assert dec.records_canon > 0
+
+
+def test_dedup_ships_fraction_of_plain_bytes():
+    """Steady-state canonical records carry ONE novel frame per lane
+    (obs == next_obs dedups too): ~2*frame_stack-fold fewer bytes than
+    the undeduped layout, tracked by the decoder's savings counters."""
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    plain = ingest.StepEncoder(schema)
+    st = _StackedStream(2)
+    dedup_bytes = plain_bytes = 0
+    for t in range(50):
+        arrays = st.step()
+        p = bytes(enc.encode_step(arrays, actor=0, t=t + 1))
+        dedup_bytes += len(p)
+        plain_bytes += len(bytes(plain.encode_step(arrays, actor=0,
+                                                   t=t + 1)))
+        dec.decode(p)
+    assert dedup_bytes * 4 < plain_bytes       # >4x on a 4-stack
+    assert dec.frames_reused >= 49 * (2 * FS - 1) * LANES
+    assert dec.bytes_saved == plain_bytes - dedup_bytes
+
+
+def test_dedup_negotiation_declines_vector_and_mismatched_schemas():
+    """The capability gate: vector obs (no frame axis) and mismatched
+    stack declarations refuse dedup — at schema validation and at the
+    actor's negotiation probe alike."""
+    from dist_dqn_tpu.actors.actor import _negotiate_dedup
+
+    vec = ingest.step_schema((4,), np.float32, 4)
+    with pytest.raises(ValueError):
+        ingest.validate_dedup_stack(vec, 4)
+    with pytest.raises(ValueError):
+        ingest.validate_dedup_stack(_schema(), FS + 1)   # wrong depth
+    with pytest.raises(ValueError):
+        ingest.validate_dedup_stack(_schema(), 1)        # no stack
+
+    class _Env:
+        frame_stack = 0
+
+    obs = np.zeros((4, 4), np.float32)
+    assert _negotiate_dedup(_Env(), obs, "zerocopy", True) == 0
+    _Env.frame_stack = FS
+    pix = np.zeros((4, H, W, FS), np.uint8)
+    assert _negotiate_dedup(_Env(), pix, "zerocopy", True) == FS
+    assert _negotiate_dedup(_Env(), pix, "zerocopy", False) == 0
+    assert _negotiate_dedup(_Env(), pix, "legacy", True) == 0
+
+
+def test_dedup_chain_break_rejects_whole_until_rehello():
+    """Drop one record mid-stream: every subsequent record must reject
+    (the ``t`` continuity guard — a back-reference can never bridge a
+    gap silently), and a fresh hello (new decoder + encoder.reset)
+    recovers bit-exact decoding."""
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    st = _StackedStream(3)
+    recs = []
+    for t in range(8):
+        recs.append((bytes(enc.encode_step(st.step(), actor=0, t=t + 1)),
+                     None))
+    dec.decode(recs[0][0])
+    dec.decode(recs[1][0])
+    # record 3 (index 2) lost in transit:
+    with pytest.raises(ingest.WireFormatError):
+        dec.decode(recs[3][0])
+    with pytest.raises(ingest.WireFormatError):
+        dec.decode(recs[4][0])                 # stays broken
+    # Re-hello: both ends restart their chains.
+    enc.reset()
+    dec2 = ingest.DedupStepDecoder(schema, FS, t0=10)
+    arrays = st.step()
+    out, _ = dec2.decode(bytes(enc.encode_step(arrays, actor=0, t=11)))
+    for k in arrays:
+        assert np.array_equal(out[k], arrays[k])
+
+
+def test_dedup_backref_miss_rejects_whole():
+    """A general record referencing an id the ring never shipped is a
+    stream desync: rejected whole, decoder state untouched."""
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS, verify=True)  # explicit refs
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    st = _StackedStream(4)
+    p1 = bytearray(enc.encode_step(st.step(), actor=0, t=1))
+    # Forge one obs back-reference to a never-shipped id.
+    table_off = dec.lay.body_off(False)
+    p1[table_off:table_off + 4] = (10 ** 6).to_bytes(4, "little")
+    with pytest.raises(ingest.WireFormatError, match="back-reference"):
+        dec.decode(bytes(p1))
+
+
+def test_dedup_canon_before_seed_and_flag_mismatch_reject():
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    st = _StackedStream(5)
+    seed = bytes(enc.encode_step(st.step(), actor=0, t=1))
+    canon = bytes(enc.encode_step(st.step(), actor=0, t=2))
+    assert ingest.peek_header(canon)["flags"] & ingest.FLAG_DEDUP_CANON
+    with pytest.raises(ingest.WireFormatError, match="seeding"):
+        dec.decode(canon)                       # canonical before seed
+    dec.decode(seed)
+    dec.decode(canon)                           # in order: fine
+    # A dedup frame at a non-dedup decoder rejects (and vice versa).
+    with pytest.raises(ingest.WireFormatError, match="dedup"):
+        ingest.StepDecoder(schema).decode(seed)
+    plain = bytes(ingest.StepEncoder(schema).encode_step(
+        st.step(), actor=0, t=3))
+    with pytest.raises(ingest.WireFormatError, match="dedup"):
+        ingest.DedupStepDecoder(schema, FS).decode(plain)
+
+
+def test_dedup_chaos_bit_flip_rejects_then_rehello_recovers():
+    """Chaos ``ingest.decode: bit_flip`` on a dedup stream: the
+    corrupted record rejects whole, the chain stays broken (honest —
+    dedup records are not independently decodable), and the re-hello
+    path recovers with the trip closed."""
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    st = _StackedStream(6)
+    plan = chaos.FaultPlan(seed=2, events=(
+        chaos.FaultEvent("ingest.decode", "bit_flip", at_hit=2,
+                         args={"bit": 0}),))     # flips the ZC magic
+    with chaos.installed(plan) as inj:
+        dec.decode(bytes(enc.encode_step(st.step(), actor=0, t=1)))
+        with pytest.raises(ingest.WireFormatError):
+            dec.decode(bytes(enc.encode_step(st.step(), actor=0, t=2)))
+        with pytest.raises(ingest.WireFormatError):
+            dec.decode(bytes(enc.encode_step(st.step(), actor=0, t=3)))
+        # Recovery = the NACK-driven reconnect + re-hello (transport
+        # layer): fresh chain on both ends.
+        enc.reset()
+        dec = ingest.DedupStepDecoder(schema, FS, t0=3)
+        arrays = st.step()
+        out, _ = dec.decode(bytes(enc.encode_step(arrays, actor=0, t=4)))
+        for k in arrays:
+            assert np.array_equal(out[k], arrays[k])
+        assert [e["fault"] for e in inj.injected] == ["bit_flip"]
+        assert "ingest.decode" not in inj.open_trips()
+
+
+def test_dedup_view_lifetime_bound():
+    """Decoded stacks are views into the rolling history: they must
+    stay intact for at least ``history - 2 * frame_stack`` further
+    decodes (the service sizes history from the assembler's hold)."""
+    schema = _schema()
+    enc = ingest.DedupStepEncoder(schema, FS)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0, history=24)
+    st = _StackedStream(7)
+    held = []
+    for t in range(60):
+        arrays = st.step()
+        out, _ = dec.decode(bytes(enc.encode_step(arrays, actor=0,
+                                                  t=t + 1)))
+        held.append((t, out["obs"], arrays["obs"].copy()))
+        horizon = 24 - 2 * FS
+        for ht, view, copy in held[-min(len(held), 8):]:
+            if t - ht <= horizon - 8:
+                assert np.array_equal(view, copy), (t, ht)
+
+
+# ---------------------------------------------------------------------------
+# Batched shm slot publishes
+# ---------------------------------------------------------------------------
+
+def test_shm_push_batch_wraparound_order_and_sizing():
+    ring = ingest.ShmSlotRing("t_dd_batch", slot_size=256, nslots=4,
+                              create=True)
+    try:
+        rng = np.random.default_rng(0)
+        msgs = [bytes([i]) * (i % 40 + 1) for i in range(80)]
+        out, i = [], 0
+        while i < len(msgs):
+            take = int(rng.integers(1, 6))
+            if ring.push_batch(msgs[i:i + take]):
+                i += take
+            got = ring.pop()
+            if got is not None:
+                out.append(got)
+        while len(out) < len(msgs):
+            got = ring.pop()
+            assert got is not None
+            out.append(got)
+        assert out == msgs
+        assert ring.pop() is None and ring.pending == 0
+        with pytest.raises(ValueError):
+            ring.push_batch([b"x" * 200, b"y" * 200])   # over slot_size
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_push_batch_torn_drops_whole_batch():
+    """One seqlock covers one slot: a torn batched publish can never
+    deliver a partial batch — all records dropped, counted once."""
+    plan = chaos.FaultPlan(seed=1, events=(
+        chaos.FaultEvent("shm.publish", "torn", at_hit=2),))
+    ring = ingest.ShmSlotRing("t_dd_torn", slot_size=128, nslots=4,
+                              create=True)
+    try:
+        with chaos.installed(plan) as inj:
+            assert ring.push_batch([b"a1", b"a2"])
+            assert ring.push_batch([b"b1", b"b2", b"b3"])   # torn whole
+            assert ring.push_batch([b"c1"])
+            got = [ring.pop() for _ in range(8)]
+            assert [g for g in got if g is not None] == \
+                [b"a1", b"a2", b"c1"]
+            assert ring.torn_reads == 1
+            assert "shm.publish" not in inj.open_trips()
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_push_batch_concurrent_hammer():
+    """SPSC hammer with mixed batch sizes across attach boundaries and
+    many wraparounds: every record once, in order, bit-intact."""
+    rng = np.random.default_rng(6)
+    ring = ingest.ShmSlotRing("t_dd_hammer", slot_size=2048, nslots=8,
+                              create=True)
+    att = ingest.ShmSlotRing("t_dd_hammer")
+    msgs = [rng.integers(0, 256, rng.integers(1, 300)).astype(np.uint8)
+            .tobytes() for _ in range(3000)]
+    try:
+        def produce():
+            i = 0
+            g = np.random.default_rng(1)
+            while i < len(msgs):
+                take = int(g.integers(1, 6))
+                batch = msgs[i:i + take]
+                att.push_batch_wait(batch, poll_s=0.0)
+                i += len(batch)
+
+        th = threading.Thread(target=produce, daemon=True,
+                              name="dd-hammer-producer")
+        th.start()
+        got = []
+        while len(got) < len(msgs):
+            b = ring.pop()
+            if b is not None:
+                got.append(b)
+        th.join(timeout=10)
+        assert got == msgs
+        assert ring.torn_reads == 0
+    finally:
+        att.close()
+        ring.close()
+        ring.unlink()
+
+
+# ---------------------------------------------------------------------------
+# Ingest-side per-shard sampling
+# ---------------------------------------------------------------------------
+
+def _filled_sharded_replay(seed=0):
+    from dist_dqn_tpu.replay.sharded import ShardedPrioritizedReplay
+
+    r = ShardedPrioritizedReplay(3, 300, alpha=0.6, seed=seed)
+    rng = np.random.default_rng(7)
+    for _ in range(40):
+        n = int(rng.integers(1, 9))
+        items = {"obs": rng.normal(size=(n, 4)).astype(np.float32),
+                 "action": rng.integers(0, 2, n).astype(np.int32),
+                 "reward": rng.normal(size=n).astype(np.float32),
+                 "discount": np.full(n, 0.99, np.float32),
+                 "next_obs": rng.normal(size=(n, 4)).astype(np.float32)}
+        r.add(items, priorities=rng.random(n) + 0.1,
+              shard=int(rng.integers(0, 3)))
+    return r
+
+
+def test_shard_sampling_bit_identical_to_facade_draw():
+    """THE equivalence pin: with inserts quiesced, the per-shard
+    sampling service's (items, idx, weights) sequence equals the
+    facade's inline draw bit for bit at batch parity — same rng stream,
+    same per-shard draw function, same IS math."""
+    from dist_dqn_tpu.replay.sharded import ShardSampleService
+
+    facade = _filled_sharded_replay()
+    threaded = _filled_sharded_replay()
+    svc = ShardSampleService(threaded, depth=1)
+    try:
+        for k in range(10):
+            ia, xa, wa = facade.sample(32, 0.5)
+            ib, xb, wb, gb = svc.sample(32, 0.5)
+            assert np.array_equal(xa, xb), k
+            assert np.array_equal(wa, wb), k
+            # Generations were snapshotted at draw time under the
+            # shard locks — quiesced, they equal the facade's read.
+            assert np.array_equal(gb, facade.generation(xa)), k
+            for key in ia:
+                assert ia[key].tobytes() == ib[key].tobytes(), (k, key)
+        assert facade.sampled == threaded.sampled
+    finally:
+        svc.close()
+
+
+def test_shard_sampling_error_tombstones():
+    from dist_dqn_tpu.replay.sharded import (ShardedPrioritizedReplay,
+                                             ShardSamplerError,
+                                             ShardSampleService)
+
+    svc = ShardSampleService(ShardedPrioritizedReplay(2, 100), depth=1)
+    try:
+        with pytest.raises(ShardSamplerError):
+            svc.sample(8, 0.5)                  # empty replay: loud
+        with pytest.raises(ShardSamplerError):
+            svc.sample(8, 0.5)                  # latched, still loud
+    finally:
+        svc.close()
+
+
+def test_shard_sampling_under_concurrent_inserts():
+    """Liveness + shape sanity under live inserts (the production
+    interleaving): per-shard locks serialize each shard's draw against
+    the service thread's adds."""
+    from dist_dqn_tpu.replay.sharded import ShardSampleService
+
+    r = _filled_sharded_replay()
+    svc = ShardSampleService(r, depth=2)
+    stop = threading.Event()
+
+    def adder():
+        rng = np.random.default_rng(1)
+        while not stop.is_set():
+            n = 4
+            items = {"obs": rng.normal(size=(n, 4)).astype(np.float32),
+                     "action": rng.integers(0, 2, n).astype(np.int32),
+                     "reward": rng.normal(size=n).astype(np.float32),
+                     "discount": np.full(n, 0.99, np.float32),
+                     "next_obs": rng.normal(size=(n, 4)
+                                            ).astype(np.float32)}
+            r.add(items, priorities=rng.random(n) + 0.1,
+                  shard=int(rng.integers(0, 3)))
+
+    th = threading.Thread(target=adder, name="dd-adder", daemon=True)
+    th.start()
+    try:
+        for _ in range(100):
+            items, idx, w, gen = svc.sample(32, 0.4)
+            assert idx.shape == (32,) and w.shape == (32,)
+            assert gen.shape == (32,)
+            assert np.all(idx >= 0) and np.all(idx < 3 * r.shard_capacity)
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        svc.close()
+
+
+def test_shard_sampling_generation_snapshotted_at_draw_time():
+    """The write-back overwrite guard survives the queue delay: a slot
+    overwritten AFTER the draw but BEFORE the learner pops the batch
+    must carry its draw-time generation, so update_priorities with
+    expected_gen drops the stale row instead of stamping the new
+    item."""
+    from dist_dqn_tpu.replay.sharded import ShardSampleService
+
+    r = _filled_sharded_replay()
+    svc = ShardSampleService(r, depth=1)
+    try:
+        items, idx, w, gen = svc.sample(32, 0.5)   # drawn now
+        # Overwrite every shard's slots wholesale (capacity churn).
+        rng = np.random.default_rng(9)
+        for _ in range(200):
+            n = 8
+            batch = {"obs": rng.normal(size=(n, 4)).astype(np.float32),
+                     "action": rng.integers(0, 2, n).astype(np.int32),
+                     "reward": rng.normal(size=n).astype(np.float32),
+                     "discount": np.full(n, 0.99, np.float32),
+                     "next_obs": rng.normal(size=(n, 4)
+                                            ).astype(np.float32)}
+            r.add(batch, priorities=rng.random(n) + 0.1,
+                  shard=int(rng.integers(0, 3)))
+        # Every sampled slot has been overwritten: its live generation
+        # moved past the snapshot, so the guard must drop ALL rows.
+        assert not np.array_equal(gen, r.generation(idx))
+        before = [s.tree.get(np.arange(s.capacity, dtype=np.int64))
+                  for s in r.shards]
+        r.update_priorities(idx, np.full(32, 1e6), expected_gen=gen)
+        after = [s.tree.get(np.arange(s.capacity, dtype=np.int64))
+                 for s in r.shards]
+        for b, a in zip(before, after):
+            assert np.array_equal(b, a)     # nothing stamped
+    finally:
+        svc.close()
+
+
+def test_dedup_blinking_screen_keeps_id_chain_sound():
+    """Regression: a boundary record whose newest frame content-
+    matches an OLDER frame in the same stack (blinking screen at a
+    re-hello) must not desync the canonical implied-id arithmetic —
+    the encoder re-ships the top frame under a fresh id."""
+    schema = _schema(lanes=1)
+    enc = ingest.DedupStepEncoder(schema, FS, verify=True)
+    dec = ingest.DedupStepDecoder(schema, FS, t0=0)
+    rng = np.random.default_rng(11)
+    a = rng.integers(0, 256, (H, W)).astype(np.uint8)
+    b = rng.integers(0, 256, (H, W)).astype(np.uint8)
+    # Stack [A, B, B, A]: top matches slot 0, allocated before B.
+    stack = np.stack([a, b, b, a], axis=-1)[None]
+    arrays = {"obs": stack, "reward": np.zeros(1, np.float32),
+              "terminated": np.zeros(1, np.uint8),
+              "truncated": np.zeros(1, np.uint8), "next_obs": stack}
+    out, _ = dec.decode(bytes(enc.encode_step(arrays, actor=0, t=1)))
+    assert np.array_equal(out["obs"], stack)
+    # Continue the stream through a steady stretch (the default
+    # encoder's canonical records must resolve against a sound chain).
+    enc2 = ingest.DedupStepEncoder(schema, FS)
+    dec2 = ingest.DedupStepDecoder(schema, FS, t0=0)
+    prev = stack
+    for t in range(1, 12):
+        f = rng.integers(0, 256, (1, H, W, 1)).astype(np.uint8)
+        nxt = np.concatenate([prev[:, :, :, 1:], f], axis=3)
+        arrays = {"obs": nxt, "reward": np.zeros(1, np.float32),
+                  "terminated": np.zeros(1, np.uint8),
+                  "truncated": np.zeros(1, np.uint8), "next_obs": nxt}
+        out, _ = dec2.decode(bytes(enc2.encode_step(arrays, actor=0,
+                                                    t=t)))
+        assert np.array_equal(out["obs"], nxt), t
+        prev = nxt
+
+
+# ---------------------------------------------------------------------------
+# Synthetic stacked env contract (what the default encoder trusts)
+# ---------------------------------------------------------------------------
+
+def test_synthstack_env_honors_dedup_stream_contract():
+    """The adapter-contract pin behind the default (non-verify) dedup
+    encoder: obs == next_obs on non-done lanes, next_obs = shift by one
+    frame, reset stacks repeat one frame — checked on the REAL
+    HostVectorEnv wrapping, and cross-checked by the verify encoder
+    producing an identical decode."""
+    from dist_dqn_tpu.envs.gym_adapter import make_host_env
+
+    env = make_host_env("synthstack", 3, seed=5)
+    assert env.frame_stack == 4
+    obs = env.reset()
+    schema = ingest.step_schema(obs.shape[1:], obs.dtype, 3)
+    enc = ingest.DedupStepEncoder(schema, 4)
+    dec = ingest.DedupStepDecoder(schema, 4, t0=0)
+    rng = np.random.default_rng(0)
+    prev = obs
+    for t in range(300):
+        actions = rng.integers(0, 4, 3)
+        obs, nxt, reward, term, trunc = env.step(actions)
+        done = np.logical_or(term, trunc)
+        # Contract assertions on the raw adapter output.
+        assert np.array_equal(nxt[:, :, :, :-1], prev[:, :, :, 1:])
+        for lane in range(3):
+            if not done[lane]:
+                assert np.array_equal(obs[lane], nxt[lane])
+            else:
+                assert np.array_equal(
+                    obs[lane],
+                    np.repeat(obs[lane][:, :, :1], 4, axis=2))
+        arrays = {"obs": obs, "reward": np.asarray(reward, np.float32),
+                  "terminated": term.astype(np.uint8),
+                  "truncated": trunc.astype(np.uint8), "next_obs": nxt}
+        out, _ = dec.decode(bytes(enc.encode_step(arrays, actor=0,
+                                                  t=t + 1)))
+        for k in arrays:
+            assert np.array_equal(out[k], arrays[k]), (t, k)
+        prev = obs
+
+
+# ---------------------------------------------------------------------------
+# End-to-end acceptance pins (apex service on CPU)
+# ---------------------------------------------------------------------------
+
+def _tiny_apex_cfg():
+    cfg = CONFIGS["apex"]
+    return dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096,
+                                   min_fill=200),
+        learner=dataclasses.replace(cfg.learner, batch_size=32),
+    )
+
+
+def test_apex_dedup_e2e_synthstack():
+    """ISSUE 14 acceptance: real actor processes negotiate frame dedup
+    against a stacked pixel env, the service reconstructs full stacks
+    at append time in the drain, experience trains, and the savings
+    counters prove frames actually travelled as back-references."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+
+    rt = ApexRuntimeConfig(host_env="synthstack", num_actors=2,
+                           envs_per_actor=4, total_env_steps=1200,
+                           inserts_per_grad_step=64)
+    res = run_apex(_tiny_apex_cfg(), rt, log_fn=lambda s: None)
+    assert res["transport"] == "zerocopy"
+    assert res["bad_records"] == 0
+    assert res["ingest_decode_errors"] == 0
+    assert res["grad_steps"] >= 5
+    assert res["replay_size"] > 400
+    assert res["dedup_frames_reused"] > 0
+    assert res["dedup_bytes_saved"] > res["bytes_on_wire"]
+    # Dedup keeps the zero-bootstrap-dispatch property (ISSUE 9 pin).
+    assert "bootstrap" not in res["device_calls"]
+
+
+def test_apex_dedup_off_is_plain_zerocopy():
+    """--no-wire-dedup: same env, plain zero-copy records — the dedup-
+    off A/B arm, with the savings counters honestly zero."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+
+    rt = ApexRuntimeConfig(host_env="synthstack", num_actors=2,
+                           envs_per_actor=4, total_env_steps=800,
+                           inserts_per_grad_step=64, wire_dedup=False)
+    res = run_apex(_tiny_apex_cfg(), rt, log_fn=lambda s: None)
+    assert res["bad_records"] == 0
+    assert res["ingest_decode_errors"] == 0
+    assert res["dedup_frames_reused"] == 0
+    assert res["dedup_bytes_saved"] == 0
+
+
+def test_apex_shard_sampling_e2e():
+    """Per-shard sampling carries a sharded apex run end to end: every
+    train batch came off the pre-packed block queue."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=3,
+                           envs_per_actor=4, total_env_steps=1200,
+                           inserts_per_grad_step=64, ingest_shards=2,
+                           shard_sampling=True)
+    res = run_apex(_tiny_apex_cfg(), rt, log_fn=lambda s: None)
+    assert res["shard_sampling"] is True
+    assert res["grad_steps"] >= 5
+    assert res["shard_sample_batches"] >= res["grad_steps"]
+    assert res["bad_records"] == 0
+
+
+def test_shard_sampling_requires_sharded_store():
+    from dist_dqn_tpu.actors.service import (ApexLearnerService,
+                                             ApexRuntimeConfig)
+
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", shard_sampling=True)
+    with pytest.raises(ValueError, match="ingest_shards"):
+        ApexLearnerService(_tiny_apex_cfg(), rt, log_fn=lambda s: None)
+
+
+def test_dedup_ab_bench_smoke():
+    """apex_feeder_bench --ab pixel arms at pytest size: the dedup
+    plane ships FEWER bytes than the undeduped zero-copy layout (the
+    tier-1 byte assertion — deterministic) and decodes for a fraction
+    of the legacy codec's CPU; the savings counters ride the rows."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "benchmarks"))
+    from apex_feeder_bench import _transport_ab
+
+    rows = _transport_ab("pixel", records=40, lanes=4)
+    by_arm = {r["arm"]: r for r in rows}
+    assert set(by_arm) == {"legacy", "zerocopy", "shm", "shm_batched",
+                           "dedup", "shm_dedup"}
+    # THE byte pin: pixel dedup < undeduped zerocopy on the wire.
+    assert by_arm["dedup"]["bytes_on_wire"] * 3 < \
+        by_arm["zerocopy"]["bytes_on_wire"]
+    assert by_arm["dedup"]["bytes_on_wire"] * 3 < \
+        by_arm["legacy"]["bytes_on_wire"]
+    assert by_arm["shm_dedup"]["bytes_on_wire"] * 3 < \
+        by_arm["shm"]["bytes_on_wire"]
+    # Decode CPU stays ordered vs the legacy inflate under load.
+    assert by_arm["dedup"]["decode_cpu_s"] * 2 < \
+        by_arm["legacy"]["decode_cpu_s"]
+    assert by_arm["dedup"]["dedup_bytes_saved"] > 0
+    assert by_arm["dedup"]["dedup_frames_reused"] > 0
+    for r in rows:
+        assert r["trajectories_per_sec"] > 0
