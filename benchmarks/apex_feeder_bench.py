@@ -146,6 +146,17 @@ _AB_SPECS = {
 #: records coalesced per slot publish in the shm_batched arm.
 _AB_SHM_BATCH = 8
 
+#: Load-proofing for the VECTOR TCP arms (known flake, recorded in
+#: PR 14): small records make both TCP arms GIL/scheduler-bound, so on
+#: a loaded box either arm can draw the short straw and the wall-clock
+#: ratio flips run to run. The fix is the one
+#: tests/test_native_assembler.py uses — BEST of up to N interleaved
+#: samples with backoff: the arms run back-to-back inside one round
+#: (a load spike hits both sides of the ratio), any one quiet window
+#: is enough, and the byte/decode-CPU columns are deterministic so
+#: only the best wall is kept per arm.
+_AB_TCP_SAMPLES = 3
+
 
 def _ab_pool(variant: str, lanes: int):
     """Per-record (arrays, q_sel, q_max) source stream for the A/B.
@@ -397,9 +408,43 @@ def _transport_ab(variant: str, records: int, lanes: int):
 
         arms += [("dedup", dedup_tcp), ("shm_dedup", dedup_shm)]
 
+    # Vector TCP arms: best-of-N interleaved with backoff (see
+    # _AB_TCP_SAMPLES). Pixel arms are memcpy/zlib-bound and stable;
+    # shm arms never flaked — both stay single-sample.
+    best_of = {}
+    if not fs:
+        tcp_arms = {"legacy", "zerocopy"}
+        runs = dict(arms)
+        best = {}
+        for attempt in range(_AB_TCP_SAMPLES):
+            improved = False
+            for arm in ("legacy", "zerocopy"):
+                sample = runs[arm]()
+                # Lower wall = the quieter window; bytes/decode-CPU
+                # are deterministic per arm, so the best run's row is
+                # the arm's row.
+                if arm not in best or sample[0] < best[arm][0]:
+                    if arm in best and \
+                            sample[0] < best[arm][0] * 0.95:
+                        improved = True
+                    elif arm not in best:
+                        improved = True
+                    best[arm] = sample
+            if attempt and not improved:
+                break
+            if attempt + 1 < _AB_TCP_SAMPLES:
+                time.sleep(0.2 * (attempt + 1))
+        best_of = {arm: (res, attempt + 1)
+                   for arm, res in best.items()}
+        arms = [(a, r) for a, r in arms if a not in tcp_arms]
+
     rows = []
-    for arm, run in arms:
-        wall, sent, cpu = run()
+    results = [(arm, run(), 1) for arm, run in arms]
+    results += [(arm, res, n) for arm, (res, n) in best_of.items()]
+    order = ["legacy", "zerocopy", "shm", "shm_batched", "dedup",
+             "shm_dedup"]
+    results.sort(key=lambda r: order.index(r[0]))
+    for arm, (wall, sent, cpu), samples in results:
         row = {
             "bench": "apex_feeder", "phase": "ab", "variant": variant,
             "arm": arm, "transport": arm, "records": records,
@@ -408,6 +453,7 @@ def _transport_ab(variant: str, records: int, lanes: int):
             "bytes_on_wire": int(sent),
             "bytes_per_record": round(sent / records, 1),
             "decode_cpu_s": round(cpu, 4),
+            "ab_samples": samples,
             "dedup_bytes_saved": 0,
             "dedup_frames_reused": 0,
             "wall_s": round(wall, 3)}

@@ -76,6 +76,50 @@ def _host_replay_leg(cfg, total, chunk_iters, dp):
             out["grad_steps_per_sec"] / out["dp_size"], 1),
         "grad_steps": out["grad_steps"],
         "param_checksum": out["param_checksum"],
+        # Collect-scaling arm inputs (ISSUE 15): acting-side provenance
+        # + the per-shard conservation evidence.
+        "sharded_collect": out["sharded_collect"],
+        "collect_lane_block": out["collect_lane_block"],
+        "collect_dispatch_s_total": out["collect_dispatch_s_total"],
+        "d2h_bytes_total": out["d2h_bytes_total"],
+        "d2h_bytes_by_shard": out["d2h_bytes_by_shard"],
+        "ring_bytes_by_shard": out["ring_bytes_by_shard"],
+        "wall_s": out["wall_s"],
+        "env_steps": out["env_steps"],
+    }
+
+
+def _collect_arm(dp1_leg, dpn_leg, dp):
+    """The collect-scaling arm (ISSUE 15): the dp1-vs-dpN row finally
+    measures ACTING throughput, not just grad throughput — per-shard
+    collect/evac rates plus the zero-cross-shard-scatter proof: each
+    shard's own device evacuated exactly the bytes its own ring
+    appended, all shards equal, summing to the run total."""
+    per_shard = dpn_leg["d2h_bytes_by_shard"] or []
+    ring_shard = dpn_leg["ring_bytes_by_shard"] or []
+    conserved = (
+        len(per_shard) == dp
+        and per_shard == ring_shard
+        and len(set(per_shard)) == 1
+        and sum(per_shard) == dpn_leg["d2h_bytes_total"])
+    wall = max(dpn_leg["wall_s"], 1e-9)
+    return {
+        "sharded": dpn_leg["sharded_collect"],
+        "lane_block": dpn_leg["collect_lane_block"],
+        # Acting-side rates: aggregate env-steps/sec over the mesh and
+        # each shard's share (equal lane blocks => equal shares; the
+        # aggregate-vs-dp1 ratio is what the extra actor-devices buy).
+        "env_steps_x_vs_dp1": round(
+            dpn_leg["env_steps_per_sec"]
+            / max(dp1_leg["env_steps_per_sec"], 1e-9), 3),
+        "per_shard_env_steps_per_sec": round(
+            dpn_leg["env_steps_per_sec"] / dp, 1),
+        "per_shard_evac_bytes_per_sec": [
+            round(b / wall, 1) for b in per_shard],
+        "collect_dispatch_s_total": dpn_leg["collect_dispatch_s_total"],
+        "d2h_bytes_by_shard": per_shard,
+        "ring_bytes_by_shard": ring_shard,
+        "d2h_bytes_conserved_per_shard": conserved,
     }
 
 
@@ -146,6 +190,16 @@ def main() -> int:
                                   / max(legs["dp1"]["grad_steps_per_sec"],
                                         1e-9), 3),
         }
+        collect = _collect_arm(legs["dp1"], dpn, dp)
+        if not collect["d2h_bytes_conserved_per_shard"]:
+            contract.error(
+                "collect",
+                "per-shard D2H bytes not conserved: evacuated "
+                f"{collect['d2h_bytes_by_shard']} vs ring-appended "
+                f"{collect['ring_bytes_by_shard']} (total "
+                f"{dpn['d2h_bytes_total']}) — a lane block crossed "
+                "shards or was lost")
+            return 1
         apex = None
         if not args.skip_apex:
             from dist_dqn_tpu.actors.service import (ApexRuntimeConfig,
@@ -171,6 +225,7 @@ def main() -> int:
             "dp_size": dp,
             "host_replay": legs,
             "scaling": scaling,
+            "collect": collect,
             "apex": apex,
         })
         return 0

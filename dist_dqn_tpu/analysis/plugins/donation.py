@@ -35,8 +35,13 @@ SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py")
 #: ``shard`` joined in ISSUE 10: the data-parallel learners wrap their
 #: train steps in closures named ``sharded`` (parallel/learner.py
 #: make_sharded_train_step), which the train/collect/chunk patterns
-#: would silently stop seeing.
-TARGET = re.compile(r"train|collect|chunk|shard")
+#: would silently stop seeing. ``snapshot``/``lane`` joined in
+#: ISSUE 15: the sharded-collect runtime's per-chunk param-snapshot
+#: program (host_replay_loop.py snapshot_collect_params) and any
+#: lane-block split dispatch are collect-side entry points whose
+#: buffers are chunk-sized — a rename away from "collect" must not
+#: drop them out of scope.
+TARGET = re.compile(r"train|collect|chunk|shard|snapshot|lane")
 #: Rationale escape hatch: a nearby comment owning the decision.
 RATIONALE = re.compile(r"#.*donation:")
 

@@ -60,6 +60,12 @@ SEAMS: Dict[str, Tuple[str, ...]] = {
     # host_replay_loop.py chunk boundary (the deliberate mid-run crash
     # the resume-bit-identical pin kills the run with).
     "host_replay.chunk": ("crash",),
+    # host_replay_loop.py per-shard collect dispatch (ISSUE 15): fires
+    # once per SHARD dispatch, so an at_hit schedule can crash or stall
+    # any one shard of a dp mesh. "stall" recovery = the dispatch pass
+    # completes; "crash" recovery = the next process's resume (same
+    # proof as host_replay.chunk, anchored at the resume site).
+    "host_replay.collect": ("crash", "stall"),
     # actors/service.py run loop (learner-process kill for game days).
     "service.loop": ("crash",),
     # ingest/shm_ring.py ShmSlotRing.push (the zero-copy same-host

@@ -110,13 +110,22 @@ class _ScanCarry(NamedTuple):
 
 
 def make_collect_chunk(cfg: ExperimentConfig, env: JaxEnv, net,
-                       frame_stack: int):
+                       frame_stack: int, lanes: Optional[int] = None,
+                       num_shards: int = 1):
     """(init, collect): a device chunk of act -> step that RETURNS its
     transitions (time-major [C, B, ...]) plus the chunk's episode stats
-    instead of writing a ring."""
-    B = cfg.actor.num_envs
+    instead of writing a ring.
+
+    ``lanes``/``num_shards`` (ISSUE 15, sharded collect): build the
+    PER-SHARD variant — a chunk program over ``lanes`` env lanes (one
+    dp shard's lane block) whose epsilon schedule decays in per-shard
+    iteration units (``make_schedules`` divides the decay horizon by
+    ``lanes * num_shards``), so N shard programs together walk exactly
+    the schedule the whole-B single program walks at the same global
+    frame count. Defaults build the whole-B program unchanged."""
+    B = cfg.actor.num_envs if lanes is None else int(lanes)
     act = make_actor_step(net)
-    epsilon, _ = loop_common.make_schedules(cfg, B, 1)
+    epsilon, _ = loop_common.make_schedules(cfg, B, num_shards)
     slice_newest = ((lambda o: o[..., -1:]) if frame_stack
                     else (lambda o: o))
 
@@ -187,6 +196,12 @@ class _MultiEvacHandle:
             "slices": sum(h.stats["slices"] for h in self.handles),
         }
 
+    @property
+    def per_shard(self) -> list:
+        """[shard] -> that shard's own drained stats (ISSUE 15): the
+        per-shard byte-conservation evidence and straggler wall."""
+        return [h.stats for h in self.handles]
+
 
 class _ResumedEvacHandle:
     """Completion-handle stand-in installed on resume: the chunk it
@@ -194,6 +209,7 @@ class _ResumedEvacHandle:
     the fence is a no-op and the evacuation accounting reads zero."""
 
     stats = {"evac_s": 0.0, "bytes": 0, "slices": 0}
+    per_shard = ()
     done = True
 
     def wait(self, timeout=None) -> bool:
@@ -212,7 +228,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     prio_writeback_batch: int = 8,
                     checkpoint_dir: Optional[str] = None,
                     save_every_frames: int = 0,
-                    mesh_devices: int = 1):
+                    mesh_devices: int = 1,
+                    sharded_collect: Optional[bool] = None):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
@@ -287,12 +304,27 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     SamplePrefetcher feeds its LOCAL chip, and the train step runs
     under ``shard_map`` with params replicated, batch rows sharded over
     ``dp`` and ONE pmean gradient allreduce per update (the same specs
-    the fused and apex learners use — parallel/learner.py). The collect
-    chunk itself stays a single-device program acting on a per-chunk
-    host mirror of the replicated params (the Sebulba actor-side
-    refresh); sharding collection is the fused runtime's job.
-    ``mesh_devices=1`` is the untouched pre-mesh program — bit-identical
-    by construction (same code path).
+    the fused and apex learners use — parallel/learner.py).
+
+    Since ISSUE 15 COLLECT is data-parallel too: each dp shard runs its
+    OWN collect program over its own ``B/dp`` env-lane block, with its
+    own donated ``CollectCarry`` and its own per-shard RNG stream, ON
+    ITS OWN DEVICE — the transitions are born on the device whose
+    evacuation worker feeds the shard's ring, so no lane-block split
+    dispatch and no cross-shard scatter exist anywhere on the path.
+    All shard dispatches share ONE params snapshot per chunk (a single
+    replicated copy/bf16-cast program; each device materializes its
+    replica locally and the shard collects consume zero-copy per-device
+    views — parallel/learner.py replicated_device_views), so the bf16
+    actor split still costs one cast per chunk, not one per shard. The
+    collect-ahead schedule, heartbeats
+    (``host_replay.collect.s{N}``), generation fences and evacuation
+    workers are all per-shard. ``mesh_devices=1`` is the untouched
+    pre-mesh program — bit-identical by construction (same code path);
+    ``sharded_collect=True`` at ``mesh_devices=1`` forces the sharded
+    machinery through a 1-shard mesh instead — the mechanism pin
+    (tests/test_sharded_collect.py holds it bit-identical to the
+    single-collect program).
     """
     from dist_dqn_tpu.envs import make_jax_env
     from dist_dqn_tpu.models import build_network
@@ -321,6 +353,16 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     if dp > len(jax.devices()):
         raise ValueError(f"--mesh-devices {dp} requested but only "
                          f"{len(jax.devices())} devices are available")
+    if sharded_collect is False and dp > 1:
+        raise ValueError(
+            "--mesh-devices > 1 always runs the sharded collect path "
+            "(ISSUE 15 removed the single-device lane-scatter collect); "
+            "sharded_collect=False is only meaningful at mesh width 1")
+    # mesh_mode routes the WHOLE sharded machinery (per-shard collect +
+    # rings + pipelines + shard_map train). dp > 1 implies it;
+    # sharded_collect=True forces it through a 1-shard mesh — the
+    # dp=1 mechanism-equivalence pin's knob.
+    mesh_mode = dp > 1 or bool(sharded_collect)
 
     if env is None:
         env = make_jax_env(cfg.env_name)
@@ -357,12 +399,20 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             f"actor.num_envs={B} not divisible by --mesh-devices {dp}: "
             "each dp shard owns one env-lane block of the collect chunk")
 
-    init_collect, collect = make_collect_chunk(cfg, env, net, stack)
+    if mesh_mode:
+        # Per-shard collect program (ISSUE 15): one chunk body over a
+        # B/dp lane block; ONE jit, dispatched once per shard on that
+        # shard's own device (jit re-specializes per device placement,
+        # so the mesh pays dp compiles of the same small program).
+        init_collect, collect = make_collect_chunk(
+            cfg, env, net, stack, lanes=B // dp, num_shards=dp)
+    else:
+        init_collect, collect = make_collect_chunk(cfg, env, net, stack)
     collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
     init_learner, train_step = make_learner(
-        net, cfg.learner, axis_name="dp" if dp > 1 else None)
+        net, cfg.learner, axis_name="dp" if mesh_mode else None)
     mesh = mesh_devs = weights_sharding = None
-    if dp == 1:
+    if not mesh_mode:
         train_jit = jax.jit(train_step, donate_argnums=0)
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -399,18 +449,35 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         cfg.network.actor_dtype)
     cast_jit = jax.jit(_cast_actor) if _actor_split else None
 
-    def collect_params(state):
-        params = state.params
-        if dp > 1:
-            # Host mirror of the mesh-replicated params (the Sebulba
-            # actor-side param refresh): collect is a single-device
-            # program and must not consume mesh-committed arrays; the
-            # D2H copy costs once per chunk, exactly where the bf16
-            # cast already sits.
-            params = jax.device_get(params)
-        return cast_jit(params) if _actor_split else params
+    if not mesh_mode:
+        def collect_params(state):
+            return cast_jit(state.params) if _actor_split \
+                else state.params
+    else:
+        from dist_dqn_tpu.parallel.learner import replicated_device_views
 
-    if dp == 1:
+        # ONE collect-params snapshot per chunk, shared by every shard
+        # dispatch (ISSUE 15): a single replicated mesh program — each
+        # device casts/copies its own replica locally, replacing PR
+        # 10's per-chunk host mirror (one D2H + re-upload) with zero
+        # host traffic and exactly one cast even at dp shards. The
+        # copy (never an alias of the live params) is what lets the
+        # donated train step overwrite its state while the async shard
+        # collects are still reading the snapshot.
+        # donation: the snapshot must COPY — the learner still owns
+        # (and the train step donates) the params tree it reads.
+        @jax.jit
+        def snapshot_collect_params(params):
+            params = _cast_actor(params) if _actor_split else params
+            return jax.tree.map(jnp.copy, params)
+
+        def collect_params_views(state):
+            """[shard] -> shard s's zero-copy device view of this
+            chunk's one shared snapshot."""
+            return replicated_device_views(
+                snapshot_collect_params(state.params), mesh_devs)
+
+    if not mesh_mode:
         ring = HostTimeRing(num_slots, B, stored_shape,
                             np.dtype(env.observation_dtype),
                             frame_stack=stack)
@@ -424,10 +491,25 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
 
     rng = jax.random.PRNGKey(cfg.seed)
     k_carry, k_learn = jax.random.split(rng)
-    carry = init_collect(k_carry)
-    obs_example = jax.tree.map(lambda x: x[0], carry.obs)
+    carries = None
+    if not mesh_mode:
+        carry = init_collect(k_carry)
+        obs_example = jax.tree.map(lambda x: x[0], carry.obs)
+    else:
+        # Per-shard collect carries, each committed to its own device
+        # (ISSUE 15). Shard s acts on its own RNG stream; ONE shard
+        # keeps the seed's undivided stream, which is what makes the
+        # 1-shard sharded-collect path bit-identical to the
+        # single-collect program (the dp=1 mechanism pin,
+        # tests/test_sharded_collect.py).
+        shard_keys = ([k_carry] if dp == 1
+                      else list(jax.random.split(k_carry, dp)))
+        carries = [jax.device_put(init_collect(shard_keys[s]),
+                                  mesh_devs[s]) for s in range(dp)]
+        obs_example = jax.tree.map(lambda x: x[0], carries[0].obs)
+        carry = None
     state = init_learner(k_learn, obs_example)
-    if dp > 1:
+    if mesh_mode:
         # Replicate the learner once onto the mesh; the donated sharded
         # train step then updates the replicas in place.
         state = jax.device_put(state, repl_sharding)
@@ -438,7 +520,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # same generation fence the samplers hold. dp > 1 attaches ONE
     # sum-tree per shard ring (per-shard fences, per-shard flushes).
     per_sampler = per_samplers = None
-    if per_enabled and dp == 1:
+    if per_enabled and not mesh_mode:
         from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
         per_sampler = RingPrioritySampler(
             ring, n_step=cfg.learner.n_step,
@@ -504,9 +586,9 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         ring.add_chunk(tree["obs"], tree["action"], tree["reward"],
                        tree["terminated"], tree["truncated"])
 
-    # -- dp > 1 plumbing (ISSUE 10): per-shard sample/upload/assemble ------
+    # -- mesh plumbing (ISSUE 10): per-shard sample/upload/assemble ------
     shard_samples = shard_puts = assemble_tree = None
-    if dp > 1:
+    if mesh_mode:
         lb_shard = train_batch // dp
 
         def make_shard_sample(s: int):
@@ -515,8 +597,10 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                          else None)
 
             def sample_shard(k: int):
-                """Shard s's row block of train batch k."""
-                rng_k = _batch_rng(k, s)
+                """Shard s's row block of train batch k. A 1-shard
+                mesh keeps the undivided (k,) stream — the dp=1
+                mechanism pin's draws are the single-ring draws."""
+                rng_k = _batch_rng(k, s if dp > 1 else None)
                 if sampler_s is not None:
                     hb, aux = sampler_s.sample(rng_k, lb_shard,
                                                cfg.learner.gamma)
@@ -569,7 +653,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # serial put_batch path serve as the pinned references. dp > 1 runs
     # ONE prefetcher per shard, staging onto that shard's local chip.
     prefetcher = stager = prefetchers = None
-    if prefetch and dp > 1:
+    if prefetch and mesh_mode:
         from dist_dqn_tpu.replay.staging import SamplePrefetcher
         prefetchers = [
             SamplePrefetcher(shard_samples[s], depth=prefetch_depth,
@@ -584,7 +668,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         prefetcher = SamplePrefetcher(sample_host, depth=prefetch_depth,
                                       name="host_replay",
                                       wait_generation=ring.wait_generation)
-    elif double_buffer and dp == 1:
+    elif double_buffer and not mesh_mode:
         from dist_dqn_tpu.replay.staging import DoubleBufferedStager
         stager = DoubleBufferedStager(depth=2, name="host_replay")
     elif double_buffer:
@@ -598,13 +682,16 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                "only — ignored")
 
     # Streamed D2H + background worker (the pipeline's stages 2 and 3).
-    # dp > 1: one evacuator/worker pair PER SHARD — each shard's lane
-    # block streams into its own ring under its own generation fence.
-    evacuator = worker = workers = lane_split = None
-    if pipeline and dp > 1:
+    # Mesh mode: one evacuator/worker pair PER SHARD. Since ISSUE 15
+    # each shard's records are BORN on that shard's own device (its own
+    # collect program), so a worker's whole stream — split dispatch,
+    # async host copies, ring appends — runs against its own device and
+    # its own generation fence: the lane-block scatter program PR 10
+    # dispatched on device 0 no longer exists.
+    evacuator = worker = workers = None
+    if pipeline and mesh_mode:
         from dist_dqn_tpu.replay.staging import (EvacuationWorker,
                                                  StreamedEvacuator)
-        Bs = B // dp
 
         def _make_append(s: int):
             def append(tree, lo, hi):
@@ -617,17 +704,10 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         workers = [
             EvacuationWorker(
                 StreamedEvacuator(num_slices=evac_slices,
-                                  name=f"host_replay_s{s}"),
-                _make_append(s), name=f"host_replay_s{s}")
+                                  name=f"host_replay_s{s}", shard=s),
+                _make_append(s), name=f"host_replay_s{s}", shard=s)
             for s in range(dp)
         ]
-        # One dispatched lane-split program per chunk: [C, B, ...]
-        # records -> dp lane blocks, each submitted to its shard's
-        # worker (the time-slice split happens per shard inside its
-        # StreamedEvacuator, same as the single-ring path).
-        lane_split = jax.jit(lambda tree: tuple(
-            jax.tree.map(lambda x, s=s: x[:, s * Bs:(s + 1) * Bs], tree)
-            for s in range(dp)))
     elif pipeline:
         from dist_dqn_tpu.replay.staging import (EvacuationWorker,
                                                  StreamedEvacuator)
@@ -638,12 +718,13 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
 
     def submit_evac(records):
         """Queue one chunk's evacuation; returns the completion handle
-        the next train event fences on."""
-        if dp == 1:
+        the next train event fences on. Mesh mode takes the per-shard
+        records LIST — shard s's block goes straight to shard s's
+        worker, no split dispatch in between."""
+        if not mesh_mode:
             return worker.submit(records)
-        blocks = lane_split(records)
-        return _MultiEvacHandle([w.submit(b)
-                                 for w, b in zip(workers, blocks)])
+        return _MultiEvacHandle([w.submit(r)
+                                 for w, r in zip(workers, records)])
 
     # Crash forensics (ISSUE 4): per-stage heartbeats (the evacuation
     # stage's heartbeat lives inside EvacuationWorker as
@@ -654,11 +735,25 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # covers the first-chunk jit compile; a compile outliving it is the
     # wedged-tunnel hang and trips with its stack on record.
     fr = tm_flight.get_flight()
-    hb_collect = tm_watchdog.heartbeat(
-        "host_replay.collect",
-        startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+    # Collect heartbeats are per-shard in mesh mode (ISSUE 15): stage
+    # host_replay.collect.s{N} — a wedged shard dispatch names ITS
+    # shard in the forensics bundle instead of hiding behind one
+    # aggregate stage.
+    if mesh_mode:
+        hb_collects = [tm_watchdog.heartbeat(
+            f"host_replay.collect.s{s}",
+            startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+            for s in range(dp)]
+    else:
+        hb_collects = [tm_watchdog.heartbeat(
+            "host_replay.collect",
+            startup_grace_s=tm_watchdog.STARTUP_GRACE_S)]
     hb_train = tm_watchdog.heartbeat(
         "host_replay.train", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+
+    def _beat_collect():
+        for hb in hb_collects:
+            hb.beat()
 
     reg = get_registry()
     _labels = {"loop": "host_replay"}
@@ -686,13 +781,73 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     g_grad_rate = reg.gauge(tmc.LEARNER_GRAD_RATE,
                             "grad steps per second (whole loop)",
                             _labels)
+    # Sharded-collect surface (ISSUE 15): the lane block each shard's
+    # own collect acts over, and the per-shard dispatch enqueue wall
+    # (async dispatch — growth means that shard's device queue is full,
+    # the dqn_mesh_chunk_dispatch_seconds semantic). The per-shard evac
+    # gauges live with the workers (replay/staging.py).
+    h_collect_disp = c_shard_d2h = None
+    collect_dispatch_s_total = 0.0
+    if mesh_mode:
+        reg.gauge(tmc.HOST_REPLAY_COLLECT_LANE_BLOCK,
+                  "env lanes per shard collect dispatch",
+                  _labels).set(B // dp)
+        h_collect_disp = [reg.histogram(
+            tmc.HOST_REPLAY_COLLECT_SECONDS,
+            "per-shard collect dispatch enqueue wall",
+            {**_labels, "shard": str(s)}) for s in range(dp)]
+        # Serial (--no-pipeline) path's half of the per-shard byte
+        # family; the pipelined half lives with each shard's
+        # StreamedEvacuator (same name+labels => same series).
+        c_shard_d2h = [reg.counter(
+            tmc.HOST_REPLAY_SHARD_D2H_BYTES,
+            "bytes evacuated from this shard's own device into its "
+            "own ring (zero cross-shard lane scatter)",
+            {**_labels, "shard": str(s)}) for s in range(dp)]
+
+        def dispatch_collect(state):
+            """Per-shard collect dispatches (ISSUE 15 tentpole): one
+            shared params snapshot, then shard s's donated carry +
+            lane block dispatched on ITS OWN device. Dispatches are
+            async, so all dp devices collect concurrently; the
+            records land where their evac worker and ring live, and
+            no byte ever crosses a shard boundary."""
+            nonlocal collect_dispatch_s_total
+            views = collect_params_views(state)
+            recs, sts = [], []
+            stalled = False
+            for s in range(dp):
+                # Chaos seam (ISSUE 15): per-shard crash/stall at the
+                # dispatch site. Stall recovery = the completed
+                # dispatch pass below; crash recovery = the next
+                # process's resume (anchored beside
+                # host_replay.chunk's).
+                cev = chaos.fire("host_replay.collect")
+                if cev is not None:
+                    if cev.fault == "crash":
+                        raise chaos.ChaosInjectedError(
+                            "host_replay.collect", cev.fault)
+                    chaos.sleep_for(cev)
+                    stalled = True
+                t_d = time.perf_counter()
+                carries[s], r, st = collect_jit(carries[s], views[s],
+                                                chunk_iters)
+                dt = time.perf_counter() - t_d
+                h_collect_disp[s].observe(dt)
+                collect_dispatch_s_total += dt
+                hb_collects[s].beat()
+                recs.append(r)
+                sts.append(st)
+            if stalled:
+                chaos.mark_recovered("host_replay.collect")
+            return recs, sts
 
     # Train-event cadence carries its remainder across chunks so the
     # average exactly matches the fused loop's one-event-per-train_every
     # iterations (chunk_iters need not divide train_every).
     updates_per_train = max(cfg.updates_per_train, 1) * replay_ratio
     train_debt_iters = 0
-    if dp == 1:
+    if not mesh_mode:
         weights = jnp.ones((train_batch,), jnp.float32)
     else:
         weights = jax.device_put(np.ones((train_batch,), np.float32),
@@ -716,7 +871,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         if per_sampler is None and per_samplers is None:
             return
         wb_pending.append((aux, metrics["priorities"]))
-        for a in (aux if dp > 1 else (aux,)):
+        for a in (aux if mesh_mode else (aux,)):
             is_w_sum += float(a.weights.sum())
             is_w_count += int(a.weights.shape[0])
             is_w_min = min(is_w_min, float(a.weights.min()))
@@ -728,7 +883,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 or not wb_pending:
             return
         pending, wb_pending[:] = wb_pending[:], []
-        if dp == 1:
+        if not mesh_mode:
             leaf = np.concatenate([a.leaf for a, _ in pending])
             prios = np.concatenate([np.asarray(p, np.float64)
                                     for _, p in pending])
@@ -803,7 +958,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         def _sidecar_path(step: int) -> str:
             return os.path.join(checkpoint_dir, f"host_loop_{step}.npz")
 
-        example_tree = {"learner": state, "carry": carry}
+        # Mesh mode keeps the per-shard collect carries in the SIDECAR
+        # (flattened leaves, schema v2) — the orbax tree carries only
+        # the learner; the single-collect path keeps its one carry in
+        # orbax exactly as before (ISSUE 15).
+        example_tree = ({"learner": state} if mesh_mode
+                        else {"learner": state, "carry": carry})
         # Newest step whose sidecar READS wins: an orbax step whose
         # sidecar is torn or missing is not a checkpoint — delete it
         # loudly and fall back to the next older one, instead of
@@ -872,6 +1032,18 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     "(re-sharding a lane-striped host-replay window is "
                     "not supported; docs/fault_tolerance.md 'resuming "
                     "a sharded run')")
+            if bool(side["sharded_collect"]) != mesh_mode:
+                # The collect carries live in different places per mode
+                # (per-shard sidecar leaves vs the orbax tree), so a
+                # mode flip cannot restore either representation.
+                _refuse_resume(
+                    "sharded_collect",
+                    f"checkpoint at {checkpoint_dir!r} was written "
+                    f"with sharded_collect="
+                    f"{bool(side['sharded_collect'])}, this run "
+                    f"resolves sharded_collect={mesh_mode} — resume "
+                    "with the same collect mode (the collect carries "
+                    "are stored per mode)")
             if per_enabled and \
                     int(side["prio_writeback_batch"]) \
                     != prio_writeback_batch:
@@ -897,10 +1069,27 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     "versa); resume with the same sampler, or start a "
                     "fresh --checkpoint-dir")
             _, tree = ckpt.restore_latest(example_tree, step=step)
-            state, carry = tree["learner"], tree["carry"]
+            state = tree["learner"]
+            if not mesh_mode:
+                carry = tree["carry"]
+            else:
+                # Per-shard collect carries from the sidecar (ISSUE
+                # 15): flattened leaves keyed carry{s}_leaf{i},
+                # re-built against the freshly-initialized carries'
+                # treedef (same cfg/env => same structure), committed
+                # back to each shard's own device.
+                cdef = jax.tree.structure(carries[0])
+                n_leaves = len(jax.tree.leaves(carries[0]))
+                carries = [
+                    jax.device_put(
+                        jax.tree.unflatten(
+                            cdef, [side[f"carry{s}_leaf{i}"]
+                                   for i in range(n_leaves)]),
+                        mesh_devs[s])
+                    for s in range(dp)]
             ring_side = {k[len("ring_"):]: v for k, v in side.items()
                          if k.startswith("ring_")}
-            if dp == 1:
+            if not mesh_mode:
                 ring.load_state_dict(ring_side)
                 if per_sampler is not None:
                     # Exact priority state (ISSUE 12): shadow mass,
@@ -950,22 +1139,41 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                         weights=np.zeros(leaf.shape[0], np.float32),
                         generation=0)
 
-                aux = (_wb_aux(0) if dp == 1
+                aux = (_wb_aux(0) if not mesh_mode
                        else [_wb_aux(s) for s in range(dp)])
                 wb_pending.append((aux, prios_j))
             if bool(side["has_stats"]):
                 # Episode-stat scalars of the already-dispatched next
                 # chunk: host floats; jax.device_get at the loop's
-                # fetch point is a no-op on them.
-                resume_stats = (np.float32(side["stats_cr"]),
-                                np.float32(side["stats_cc"]))
+                # fetch point is a no-op on them. Mesh mode stores one
+                # (cr, cc) pair per shard as [dp] arrays.
+                if not mesh_mode:
+                    resume_stats = (np.float32(side["stats_cr"]),
+                                    np.float32(side["stats_cc"]))
+                else:
+                    resume_stats = [
+                        (np.float32(side["stats_cr"][s]),
+                         np.float32(side["stats_cc"][s]))
+                        for s in range(dp)]
             if bool(side["has_pending"]):
                 # Serial path: the next chunk's collected records were
                 # materialized into the checkpoint; the body's
-                # monolithic fetch reads host arrays identically.
-                resume_pending = {
-                    k[len("pending_"):]: v for k, v in side.items()
-                    if k.startswith("pending_")}
+                # monolithic fetch reads host arrays identically. Mesh
+                # mode stores one record dict per shard
+                # (pending{s}_{field}).
+                if not mesh_mode:
+                    resume_pending = {
+                        k[len("pending_"):]: v for k, v in side.items()
+                        if k.startswith("pending_")}
+                else:
+                    import re as _re
+                    _pat = _re.compile(r"^pending(\d+)_([a-z_]+)$")
+                    resume_pending = [dict() for _ in range(dp)]
+                    for k, v in side.items():
+                        m = _pat.match(k)
+                        if m is not None:
+                            resume_pending[int(m.group(1))][
+                                m.group(2)] = v
             log_fn(json.dumps({"resumed_at_frames": env_steps,
                                "resumed_at_chunk": start_chunk,
                                "resumed_dp": dp,
@@ -978,10 +1186,15 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # resume that fell back past an injected torn sidecar
             # proves that seam recovered too.
             chaos.mark_recovered("host_replay.chunk")
+            # ...and for a crash injected at a shard's collect dispatch
+            # (ISSUE 15): the resumed process restores that shard's
+            # carry from the sidecar, which is the surviving path.
+            chaos.mark_recovered("host_replay.collect")
             if fell_back:
                 chaos.mark_recovered("sidecar.write")
 
     d2h_bytes_total = 0
+    d2h_bytes_by_shard = [0] * dp if mesh_mode else None
     fence_wait_total = 0.0
     sample_s_total = 0.0
     prefetch_wait_s_total = 0.0
@@ -1011,7 +1224,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         t_save = time.perf_counter()
         if pipeline and handle is not None:
             handle.wait()
-        if dp == 1:
+        if not mesh_mode:
             side = {f"ring_{k}": v for k, v in ring.state_dict().items()}
             if per_sampler is not None:
                 side.update({f"per_{k}": v for k, v in
@@ -1020,6 +1233,13 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # ShardedHostReplay snapshot: per-shard rings + (when PER)
             # per-shard sampler state, each under its own fence.
             side = {f"ring_{k}": v for k, v in store.state_dict().items()}
+            # Per-shard collect carries (ISSUE 15, schema v2): shard
+            # s's donated carry, flattened to leaves — the orbax tree
+            # carries only the learner in mesh mode.
+            for s in range(dp):
+                for i, leaf in enumerate(
+                        jax.tree.leaves(jax.device_get(carries[s]))):
+                    side[f"carry{s}_leaf{i}"] = np.asarray(leaf)
         side.update(
             sidecar_version=np.int64(ckpt_schema.SIDECAR_VERSION),
             env_steps=np.int64(env_steps),
@@ -1030,6 +1250,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             chunk_iters=np.int64(chunk_iters),
             dp=np.int64(dp),
             per=np.bool_(per_enabled),
+            sharded_collect=np.bool_(mesh_mode),
             prio_writeback_batch=np.int64(prio_writeback_batch),
             wb_count=np.int64(len(wb_pending)),
             has_stats=np.bool_(stats is not None),
@@ -1038,7 +1259,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # Deferred |TD| write-backs ride along verbatim (see the
             # restore path's comment: an early flush would break the
             # bit-identical pin).
-            if dp == 1:
+            if not mesh_mode:
                 side["wb0_leaf"] = np.stack(
                     [a.leaf for a, _ in wb_pending])
                 side["wb0_slot_gen"] = np.stack(
@@ -1052,12 +1273,27 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             side["wb_prios"] = np.stack(
                 [np.asarray(p, np.float64) for _, p in wb_pending])
         if stats is not None:
-            s_cr, s_cc = jax.device_get(stats)
-            side.update(stats_cr=np.float32(s_cr),
-                        stats_cc=np.float32(s_cc))
+            if not mesh_mode:
+                s_cr, s_cc = jax.device_get(stats)
+                side.update(stats_cr=np.float32(s_cr),
+                            stats_cc=np.float32(s_cc))
+            else:
+                got = jax.device_get(stats)
+                side.update(
+                    stats_cr=np.asarray([g_[0] for g_ in got],
+                                        np.float32),
+                    stats_cc=np.asarray([g_[1] for g_ in got],
+                                        np.float32))
         if records is not None:
-            side.update({f"pending_{k}": np.asarray(jax.device_get(v))
-                         for k, v in records.items()})
+            if not mesh_mode:
+                side.update({f"pending_{k}":
+                             np.asarray(jax.device_get(v))
+                             for k, v in records.items()})
+            else:
+                for s, rec in enumerate(records):
+                    side.update({f"pending{s}_{k}":
+                                 np.asarray(jax.device_get(v))
+                                 for k, v in rec.items()})
         # Schema gate (ISSUE 12 satellite): a code path emitting a
         # field utils/ckpt_schema.py does not name fails HERE, at save
         # time, instead of becoming a silently-unread key at restore.
@@ -1083,7 +1319,9 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             os.remove(tmp)
         else:
             os.replace(tmp, path)
-        ckpt.save(env_steps, {"learner": state, "carry": carry})
+        orbax_tree = ({"learner": state} if mesh_mode
+                      else {"learner": state, "carry": carry})
+        ckpt.save(env_steps, orbax_tree)
         ckpt.wait()
         last_saved = env_steps
         # Prune sidecars in lockstep with orbax's max_to_keep: each one
@@ -1104,8 +1342,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         c_ckpt_bytes.inc(
             os.path.getsize(path)
             + int(sum(getattr(leaf, "nbytes", 0) for leaf in
-                      jax.tree.leaves({"learner": state,
-                                       "carry": carry}))))
+                      jax.tree.leaves(orbax_tree))))
         fr.record("checkpoint", "host_replay.save", frames=env_steps,
                   wall_s=round(wall, 3), shards=dp)
         log_fn(json.dumps({"host_replay_checkpoint": env_steps,
@@ -1132,7 +1369,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             import os as _os
             save_pytree(_os.path.join(checkpoint_dir, "emergency_learner"),
                         {"learner": _emerg_state["state"]})
-            if dp == 1:
+            if not mesh_mode:
                 # One fence hold for ring + sampler (RLock): appends
                 # may still be in flight on the abort path, and a
                 # publish between the two snapshots would tear sampler
@@ -1156,11 +1393,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         tm_watchdog.register_emergency_hook("host_replay.checkpoint",
                                             _emergency_save)
 
+    def _dispatch_chunk():
+        """One chunk's collect: the single program (dp=1) or the
+        per-shard dispatch pass (mesh mode). Returns (records, stats)
+        — per-shard LISTS in mesh mode."""
+        nonlocal carry
+        if mesh_mode:
+            return dispatch_collect(state)
+        carry, r, st = collect_jit(carry, collect_params(state),
+                                   chunk_iters)
+        return r, st
+
     try:
         if num_chunks and not resumed:
             # Chunk 0: prologue dispatch + evacuation submit.
-            carry, records, stats = collect_jit(
-                carry, collect_params(state), chunk_iters)
+            records, stats = _dispatch_chunk()
             if pipeline:
                 handle = submit_evac(records)
                 records = None
@@ -1176,8 +1423,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # chunk one train event earlier (the collect-ahead
             # schedule), so params at the boundary differ by one
             # staleness event.
-            carry, records, stats = collect_jit(
-                carry, collect_params(state), chunk_iters)
+            records, stats = _dispatch_chunk()
             if pipeline:
                 handle = submit_evac(records)
                 records = None
@@ -1203,9 +1449,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 # same point in the data-dependency order, so the two
                 # paths stay bit-identical).
                 if g + 1 < num_chunks:
-                    carry, next_records, next_stats = collect_jit(
-                        carry, collect_params(state), chunk_iters)
-                hb_collect.beat()
+                    next_records, next_stats = _dispatch_chunk()
+                _beat_collect()
                 t_dispatch = time.perf_counter()
                 # Stage 2 — fence on chunk g's evacuation (submitted
                 # last iteration / at the prologue): its last slice
@@ -1219,40 +1464,60 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 fence_wait_s = t_fence - t_dispatch
                 evac_s = handle.stats["evac_s"]
                 d2h_bytes = handle.stats["bytes"]
+                if mesh_mode:
+                    # Per-shard conservation accounting (ISSUE 15):
+                    # what each shard's own device evacuated this chunk
+                    # (the worker already counted it into the {shard}
+                    # telemetry families).
+                    for s, st in enumerate(handle.per_shard):
+                        d2h_bytes_by_shard[s] += st["bytes"]
                 overlap = max(0.0, min(1.0, 1.0 - fence_wait_s
                                        / max(evac_s, 1e-9)))
                 t_evac_parts = None
             else:
-                # Serial reference: one monolithic blocking fetch, one
-                # monolithic append, device idle throughout (the
-                # round-5 measured shape), THEN the look-ahead dispatch
-                # — same pre-train params as the pipelined path, with
-                # zero evacuation overlap.
-                host = {k: np.asarray(jax.device_get(v))
-                        for k, v in records.items()}
-                t_mono_fetch = time.perf_counter()
-                if dp == 1:
+                # Serial reference: one monolithic blocking fetch (per
+                # shard in mesh mode — each shard's records come off
+                # its OWN device, no lane re-split), one monolithic
+                # append, device idle throughout (the round-5 measured
+                # shape), THEN the look-ahead dispatch — same pre-train
+                # params as the pipelined path, with zero evacuation
+                # overlap.
+                if not mesh_mode:
+                    host = {k: np.asarray(jax.device_get(v))
+                            for k, v in records.items()}
+                    t_mono_fetch = time.perf_counter()
                     ring.add_chunk(host["obs"], host["action"],
                                    host["reward"], host["terminated"],
                                    host["truncated"])
+                    t_fence = time.perf_counter()
+                    d2h_bytes = int(sum(v.nbytes
+                                        for v in host.values()))
+                    del host
                 else:
-                    Bs = B // dp
-                    for s in range(dp):
-                        store.add_chunk(
-                            s, *(host[k][:, s * Bs:(s + 1) * Bs]
-                                 for k in ("obs", "action", "reward",
-                                           "terminated", "truncated")))
-                t_fence = time.perf_counter()
+                    hosts = [{k: np.asarray(jax.device_get(v))
+                              for k, v in rec.items()}
+                             for rec in records]
+                    t_mono_fetch = time.perf_counter()
+                    for s, host in enumerate(hosts):
+                        store.add_chunk(s, host["obs"], host["action"],
+                                        host["reward"],
+                                        host["terminated"],
+                                        host["truncated"])
+                        b_s = int(sum(v.nbytes for v in host.values()))
+                        d2h_bytes_by_shard[s] += b_s
+                        c_shard_d2h[s].inc(b_s)
+                    t_fence = time.perf_counter()
+                    d2h_bytes = int(sum(
+                        v.nbytes for host in hosts
+                        for v in host.values()))
+                    del hosts
                 fence_wait_s = evac_s = t_fence - t0
-                d2h_bytes = int(sum(v.nbytes for v in host.values()))
                 c_d2h.inc(d2h_bytes)
                 overlap = 0.0
                 t_evac_parts = (t_mono_fetch - t0, t_fence - t_mono_fetch)
-                del host
                 if g + 1 < num_chunks:
-                    carry, next_records, next_stats = collect_jit(
-                        carry, collect_params(state), chunk_iters)
-                hb_collect.beat()
+                    next_records, next_stats = _dispatch_chunk()
+                _beat_collect()
             records = next_records
             fr.record("fence", "host_replay.chunk", chunk=g,
                       fence_wait_s=round(fence_wait_s, 4),
@@ -1269,22 +1534,23 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # deterministic post-chunk-g state.
             g_overlap.set(overlap)
             h_fence.observe(fence_wait_s)
-            ring_transitions = (ring.size if dp == 1 else store.size) * B
+            ring_transitions = (store.size if mesh_mode
+                                else ring.size) * B
 
             # Stage 3 — train event for chunk g (samples the window
             # INCLUDING chunk g, exactly as the serial path does).
             did = 0
             ev_sample_s = ev_wait_s = 0.0
             ev_depth_sum = ev_stale = 0
-            sampleable = (ring.can_sample(cfg.learner.n_step)
-                          if dp == 1
-                          else store.can_sample(cfg.learner.n_step))
+            sampleable = (store.can_sample(cfg.learner.n_step)
+                          if mesh_mode
+                          else ring.can_sample(cfg.learner.n_step))
             if sampleable and ring_transitions >= cfg.replay.min_fill:
                 train_debt_iters += chunk_iters
                 events = train_debt_iters // max(cfg.train_every, 1)
                 train_debt_iters -= events * max(cfg.train_every, 1)
                 grads_this_chunk = events * updates_per_train
-                if grads_this_chunk and dp > 1:
+                if grads_this_chunk and mesh_mode:
                     # Data-parallel train event (ISSUE 10): each shard's
                     # pipeline delivers its OWN row block onto its local
                     # chip; assembly stitches the blocks into one global
@@ -1446,7 +1712,14 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             # Fused episode-stat fetch (ISSUE 3 satellite): ONE
             # device_get for both scalars, and its wall accounted in
             # the row instead of hiding between t_train and the log.
-            cr, cc = jax.device_get(stats)
+            # Mesh mode fetches every shard's pair in the one call and
+            # sums — the global stats are the sum over lane blocks.
+            if not mesh_mode:
+                cr, cc = jax.device_get(stats)
+            else:
+                got = jax.device_get(stats)
+                cr = sum(float(g_[0]) for g_ in got)
+                cc = sum(float(g_[1]) for g_ in got)
             stats = next_stats
             t_stats = time.perf_counter()
             ep = float(cr) / max(float(cc), 1.0)
@@ -1474,8 +1747,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 "device_idle_est_s": round(fence_wait_s, 4),
                 "d2h_bytes": d2h_bytes,
                 "ring_transitions": ring_transitions,
-                "ring_gb": round((ring.nbytes if dp == 1
-                                  else store.nbytes) / 1e9, 3),
+                "ring_gb": round((store.nbytes if mesh_mode
+                                  else ring.nbytes) / 1e9, 3),
                 # Sample-side overlap accounting (ISSUE 5): sample_s is
                 # the host sampling wall this chunk (on the critical
                 # path when prefetch is off, overlapped when on);
@@ -1539,7 +1812,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             except Exception as e:  # noqa: BLE001 — surfaced already
                 log_fn(f"# host-replay checkpoint close failed: "
                        f"{type(e).__name__}: {e}")
-        hb_collect.close()
+        for hb in hb_collects:
+            hb.close()
         hb_train.close()
 
     # Apply any accumulated-but-unflushed |TD| write-backs before the
@@ -1579,8 +1853,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         "replay_ratio": replay_ratio,
         "train_batch": train_batch,
         "actor_dtype": cfg.network.actor_dtype or "float32",
-        "ring_transitions": (ring.size if dp == 1 else store.size) * B,
-        "ring_gb": round((ring.nbytes if dp == 1 else store.nbytes)
+        # Sharded-collect provenance + per-shard conservation evidence
+        # (ISSUE 15): in mesh mode each entry of d2h_bytes_by_shard is
+        # the bytes shard s's OWN device evacuated, and
+        # ring_bytes_by_shard the bytes appended into shard s's ring —
+        # elementwise equality is the zero-cross-shard-scatter proof
+        # scaling_bench's collect arm asserts.
+        "sharded_collect": mesh_mode,
+        "collect_lane_block": (B // dp) if mesh_mode else B,
+        "collect_dispatch_s_total": round(collect_dispatch_s_total, 4),
+        "d2h_bytes_by_shard": d2h_bytes_by_shard,
+        "ring_bytes_by_shard": (list(store.bytes_by_shard)
+                                if mesh_mode else None),
+        "ring_transitions": (store.size if mesh_mode
+                             else ring.size) * B,
+        "ring_gb": round((store.nbytes if mesh_mode else ring.nbytes)
                          / 1e9, 3),
         "window_transitions_max": num_slots * B,
         "pipeline": pipeline,

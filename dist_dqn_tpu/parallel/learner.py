@@ -242,6 +242,28 @@ def make_sharded_train_step(train_step, mesh: Mesh, data_specs,
     return jax.jit(sharded, donate_argnums=0)
 
 
+def replicated_device_views(tree, devices):
+    """Per-device single-device views of a mesh-REPLICATED pytree
+    (ISSUE 15, sharded collect): every mesh device already holds a full
+    replica of a ``P()``-sharded array, so handing shard ``s``'s
+    collect program ``views[s]`` moves ZERO bytes — the Sebulba
+    actor-side param refresh without the PR 10 host mirror (which paid
+    one D2H per chunk and re-uploaded on dispatch). The caller owns
+    lifetime: views alias the replica buffers, so snapshot (copy/cast)
+    the tree first if a donated consumer will overwrite it."""
+
+    def view(x, d):
+        for sh in x.addressable_shards:
+            if sh.device == d:
+                return sh.data
+        # Uncommitted (host-resident) leaf — e.g. a single-device test
+        # tree that never replicated: a put is correct, just not free.
+        return jax.device_put(x, d)
+
+    return [jax.tree.map(lambda x, d=d: view(x, d), tree)
+            for d in devices]
+
+
 def global_metrics(metrics: Dict) -> Dict:
     """Device-get + float-cast a metrics dict for logging; mirrors each
     value into a ``dqn_mesh_<name>`` registry gauge on the way."""

@@ -104,6 +104,13 @@ class ShardedHostReplay:
         self.samplers: Optional[List[RingPrioritySampler]] = None
         #: flat-leaf stride for global slot encoding (shard * stride + local)
         self.leaf_stride = self.num_slots * self.lanes_per_shard
+        #: bytes appended INTO each shard's ring (ISSUE 15): together
+        #: with the per-shard evacuated-byte counters this is the
+        #: conservation pair — a sharded-collect run feeds shard s's
+        #: ring exactly the bytes shard s's own device evacuated, so a
+        #: cross-shard lane scatter (or a lost lane block) shows up as
+        #: an inequality, per shard, not washed out in the total.
+        self.bytes_by_shard: List[int] = [0] * self.num_shards
 
     # -- construction -------------------------------------------------------
     def attach_priority_samplers(self, n_step: int, alpha: float,
@@ -148,6 +155,9 @@ class ShardedHostReplay:
         that shard's generation fence)."""
         self.rings[shard].add_chunk(obs, action, reward, terminated,
                                     truncated)
+        self.bytes_by_shard[shard] += sum(
+            np.asarray(a).nbytes
+            for a in (obs, action, reward, terminated, truncated))
 
     def state_dict(self) -> Dict[str, np.ndarray]:
         """Whole-window snapshot, one sub-dict per shard — the sidecar

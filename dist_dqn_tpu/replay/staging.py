@@ -278,7 +278,8 @@ class StreamedEvacuator:
     constant, so per-slice device slicing would cancel the win.
     """
 
-    def __init__(self, num_slices: int = 4, name: str = "host_replay"):
+    def __init__(self, num_slices: int = 4, name: str = "host_replay",
+                 shard: Optional[int] = None):
         if num_slices < 1:
             raise ValueError(
                 f"evacuator num_slices must be >= 1, got {num_slices}")
@@ -297,6 +298,17 @@ class StreamedEvacuator:
         self._c_slices = reg.counter(
             tm.HOST_REPLAY_EVAC_SLICES,
             "sub-chunk D2H slices streamed", labels)
+        # Sharded collect (ISSUE 15): when this evacuator drains one dp
+        # shard's lane block, its bytes carry an explicit {shard} label
+        # too — the per-shard conservation evidence scaling_bench's
+        # collect arm reads (each shard's ring fed by its OWN device).
+        self._c_shard_bytes = None
+        if shard is not None:
+            self._c_shard_bytes = reg.counter(
+                tm.HOST_REPLAY_SHARD_D2H_BYTES,
+                "bytes evacuated from this shard's own device into its "
+                "own ring (zero cross-shard lane scatter)",
+                {"loop": "host_replay", "shard": str(shard)})
 
     def start(self, records: Any) -> _EvacJob:
         """Dispatch the slice split + async host copies for one chunk.
@@ -353,6 +365,8 @@ class StreamedEvacuator:
                 on_slice_done(i)
         self.bytes_total += nbytes
         self._c_bytes.inc(nbytes)
+        if self._c_shard_bytes is not None:
+            self._c_shard_bytes.inc(nbytes)
         return {"bytes": nbytes, "slices": len(job.bounds),
                 "evac_s": time.perf_counter() - job.submitted_at}
 
@@ -372,7 +386,8 @@ class EvacuationWorker:
 
     def __init__(self, evacuator: StreamedEvacuator,
                  on_slice: Callable[[Any, int, int], None],
-                 name: str = "host_replay"):
+                 name: str = "host_replay",
+                 shard: Optional[int] = None):
         self._evac = evacuator
         self._on_slice = on_slice
         self._q: "queue.Queue" = queue.Queue()
@@ -394,6 +409,16 @@ class EvacuationWorker:
         self._h_lag = reg.histogram(
             tm.HOST_REPLAY_SLICE_LAG_SECONDS,
             "slice publication lag behind its chunk's submission", labels)
+        # Sharded collect (ISSUE 15): the per-shard evac gauge — the
+        # last drained chunk's evacuation wall for THIS shard's lane
+        # block, so a straggler shard shows up by label, not buried in
+        # the fan-in max the loop's fence reports.
+        self._g_shard_evac = None
+        if shard is not None:
+            self._g_shard_evac = reg.gauge(
+                tm.HOST_REPLAY_SHARD_EVAC_SECONDS,
+                "last chunk's evacuation wall for this shard's lane "
+                "block", {"loop": "host_replay", "shard": str(shard)})
         self._thread = threading.Thread(
             target=self._run, name=f"evac-{name}", daemon=True)
         self._thread.start()
@@ -452,6 +477,8 @@ class EvacuationWorker:
                 stats = self._evac.drain(job, self._on_slice,
                                          on_slice_done=_lag)
                 self._h_evac.observe(stats["evac_s"])
+                if self._g_shard_evac is not None:
+                    self._g_shard_evac.set(stats["evac_s"])
                 self._flight.record("queue", f"evac.{self._name}.drained",
                                     slices=stats["slices"],
                                     bytes=stats["bytes"],

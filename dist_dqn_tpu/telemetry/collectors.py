@@ -59,6 +59,20 @@ HOST_REPLAY_SLICE_LAG_SECONDS = "dqn_host_replay_slice_lag_seconds"
 HOST_REPLAY_FENCE_WAIT_SECONDS = "dqn_host_replay_fence_wait_seconds"
 HOST_REPLAY_OVERLAP = "dqn_host_replay_evac_overlap_frac"
 
+# Sharded collect (ISSUE 15): data-parallel acting for the host-replay
+# runtime. COLLECT_SECONDS observes each shard's collect DISPATCH
+# enqueue wall ({loop, shard} — async dispatch, so growth means that
+# shard's device queue is full and the host is rate-limited by it, the
+# dqn_mesh_chunk_dispatch_seconds semantic); COLLECT_LANE_BLOCK is the
+# env lanes each shard's own collect program acts over; the SHARD_*
+# evac pair carries the per-shard D2H evidence — each shard's bytes
+# leave ITS OWN device for ITS OWN ring, so per-shard conservation is
+# the zero-cross-shard-scatter proof scaling_bench's collect arm reads.
+HOST_REPLAY_COLLECT_SECONDS = "dqn_host_replay_collect_seconds"
+HOST_REPLAY_COLLECT_LANE_BLOCK = "dqn_host_replay_collect_lane_block"
+HOST_REPLAY_SHARD_EVAC_SECONDS = "dqn_host_replay_shard_evac_seconds"
+HOST_REPLAY_SHARD_D2H_BYTES = "dqn_host_replay_shard_d2h_bytes_total"
+
 # Host-replay sample-side pipeline (ISSUE 5): the H2D prefetcher — the
 # sample/gather wall moved off the critical path, the residual
 # main-thread wait, generation-stale drops, and the batched PER

@@ -526,10 +526,13 @@ def main():
                              "dp mesh of this many devices (0 = all; "
                              "multi-process runs use the GLOBAL device "
                              "list). Fused: env lanes + replay shard "
-                             "per device. Host-replay: env-lane blocks "
-                             "+ one host ring / evac worker / sample "
-                             "prefetcher per device. Gradients pmean "
-                             "over the mesh either way; apex uses "
+                             "per device. Host-replay: one COLLECT "
+                             "program + env-lane block + host ring / "
+                             "evac worker / sample prefetcher per "
+                             "device (sharded collect — acting is "
+                             "data-parallel too, zero cross-shard "
+                             "lane scatter). Gradients pmean over the "
+                             "mesh either way; apex uses "
                              "--learner-devices instead")
     parser.add_argument("--coordinator", default=None,
                         help="multi-host: host:port of process 0's "

@@ -34,7 +34,7 @@ from typing import Dict, Iterable, Tuple
 #: Bump on ANY change to the field set below, and append the new
 #: (version, digest) pair to SIDECAR_HISTORY — scripts/check_ckpt_schema.py
 #: prints the expected digest on mismatch.
-SIDECAR_VERSION = 1
+SIDECAR_VERSION = 2
 
 #: Scalar fields present in every host_loop sidecar.
 SIDECAR_SCALAR_FIELDS: Tuple[str, ...] = (
@@ -53,6 +53,11 @@ SIDECAR_SCALAR_FIELDS: Tuple[str, ...] = (
     "wb_count",          # deferred priority write-back entries serialized
     "has_stats",         # episode-stat scalars of the dispatched chunk ride
     "has_pending",       # serial path: next chunk's records ride along
+    "sharded_collect",   # v2 (ISSUE 15): collect-carry placement pin —
+                         # sharded runs keep per-shard carries in the
+                         # sidecar (carry{s}_leaf{i}), single-collect
+                         # runs keep the one carry in the orbax tree;
+                         # a mismatch cannot restore either way
 )
 
 #: Conditional scalars: present only when their ``has_*`` flag is set.
@@ -78,6 +83,12 @@ SIDECAR_PATTERNS: Tuple[str, ...] = (
     r"^wb\d+_slot_gen$",
     r"^wb_prios$",
     r"^pending_[a-z_]+$",
+    # v2 (ISSUE 15, sharded collect): per-shard collect carries —
+    # carry{s}_leaf{i} is leaf i of shard s's CollectCarry, flattened
+    # against the freshly-initialized carry's treedef — and the serial
+    # path's per-shard pending records (pending{s}_{field}).
+    r"^carry\d+_leaf\d+$",
+    r"^pending\d+_[a-z_]+$",
 )
 
 
@@ -97,6 +108,7 @@ def sidecar_digest() -> str:
 #: lint failure — history is how a version number stays meaningful.
 SIDECAR_HISTORY: Dict[int, str] = {
     1: "948b5e00114da529",
+    2: "0e038b7fe0331a3d",
 }
 
 _COMPILED = None

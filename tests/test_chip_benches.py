@@ -94,6 +94,16 @@ def test_scaling_bench_smoke():
         assert leg["env_steps_per_sec_per_chip"] == pytest.approx(
             leg["env_steps_per_sec"] / leg["dp_size"], rel=0.01)
     assert row["scaling"]["grad_steps_x"] > 0
+    # Collect arm (ISSUE 15): the dpN leg ran the sharded collect and
+    # the per-shard byte conservation held (the bench fails otherwise;
+    # this pins the row shape the battery captures).
+    collect = row["collect"]
+    assert collect["sharded"] is True
+    assert collect["d2h_bytes_conserved_per_shard"] is True
+    assert len(collect["d2h_bytes_by_shard"]) == 2
+    assert collect["env_steps_x_vs_dp1"] > 0
+    assert legs["dp2"]["collect_lane_block"] * 2 == \
+        legs["dp1"]["collect_lane_block"]
 
 
 def test_roofline_inscan_smoke():

@@ -308,6 +308,30 @@ def test_donation_bites_and_honors_rationale(tmp_path):
         ("dist_dqn_tpu/rogue.py", 3)]
 
 
+def test_donation_targets_cover_snapshot_and_lane_sites(tmp_path):
+    """ISSUE 15 drift-bites: the sharded-collect era's entry points —
+    a jitted param-SNAPSHOT program and any LANE-block split — must
+    stay in the donation lint's scope even renamed away from
+    'collect'; a rationale comment still excuses them."""
+    pkg = tmp_path / "dist_dqn_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def snapshot_params(p):\n"
+        "    return p\n"
+        "def split_lane_blocks(t):\n"
+        "    return t\n"
+        "bad = jax.jit(split_lane_blocks)\n"
+        "# donation: snapshot must copy, the learner owns the params\n"
+        "@jax.jit\n"
+        "def snapshot_params_ok(p):\n"
+        "    return p\n")
+    failures = donation.scan(tmp_path)
+    assert sorted((rel, line) for rel, line, _ in failures) == [
+        ("dist_dqn_tpu/rogue.py", 2), ("dist_dqn_tpu/rogue.py", 7)]
+
+
 def test_donation_covers_partial_jit_spelling(tmp_path):
     pkg = tmp_path / "dist_dqn_tpu"
     pkg.mkdir()

@@ -1,0 +1,338 @@
+"""Sharded collect (ISSUE 15): data-parallel acting for the host-replay
+runtime — per-shard collect programs feeding per-shard rings with zero
+cross-shard lane scatter.
+
+The pins:
+
+* dp=1 MECHANISM pin: the sharded-collect machinery forced through a
+  1-shard mesh (``sharded_collect=True``) is BIT-IDENTICAL
+  (param_checksum + full loss trajectory) to the untouched
+  single-collect dp=1 program — the sharding is pure plumbing;
+* dp=2 LANE-BLOCK-EQUIVALENT DRAW pin: each shard's ring holds exactly
+  the transitions an independently-run per-shard collect program
+  (same shard keys, same lane block, same epsilon schedule) produces —
+  the zero-scatter path changes WHERE collect runs, never WHAT it
+  draws;
+* dp=2 per-shard FENCE HAMMER: concurrent per-shard appends vs
+  per-shard prefetched sampling never deliver a torn or stale batch;
+* dp=2 KILL-AT-CHUNK-K RESUME with the v2 sidecar: the per-shard
+  collect carries ride the sidecar (carry{s}_leaf{i}) and restore
+  bit-identically;
+* chaos seam ``host_replay.collect``: per-shard crash raises (and the
+  resumed process closes the trip), stall recovers in-process;
+* per-shard byte conservation: every shard's own device evacuated
+  exactly the bytes its own ring appended.
+
+Needs the 8-device CPU mesh conftest.py forces.
+"""
+import dataclasses
+import glob
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.config import CONFIGS
+
+
+def _cfg(prioritized=False, min_fill=64):
+    cfg = CONFIGS["cartpole"]
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096,
+                                   min_fill=min_fill,
+                                   prioritized=prioritized),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+
+
+def _require_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs >= {n} CPU devices from conftest")
+
+
+def _losses(out):
+    return [r["loss"] for r in out["history"] if "loss" in r]
+
+
+def test_dp1_sharded_collect_path_bit_identical():
+    """THE mechanism pin: forcing the whole sharded machinery (1-shard
+    mesh, per-shard collect program, ShardedHostReplay, shard_map+pmean
+    train) reproduces the untouched dp=1 program bit for bit."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _cfg()
+    kw = dict(total_env_steps=2000, chunk_iters=50, log_fn=lambda s: None)
+    ref = run_host_replay(cfg, **kw, mesh_devices=1)
+    out = run_host_replay(cfg, **kw, mesh_devices=1, sharded_collect=True)
+    assert not ref["sharded_collect"] and out["sharded_collect"]
+    assert out["param_checksum"] == ref["param_checksum"]
+    assert out["grad_steps"] == ref["grad_steps"] > 0
+    assert _losses(out) == _losses(ref)
+    # 1-shard conservation: one shard owns every evacuated byte.
+    assert out["d2h_bytes_by_shard"] == [out["d2h_bytes_total"]]
+    assert out["ring_bytes_by_shard"] == [out["d2h_bytes_total"]]
+
+
+def test_dp2_lane_block_equivalent_draw(tmp_path):
+    """Shard s's ring content == an independently-run per-shard collect
+    program over shard s's lane block (same shard key, same epsilon
+    schedule, same params — training disabled so params stay at init).
+    This pins WHAT the sharded path draws, against a reference that
+    never touches the sharded plumbing."""
+    _require_devices(2)
+    import jax
+
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.host_replay_loop import make_collect_chunk, \
+        run_host_replay
+    from dist_dqn_tpu.models import build_network
+
+    cfg = _cfg(min_fill=10**9)  # never train: params stay at init
+    chunks, chunk_iters, B, dp = 4, 50, 8, 2
+    ckpt = str(tmp_path / "lanepin")
+    out = run_host_replay(cfg, total_env_steps=chunks * chunk_iters * B,
+                          chunk_iters=chunk_iters, mesh_devices=dp,
+                          checkpoint_dir=ckpt, log_fn=lambda s: None)
+    assert out["grad_steps"] == 0 and out["sharded_collect"]
+    side_path = sorted(glob.glob(ckpt + "/host_loop_*.npz"))[-1]
+    with np.load(side_path) as f:
+        side = {k: f[k] for k in f.files}
+
+    # Reference: run shard s's program standalone on the default device.
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+    init_collect, collect = make_collect_chunk(cfg, env, net, 0,
+                                               lanes=B // dp,
+                                               num_shards=dp)
+    rng = jax.random.PRNGKey(cfg.seed)
+    k_carry, k_learn = jax.random.split(rng)
+    shard_keys = list(jax.random.split(k_carry, dp))
+    carry0 = init_collect(shard_keys[0])
+    obs_example = jax.tree.map(lambda x: x[0], carry0.obs)
+    init_learner, _ = make_learner(net, cfg.learner, axis_name="dp")
+    params0 = init_learner(k_learn, obs_example).params
+
+    T = chunks * chunk_iters
+    for s in range(dp):
+        carry = init_collect(shard_keys[s])
+        obs_parts, act_parts, rew_parts = [], [], []
+        for _ in range(chunks):
+            carry, recs, _ = collect(carry, params0, chunk_iters)
+            obs_parts.append(np.asarray(recs["obs"]))
+            act_parts.append(np.asarray(recs["action"]))
+            rew_parts.append(np.asarray(recs["reward"]))
+        np.testing.assert_array_equal(
+            np.concatenate(obs_parts)[:T],
+            side[f"ring_shard{s}_obs"][:T],
+            err_msg=f"shard {s} obs window != lane-block-equivalent draw")
+        np.testing.assert_array_equal(
+            np.concatenate(act_parts)[:T],
+            side[f"ring_shard{s}_action"][:T])
+        np.testing.assert_array_equal(
+            np.concatenate(rew_parts)[:T],
+            side[f"ring_shard{s}_reward"][:T])
+
+
+def test_dp2_per_shard_byte_conservation():
+    """Each shard's own device evacuated exactly the bytes its own ring
+    appended, shards equal, summing to the run total — the
+    zero-cross-shard-scatter evidence, in both evacuation modes."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _cfg()
+    for pipeline in (True, False):
+        out = run_host_replay(cfg, total_env_steps=1600, chunk_iters=50,
+                              mesh_devices=2, pipeline=pipeline,
+                              log_fn=lambda s: None)
+        by_shard = out["d2h_bytes_by_shard"]
+        assert len(by_shard) == 2 and len(set(by_shard)) == 1
+        assert sum(by_shard) == out["d2h_bytes_total"]
+        assert by_shard == out["ring_bytes_by_shard"], pipeline
+        assert out["collect_lane_block"] == 4
+
+
+def test_dp2_per_shard_fence_hammer():
+    """Concurrent per-shard appends (one writer thread per shard, the
+    evac-worker shape) race per-shard prefetched sampling: every popped
+    batch must be internally consistent (obs == action == reward
+    stamps) and at least as new as its shard's requested fence."""
+    from dist_dqn_tpu.replay.sharded import ShardedHostReplay
+    from dist_dqn_tpu.replay.staging import SamplePrefetcher
+
+    store = ShardedHostReplay(2, 128, 2, (3,), np.float32)
+
+    def append(s, v, C=16):
+        store.add_chunk(s,
+                        np.full((C, 2, 3), v, np.float32),
+                        np.full((C, 2), int(v), np.int32),
+                        np.full((C, 2), v, np.float32),
+                        np.zeros((C, 2), bool),
+                        np.zeros((C, 2), bool))
+
+    def make_sample(s):
+        def sample_fn(k):
+            rng = np.random.default_rng(
+                np.random.SeedSequence(0, spawn_key=(k, s)))
+            hs = store.rings[s].sample(rng, 16, n_step=1, gamma=0.99)
+            return {"obs": hs.batch.obs, "action": hs.batch.action,
+                    "reward": hs.batch.reward}, hs
+        return sample_fn
+
+    for s in (0, 1):
+        append(s, 1.0)
+    prefetchers = [
+        SamplePrefetcher(make_sample(s), depth=2,
+                         name=f"test_sc_hammer_s{s}",
+                         wait_generation=store.rings[s].wait_generation)
+        for s in (0, 1)
+    ]
+    stop = threading.Event()
+    errors = []
+
+    def writer(s):
+        v = 2.0
+        while not stop.is_set():
+            append(s, v)
+            v += 1.0
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=writer, args=(s,),
+                                name=f"hammer-writer-s{s}")
+               for s in (0, 1)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(40):
+            fences = store.generation
+            for s, p in enumerate(prefetchers):
+                p.request(1, fences[s])
+            for s, p in enumerate(prefetchers):
+                dev, aux = p.pop(fences[s])
+                if aux.generation < fences[s]:
+                    errors.append(("stale delivered", s,
+                                   aux.generation, fences[s]))
+                obs = np.asarray(dev["obs"])
+                act = np.asarray(dev["action"]).astype(np.float32)
+                rew = np.asarray(dev["reward"])
+                if not (np.all(obs == act[:, None])
+                        and np.all(rew == act)):
+                    errors.append(("torn batch", s, obs[:2], act[:2]))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        for p in prefetchers:
+            p.close()
+    assert not errors, errors[0]
+
+
+def test_dp2_killed_resume_restores_sidecar_collect_carries(tmp_path):
+    """Kill-at-chunk-k at dp=2 with the v2 sidecar: the per-shard
+    collect carries live in the sidecar (carry{s}_leaf{i}), the orbax
+    tree carries only the learner, and the resumed run is BIT-IDENTICAL
+    to the uninterrupted never-checkpointed reference."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.utils import ckpt_schema
+
+    cfg = _cfg()
+    kw = dict(total_env_steps=2400, chunk_iters=50, mesh_devices=2)
+    ref = run_host_replay(cfg, **kw, log_fn=lambda s: None)
+
+    ckpt_dir = str(tmp_path / "dp2sc")
+    plan = chaos.FaultPlan(seed=9, events=(
+        chaos.FaultEvent("host_replay.chunk", "crash", at_hit=4),))
+    with chaos.installed(plan) as inj:
+        with pytest.raises(chaos.ChaosInjectedError):
+            run_host_replay(cfg, **kw, log_fn=lambda s: None,
+                            checkpoint_dir=ckpt_dir,
+                            save_every_frames=400)
+        side_path = sorted(glob.glob(ckpt_dir + "/host_loop_*.npz"))[-1]
+        with np.load(side_path) as f:
+            assert int(f["sidecar_version"]) == \
+                ckpt_schema.SIDECAR_VERSION
+            assert bool(f["sharded_collect"])
+            for s in (0, 1):
+                assert f"carry{s}_leaf0" in f.files, f.files
+            ckpt_schema.validate_sidecar(f.files)
+        logs = []
+        out = run_host_replay(cfg, **kw, checkpoint_dir=ckpt_dir,
+                              save_every_frames=400,
+                              log_fn=lambda s: logs.append(s))
+        assert inj.open_trips() == [], inj.open_trips()
+    resumed = [json.loads(s) for s in logs if "resumed_at_frames" in s]
+    assert resumed and resumed[0]["resumed_dp"] == 2
+    assert out["param_checksum"] == ref["param_checksum"]
+    assert out["grad_steps"] == ref["grad_steps"]
+    la, lb = _losses(ref), _losses(out)
+    assert lb == la[len(la) - len(lb):]
+
+
+def test_collect_mode_mismatch_resume_refused(tmp_path):
+    """A sharded-collect checkpoint refuses a single-collect resume
+    (and names the pin): the collect carries live in different places
+    per mode, so a silent cross-load is impossible by construction."""
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _cfg()
+    ckpt_dir = str(tmp_path / "mode")
+    kw = dict(total_env_steps=1200, chunk_iters=50,
+              checkpoint_dir=ckpt_dir, save_every_frames=400,
+              log_fn=lambda s: None)
+    run_host_replay(cfg, **kw, mesh_devices=1, sharded_collect=True)
+    with pytest.raises(ValueError, match="sharded_collect"):
+        run_host_replay(cfg, **kw, mesh_devices=1)
+
+
+def test_chaos_collect_crash_and_stall():
+    """The host_replay.collect seam: a per-shard crash kills the
+    dispatch pass loudly; a stall delays one shard's dispatch and the
+    completed pass marks the recovery."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _cfg()
+    kw = dict(total_env_steps=1600, chunk_iters=50, mesh_devices=2,
+              log_fn=lambda s: None)
+
+    plan = chaos.FaultPlan(seed=1, events=(
+        chaos.FaultEvent("host_replay.collect", "stall", at_hit=3,
+                         args={"delay_s": 0.05}),))
+    with chaos.installed(plan) as inj:
+        out = run_host_replay(cfg, **kw)
+        assert [e["fault"] for e in inj.injected] == ["stall"]
+        assert inj.open_trips() == []
+    assert out["grad_steps"] > 0
+
+    plan = chaos.FaultPlan(seed=2, events=(
+        chaos.FaultEvent("host_replay.collect", "crash", at_hit=5),))
+    with chaos.installed(plan) as inj:
+        with pytest.raises(chaos.ChaosInjectedError,
+                           match="host_replay.collect"):
+            run_host_replay(cfg, **kw)
+        assert [e["fault"] for e in inj.injected] == ["crash"]
+
+
+def test_dp2_sharded_collect_refuses_optout():
+    """dp>1 always runs the sharded collect path — the single-device
+    lane-scatter program is gone; asking for it is a loud error, not a
+    silent fallback."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    with pytest.raises(ValueError, match="sharded collect"):
+        run_host_replay(_cfg(), total_env_steps=400, chunk_iters=50,
+                        mesh_devices=2, sharded_collect=False,
+                        log_fn=lambda s: None)
