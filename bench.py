@@ -383,7 +383,25 @@ def _measure(jax, device, smoke: bool):
         .inc(measure_chunks * chunk * num_envs)
     chunk_hist = reg.histogram("dqn_chunk_seconds", "fused chunk wall")
     chunk_hist.observe(dt / measure_chunks)
-    tmc.observe_device_ring(carry.replay)
+    _, ring_slots = tmc.observe_device_ring(carry.replay)
+    # Experience lineage (ISSUE 16): reconstruct the measured window's
+    # collect stamps (the timed loop cannot touch the host per chunk —
+    # that would fence it) and age them exactly as train.py does, so
+    # the BENCH row carries the fused loop's sample-age distribution.
+    gsteps_chunk = float(jax.device_get(metrics["grad_steps_in_chunk"]))
+    _lineage = tmc.FusedLineageTable()
+    _per_chunk = dt / measure_chunks
+    for i in range(measure_chunks):
+        _lineage.on_chunk(gsteps_chunk * (i + 1),
+                          max(1, ring_slots // chunk),
+                          now=t0 + (i + 1) * _per_chunk)
+    _age_h, _stale_h = tmc.lineage_histograms("fused")
+    extras["sample_age_p50_s"] = round(
+        tmc.histogram_quantile(_age_h, 0.5), 6)
+    extras["sample_age_p99_s"] = round(
+        tmc.histogram_quantile(_age_h, 0.99), 6)
+    extras["staleness_versions_p99"] = round(
+        tmc.histogram_quantile(_stale_h, 0.99), 2)
     gsteps = float(jax.device_get(metrics["grad_steps_in_chunk"]))
     if gsteps:
         reg.histogram(tmc.GRAD_LATENCY,

@@ -131,6 +131,23 @@ def _emit(row: dict) -> None:
     print(json.dumps(row), flush=True)
 
 
+def _lineage_fields() -> dict:
+    """Experience-lineage staleness quantiles (ISSUE 16). The service
+    ages every sampled batch's wire birth/version stamps into the
+    shared ``apex`` lineage histograms; quantiles are cumulative over
+    the process (probe + measure legs)."""
+    import dist_dqn_tpu.telemetry.collectors as tmc
+    age_h, stale_h = tmc.lineage_histograms("apex")
+    if not age_h.count:
+        return {}
+    return {
+        "sample_age_p50_s": round(tmc.histogram_quantile(age_h, 0.5), 6),
+        "sample_age_p99_s": round(tmc.histogram_quantile(age_h, 0.99), 6),
+        "staleness_versions_p99":
+            round(tmc.histogram_quantile(stale_h, 0.99), 2),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Transport A/B (ISSUE 9 + 14): legacy JSON codec vs zero-copy wire vs
 # shm ring vs frame-dedup plane vs batched slot publishes
@@ -643,6 +660,7 @@ def main() -> int:
                     "emulator/preprocessing in the loop (see module "
                     "docstring)",
             **_roundtrip_fields(summary),
+            **_lineage_fields(),
             **{k: summary[k] for k in
                ("env_steps", "grad_steps", "replay_size", "ring_dropped",
                 "tcp_backpressure", "bad_records", "actor_restarts")},

@@ -66,6 +66,23 @@ def _steady_fields(out) -> dict:
     }
 
 
+def _lineage_fields() -> dict:
+    """Experience-lineage staleness quantiles (ISSUE 16). The loop ages
+    each sampled batch's birth/version stamps into the shared lineage
+    histograms at draw time; the quantiles here are cumulative over the
+    process (in ``--ab`` mode, over all legs so far)."""
+    import dist_dqn_tpu.telemetry.collectors as tmc
+    age_h, stale_h = tmc.lineage_histograms("host_replay")
+    if not age_h.count:
+        return {}
+    return {
+        "sample_age_p50_s": round(tmc.histogram_quantile(age_h, 0.5), 6),
+        "sample_age_p99_s": round(tmc.histogram_quantile(age_h, 0.99), 6),
+        "staleness_versions_p99":
+            round(tmc.histogram_quantile(stale_h, 0.99), 2),
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--allow-cpu", action="store_true")
@@ -158,7 +175,7 @@ def main() -> int:
             "frame_dedup": True,
             "window_transitions": out["window_transitions_max"],
             "wall_s_incl_setup": round(wall, 1),
-            **steady, **extra,
+            **steady, **_lineage_fields(), **extra,
         }
 
     if args.ab:

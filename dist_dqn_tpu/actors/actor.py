@@ -99,13 +99,17 @@ def _step_and_encode(env, actions, actor_id: int, t: int,
 
 def _step_and_encode_zc(env, actions, enc: "ingest.StepEncoder",
                         actor_id: int, t: int, shard: int,
-                        q_sel, q_max):
+                        q_sel, q_max, params_version: int = 0):
     """The zero-copy twin of ``_step_and_encode``: raw array bytes into
     the encoder's reusable buffer — no JSON, no per-field copies. The
     q planes (from the act reply this step consumed) are Q(obs, action)
     of THIS record's ``obs`` field, which is exactly the alignment the
-    learner's priority fold needs (ISSUE 9 piece 3). Returns
-    (obs, t + 1, payload memoryview — consumed before the next call).
+    learner's priority fold needs (ISSUE 9 piece 3). Every record also
+    carries the lineage trailer (ISSUE 16): its birth wall-time plus
+    ``params_version`` — the learner grad-step count echoed from the act
+    reply this step consumed, i.e. the version of the params that CHOSE
+    these actions. Returns (obs, t + 1, payload memoryview — consumed
+    before the next call).
     """
     obs, next_obs, reward, terminated, truncated = env.step(actions)
     payload = enc.encode_step(
@@ -113,7 +117,8 @@ def _step_and_encode_zc(env, actions, enc: "ingest.StepEncoder",
          "terminated": terminated.astype(np.uint8),
          "truncated": truncated.astype(np.uint8),
          "next_obs": next_obs},
-        actor=actor_id, t=t + 1, shard=shard, q_sel=q_sel, q_max=q_max)
+        actor=actor_id, t=t + 1, shard=shard, q_sel=q_sel, q_max=q_max,
+        birth_time=time.time(), params_version=params_version)
     return obs, t + 1, payload
 
 
@@ -192,6 +197,7 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     box = ShmMailbox(act_box)
     heartbeat, steps_total, hb_stage = _actor_telemetry(actor_id, "actor")
     steps = 0
+    params_ver = 0          # learner grad-step version, echoed per reply
     try:
         while not ring.push(payload):
             time.sleep(0.001)
@@ -205,6 +211,7 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             if enc is not None and ingest.is_zc(data):
                 actions, q_sel, q_max, hdr = ingest.decode_reply(data)
                 shard = hdr["shard"]   # sticky routing tag, echoed back
+                params_ver = hdr.get("params_version", params_ver)
             else:
                 # No NACK handling here: a rejected LOCAL hello raises
                 # HelloRejectedError in the service process itself
@@ -216,7 +223,8 @@ def run_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
             _chaos_step_seam()
             if enc is not None:
                 obs, t, payload = _step_and_encode_zc(
-                    env, actions, enc, actor_id, t, shard, q_sel, q_max)
+                    env, actions, enc, actor_id, t, shard, q_sel, q_max,
+                    params_version=params_ver)
             else:
                 obs, t, payload = _step_and_encode(env, actions, actor_id,
                                                    t)
@@ -296,6 +304,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
     obs = env.reset()
     t = 0
     shard = 0
+    params_ver = 0          # learner grad-step version, echoed per reply
     if transport == "zerocopy":
         schema = ingest.step_schema(obs.shape[1:], obs.dtype, num_envs)
         dedup_fs = _negotiate_dedup(env, obs, transport, dedup)
@@ -337,6 +346,7 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         if enc is not None and ingest.is_zc(reply):
             actions, q_sel, q_max, hdr = ingest.decode_reply(reply)
             shard = hdr["shard"]
+            params_ver = hdr.get("params_version", params_ver)
         else:
             arrays, meta = decode_arrays(reply)
             if meta.get("kind") == CORRUPT_FRAME_NACK_KIND:
@@ -358,7 +368,8 @@ def run_remote_actor(actor_id: int, env_name: str, num_envs: int, seed: int,
         _chaos_step_seam()
         if enc is not None:
             obs, t, payload = _step_and_encode_zc(
-                env, actions, enc, actor_id, t, shard, q_sel, q_max)
+                env, actions, enc, actor_id, t, shard, q_sel, q_max,
+                params_version=params_ver)
         else:
             obs, t, payload = _step_and_encode(
                 env, actions, actor_id, t, compress="auto")

@@ -129,11 +129,16 @@ def _build_pool(rng: np.random.Generator, actor_id: int, lanes: int,
             "next_obs": obs_batch()}
         if zc:
             # bytes() copy: pool records must outlive the encoder's
-            # reusable scratch.
+            # reusable scratch. Lineage stamps (ISSUE 16): born at pool
+            # build under params version 0 — a feeder never refreshes
+            # its acting params, so the sampled-age/staleness families
+            # the bench row reads honestly say "pre-generated, version
+            # 0" rather than staying empty.
             steps.append(bytes(enc.encode_step(
                 arrays, actor=actor_id, t=t + 1,
                 q_sel=rng.normal(size=(lanes,)).astype(np.float32),
-                q_max=rng.normal(size=(lanes,)).astype(np.float32))))
+                q_max=rng.normal(size=(lanes,)).astype(np.float32),
+                birth_time=time.time(), params_version=0)))
         else:
             steps.append(encode_arrays(
                 arrays, {"kind": "step", "actor": actor_id, "t": t + 1}))

@@ -55,6 +55,13 @@ def main():
                              "text) on this port; 0 = ephemeral. Worker "
                              "hosts are scraped independently of the "
                              "learner (docs/observability.md)")
+    parser.add_argument("--fleet-dir", default=None,
+                        help="fleet registry directory (ISSUE 16): "
+                             "announce this worker's telemetry endpoint "
+                             "as an actor-role descriptor so the fleet "
+                             "aggregator (python -m dist_dqn_tpu."
+                             "telemetry.fleet) federates it; defaults "
+                             "to $DQN_FLEET_DIR")
     parser.add_argument("--forensics-dir", default=None,
                         help="arm this worker's stall watchdog: a wedged "
                              "step loop dumps a forensics bundle (named "
@@ -71,10 +78,19 @@ def main():
         import os
 
         os.environ["DQN_FORENSICS_DIR"] = args.forensics_dir
+    if args.fleet_dir:
+        import os
+
+        os.environ["DQN_FLEET_DIR"] = args.fleet_dir
     if args.telemetry_port is not None:
         from dist_dqn_tpu import telemetry
+        from dist_dqn_tpu.telemetry import fleet as _fleet
         server = telemetry.start_server(args.telemetry_port)
         print(json.dumps({"telemetry_port": server.port}))
+        # Registered AFTER bind so the descriptor carries the real
+        # (possibly ephemeral) port; removed by the exit lifecycle.
+        _fleet.register_endpoint("actor", server.port,
+                                 labels={"actor_id": str(args.actor_id)})
     host, port = args.address.rsplit(":", 1)
     seed = args.seed if args.seed is not None else 1000 + 7 * args.actor_id
     run_remote_actor(args.actor_id, args.env, args.num_envs, seed,

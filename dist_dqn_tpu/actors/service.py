@@ -677,10 +677,17 @@ class ApexLearnerService:
         self.telemetry_server = None
         if rt.telemetry_port is not None:
             from dist_dqn_tpu.telemetry import start_server
+            from dist_dqn_tpu.telemetry import fleet as _fleet
             self.telemetry_server = start_server(rt.telemetry_port,
                                                  host=rt.telemetry_host)
             self.log.log_fn(json.dumps(
                 {"telemetry_port": self.telemetry_server.port}))
+            # Fleet registry (ISSUE 16): announce after bind — the
+            # descriptor must carry the resolved ephemeral port. No-op
+            # unless DQN_FLEET_DIR is configured for the run.
+            _fleet.register_endpoint("learner", self.telemetry_server.port,
+                                     host=rt.telemetry_host,
+                                     labels={"loop": "apex"})
         self.global_env_steps = 0
         self._resume_global = 0
         self._next_sync = 0.0
@@ -720,6 +727,10 @@ class ApexLearnerService:
         self._tm_train_inflight = reg.gauge(
             "dqn_service_train_inflight",
             "pipelined train steps awaiting priority write-back")
+        # Experience-lineage staleness (ISSUE 16): every sampled batch
+        # ages its wire lineage stamps into the shared families.
+        self._tm_sample_age, self._tm_sample_staleness = \
+            tmc.lineage_histograms("apex")
         # Ingest fast path (ISSUE 2): dispatch accounting. One counter
         # series per dispatched-program kind, cached on first use.
         self._tm_device_calls: Dict[str, object] = {}
@@ -1179,7 +1190,8 @@ class ApexLearnerService:
                     acts_np[sl], actor=actor, t=t,
                     shard=self.router.shard_for(actor),
                     q_sel=q_rows[0] if q_rows else None,
-                    q_max=q_rows[1] if q_rows else None)
+                    q_max=q_rows[1] if q_rows else None,
+                    params_version=int(self.grad_steps))
             else:
                 payload = encode_arrays({"action": acts_np[sl]})
             if actor < self.rt.num_actors:
@@ -1479,6 +1491,7 @@ class ApexLearnerService:
             rid = self._reply_actions(actor, arrays["obs"], t)
             emitted = self.assemblers[actor].drain()
             if emitted is not None:
+                self._stamp_lineage(emitted, meta)
                 self._prio_await.append((actor, rid, emitted))
             return
         emitted = self.assemblers[actor].drain()
@@ -1499,9 +1512,27 @@ class ApexLearnerService:
                 self.replay.add(emitted, priorities=prios,
                                 shard=self.router.shard_for(actor))
             else:
+                self._stamp_lineage(emitted, meta)
                 self._pending.append(emitted)
                 self._pending_count += emitted["action"].shape[0]
         self._reply_actions(actor, arrays["obs"], t)
+
+    def _stamp_lineage(self, emitted: Dict, meta: Dict) -> None:
+        """Attach the record's wire lineage stamp (ISSUE 16) to every
+        transition it emitted. Record granularity: an n-step window
+        spans at most n_step actor steps, so the completing record's
+        birth time / acting-params version bound the whole window —
+        plenty for a staleness histogram. The replay stores are
+        field-generic (add/sample/checkpoint/reshard carry any key),
+        and the train-arg selection names its fields explicitly, so the
+        extra keys ride to sample time and never reach the device."""
+        bt = meta.get("birth_time")
+        if bt is None:
+            return
+        n = emitted["action"].shape[0]
+        emitted["lineage_birth_time"] = np.full(n, bt, np.float64)
+        emitted["lineage_params_version"] = np.full(
+            n, int(meta.get("params_version", 0)), np.int64)
 
     def _insert_actor_prio(self) -> None:
         """Insert transitions whose priorities came off the wire
@@ -1717,9 +1748,14 @@ class ApexLearnerService:
         generations were snapshotted at draw time, under the shard
         locks), else the facade's inline draw."""
         if self._shard_sampler is not None:
-            return self._shard_sampler.sample(batch_size, beta)
-        items, idx, weights = self.replay.sample(batch_size, beta)
-        return items, idx, weights, self.replay.generation(idx)
+            out = self._shard_sampler.sample(batch_size, beta)
+        else:
+            items, idx, weights = self.replay.sample(batch_size, beta)
+            out = items, idx, weights, self.replay.generation(idx)
+        tmc.observe_sample_lineage(out[0], self.grad_steps,
+                                   self._tm_sample_age,
+                                   self._tm_sample_staleness)
+        return out
 
     def _stage_batch(self, batch_size: int, beta: float) -> None:
         """Sample one batch and begin its H2D upload (replay/staging.py):

@@ -314,6 +314,11 @@ def main():
                         help="dump a JSON snapshot of the telemetry "
                              "registry to this path at exit (offline "
                              "runs; same data as /metrics.json)")
+    parser.add_argument("--fleet-dir", default=None,
+                        help="fleet registry directory (ISSUE 16): "
+                             "announce this eval's telemetry endpoint "
+                             "to the run's aggregator; defaults to "
+                             "$DQN_FLEET_DIR")
     args = parser.parse_args()
     if args.export_params and (args.all_steps or args.host_env):
         parser.error("--export-params applies to the single-point JAX-env "
@@ -325,12 +330,19 @@ def main():
         from dist_dqn_tpu.telemetry import install_snapshot_dump
 
         install_snapshot_dump(args.telemetry_snapshot)
+    if args.fleet_dir:
+        import os as _os
+
+        _os.environ["DQN_FLEET_DIR"] = args.fleet_dir
     if args.telemetry_port is not None:
         from dist_dqn_tpu import telemetry
+        from dist_dqn_tpu.telemetry import fleet as _fleet
 
         _srv = telemetry.start_server(args.telemetry_port,
                                       host=args.telemetry_host)
         print(json.dumps({"telemetry_port": _srv.port}))
+        _fleet.register_endpoint("eval", _srv.port,
+                                 host=args.telemetry_host)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     try:

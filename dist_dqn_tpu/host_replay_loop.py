@@ -1105,6 +1105,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 store.load_state_dict(ring_side)
             env_steps = int(side["env_steps"])
             grad_steps = int(side["grad_steps"])
+            # Resume lineage baseline (ISSUE 16): post-restore appends
+            # stamp the resumed version, not 0.
+            if mesh_mode:
+                store.current_params_version = grad_steps
+            else:
+                ring.current_params_version = grad_steps
             sample_k = int(side["sample_k"])
             if prefetcher is not None:
                 # Per-index batch RNG: the prefetcher must continue the
@@ -1613,6 +1619,10 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                             _wb_add(auxes, metrics)
                     did = grads_this_chunk
                     grad_steps += did
+                    # Lineage baseline (ISSUE 16): appends from here on
+                    # are born at this params version, and staleness at
+                    # sample time is measured against it.
+                    store.current_params_version = grad_steps
                     sample_s_total += ev_sample_s
                     prefetch_wait_s_total += ev_wait_s
                 elif grads_this_chunk:
@@ -1690,6 +1700,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                                 sample_k += 1
                     did = grads_this_chunk
                     grad_steps += did
+                    # Lineage baseline (ISSUE 16): see the mesh branch.
+                    ring.current_params_version = grad_steps
                     sample_s_total += ev_sample_s
                     prefetch_wait_s_total += ev_wait_s
             # Chunk g+1's evacuation: every sample for chunk g's event

@@ -11,6 +11,8 @@ planes (``q_sel``/``q_max``, f32 per lane) when ``FLAG_HAS_Q`` is set::
     +------+------+-----+-----+-------+--------+--------+-------+-------+
     | field 0 bytes | field 1 bytes | ... | [q_sel f32] | [q_max f32]   |
     +---------------------------------------------------------------+
+    | [lineage trailer: birth_time f64, params_version u32]         |
+    +---------------------------------------------------------------+
 
 Layering: this is the PAYLOAD format. On TCP it rides UNCHANGED under
 the ISSUE 8 integrity frame (``magic|len|crc32`` — corruption handling
@@ -75,8 +77,20 @@ FLAG_HAS_Q = 0x01           # q_sel/q_max f32[lanes] planes appended
 # lane — the record body is JUST the novel plane; see DedupStepEncoder).
 FLAG_DEDUP = 0x02
 FLAG_DEDUP_CANON = 0x04
+# Experience lineage (ISSUE 16): the record carries a trailing
+# ``<d I`` stamp — birth wall-time (unix seconds, f64) + the params
+# version the actor was acting with (u32) — aged at sample time into
+# the dqn_replay_sample_* histograms. On KIND_REPLY the trailer is the
+# ``<I`` params version alone (the learner telling the actor what it
+# just shipped). Trailers sit at the very END of the payload so every
+# existing offset (fields, q planes, dedup tables) is untouched.
+FLAG_LINEAGE = 0x08
 WIRE_FLAGS = {"has_q": FLAG_HAS_Q, "dedup": FLAG_DEDUP,
-              "dedup_canon": FLAG_DEDUP_CANON}
+              "dedup_canon": FLAG_DEDUP_CANON, "lineage": FLAG_LINEAGE}
+
+_LINEAGE = struct.Struct("<dI")     # birth_time f64, params_version u32
+LINEAGE_BYTES = _LINEAGE.size
+_REPLY_LINEAGE = struct.Struct("<I")  # params_version u32
 
 _F32 = np.dtype(np.float32)
 _I32 = np.dtype(np.int32)
@@ -91,7 +105,17 @@ _U32_MASK = 0xFFFFFFFF      # per-lane frame ids wrap at u32 (equality-
 WIRE_HISTORY = {
     2: "4322d42d8ca0fadd",
     3: "b7fb2f531a18e303",
+    4: "26d5d1a9a3b4fb80",
 }
+
+
+def _lineage_meta(payload, flags: int, meta: Dict) -> Dict:
+    """Fold the trailing lineage stamp (when present) into ``meta``."""
+    if flags & FLAG_LINEAGE:
+        bt, ver = _LINEAGE.unpack_from(payload, len(payload) - LINEAGE_BYTES)
+        meta["birth_time"] = bt
+        meta["params_version"] = ver
+    return meta
 
 
 class WireFormatError(ValueError):
@@ -166,7 +190,8 @@ class StepEncoder:
     def __init__(self, schema: TrajectorySchema):
         self.schema = schema
         self._q_off = HEADER_BYTES + schema.record_bytes
-        self._buf = bytearray(self._q_off + 2 * 4 * schema.lanes)
+        self._buf = bytearray(self._q_off + 2 * 4 * schema.lanes
+                              + LINEAGE_BYTES)
         # Per-field destination views, built once.
         self._views = []
         off = HEADER_BYTES
@@ -188,7 +213,9 @@ class StepEncoder:
     def encode_step(self, arrays: Dict[str, np.ndarray], actor: int,
                     t: int, shard: int = 0,
                     q_sel: Optional[np.ndarray] = None,
-                    q_max: Optional[np.ndarray] = None) -> memoryview:
+                    q_max: Optional[np.ndarray] = None,
+                    birth_time: Optional[float] = None,
+                    params_version: Optional[int] = None) -> memoryview:
         flags = 0
         end = self._q_off
         for name, dst in self._views:
@@ -198,6 +225,11 @@ class StepEncoder:
             np.copyto(self._q_sel, q_sel, casting="same_kind")
             np.copyto(self._q_max, q_max, casting="same_kind")
             end += 2 * 4 * self.schema.lanes
+        if birth_time is not None:
+            flags |= FLAG_LINEAGE
+            _LINEAGE.pack_into(self._buf, end, float(birth_time),
+                               int(params_version or 0) & _U32_MASK)
+            end += LINEAGE_BYTES
         _HDR.pack_into(self._buf, 0, MAGIC, PROTOCOL_VERSION, KIND_STEP,
                        flags, shard, actor, t, self.schema.lanes, 0)
         return memoryview(self._buf)[:end]
@@ -250,6 +282,8 @@ class StepDecoder:
                 f"record lanes {hdr['lanes']} != schema "
                 f"{self.schema.lanes}")
         want = self._with_q if hdr["flags"] & FLAG_HAS_Q else self._base
+        if hdr["flags"] & FLAG_LINEAGE:
+            want += LINEAGE_BYTES
         if len(payload) != want:
             raise WireFormatError(
                 f"record length {len(payload)} != schema-required {want} "
@@ -266,25 +300,33 @@ class StepDecoder:
             meta["q_sel"] = np.frombuffer(payload, _F32, lanes, self._base)
             meta["q_max"] = np.frombuffer(payload, _F32, lanes,
                                           self._base + 4 * lanes)
+        _lineage_meta(payload, hdr["flags"], meta)
         chaos.mark_recovered("ingest.decode")
         return out, meta
 
 
 def encode_reply(action: np.ndarray, actor: int, t: int, shard: int = 0,
                  q_sel: Optional[np.ndarray] = None,
-                 q_max: Optional[np.ndarray] = None) -> bytes:
+                 q_max: Optional[np.ndarray] = None,
+                 params_version: Optional[int] = None) -> bytes:
     """Learner -> actor reply: actions (+ optional q planes the actor
     folds into its NEXT step frame — the actor-side priority loop).
+    ``params_version`` (the learner's grad-step count at act time) rides
+    as a lineage trailer the actor echoes into its next step records.
     Replies are small (a few bytes per lane); a fresh bytes object per
     reply keeps the mailbox/connection write simple."""
     lanes = int(action.shape[0])
     flags = FLAG_HAS_Q if q_sel is not None else 0
+    if params_version is not None:
+        flags |= FLAG_LINEAGE
     parts = [_HDR.pack(MAGIC, PROTOCOL_VERSION, KIND_REPLY, flags, shard,
                        actor, t, lanes, 0),
              np.ascontiguousarray(action, _I32).tobytes()]
     if q_sel is not None:
         parts.append(np.ascontiguousarray(q_sel, _F32).tobytes())
         parts.append(np.ascontiguousarray(q_max, _F32).tobytes())
+    if params_version is not None:
+        parts.append(_REPLY_LINEAGE.pack(int(params_version) & _U32_MASK))
     return b"".join(parts)
 
 
@@ -297,7 +339,8 @@ def decode_reply(payload) -> Tuple[np.ndarray, Optional[np.ndarray],
                               f"{hdr['kind']}")
     lanes = hdr["lanes"]
     want = HEADER_BYTES + 4 * lanes \
-        + (8 * lanes if hdr["flags"] & FLAG_HAS_Q else 0)
+        + (8 * lanes if hdr["flags"] & FLAG_HAS_Q else 0) \
+        + (_REPLY_LINEAGE.size if hdr["flags"] & FLAG_LINEAGE else 0)
     if len(payload) != want:
         raise WireFormatError(
             f"reply length {len(payload)} != required {want}")
@@ -307,13 +350,17 @@ def decode_reply(payload) -> Tuple[np.ndarray, Optional[np.ndarray],
         off = HEADER_BYTES + 4 * lanes
         q_sel = np.frombuffer(payload, _F32, lanes, off)
         q_max = np.frombuffer(payload, _F32, lanes, off + 4 * lanes)
+    if hdr["flags"] & FLAG_LINEAGE:
+        (hdr["params_version"],) = _REPLY_LINEAGE.unpack_from(
+            payload, len(payload) - _REPLY_LINEAGE.size)
     return action, q_sel, q_max, hdr
 
 
 def max_record_bytes(schema: TrajectorySchema) -> int:
-    """Worst-case encoded step size (header + body + q planes) — the
-    shm slot-sizing input."""
-    return HEADER_BYTES + schema.record_bytes + 2 * 4 * schema.lanes
+    """Worst-case encoded step size (header + body + q planes +
+    lineage trailer) — the shm slot-sizing input."""
+    return (HEADER_BYTES + schema.record_bytes + 2 * 4 * schema.lanes
+            + LINEAGE_BYTES)
 
 
 # ---------------------------------------------------------------------------
@@ -429,9 +476,10 @@ class _DedupLayout:
 def max_dedup_record_bytes(schema: TrajectorySchema,
                            frame_stack: int) -> int:
     """Worst-case dedup step size (every frame slot of both stacks
-    inline + tables) — the shm slot-sizing input for dedup actors."""
+    inline + tables + lineage trailer) — the shm slot-sizing input for
+    dedup actors."""
     lay = _DedupLayout(schema, frame_stack)
-    return lay.general_len(True, 2 * lay.fs * lay.lanes)
+    return lay.general_len(True, 2 * lay.fs * lay.lanes) + LINEAGE_BYTES
 
 
 class DedupStepEncoder:
@@ -557,7 +605,9 @@ class DedupStepEncoder:
     def encode_step(self, arrays: Dict[str, np.ndarray], actor: int,
                     t: int, shard: int = 0,
                     q_sel: Optional[np.ndarray] = None,
-                    q_max: Optional[np.ndarray] = None) -> memoryview:
+                    q_max: Optional[np.ndarray] = None,
+                    birth_time: Optional[float] = None,
+                    params_version: Optional[int] = None) -> memoryview:
         lay = self.lay
         obs, next_obs = arrays["obs"], arrays["next_obs"]
         has_q = q_sel is not None
@@ -612,6 +662,11 @@ class DedupStepEncoder:
                     fr = self._frames[lane]
                     self._frames[lane] = {i: fr[i] for i in keep
                                           if i in fr}
+        if birth_time is not None:
+            flags |= FLAG_LINEAGE
+            _LINEAGE.pack_into(self._buf, end, float(birth_time),
+                               int(params_version or 0) & _U32_MASK)
+            end += LINEAGE_BYTES
         _HDR.pack_into(self._buf, 0, MAGIC, PROTOCOL_VERSION, KIND_STEP,
                        flags, shard, actor, t, lay.lanes, 0)
         return memoryview(self._buf)[:end]
@@ -752,11 +807,12 @@ class DedupStepDecoder:
 
     def _decode_canon(self, payload, hdr, has_q: bool):
         lay = self.lay
+        lin = LINEAGE_BYTES if hdr["flags"] & FLAG_LINEAGE else 0
         if len(payload) != (lay.canon_len_q if has_q
-                            else lay.canon_len_nq):
+                            else lay.canon_len_nq) + lin:
             raise WireFormatError(
                 f"canonical dedup record length {len(payload)} != "
-                f"{lay.canon_len(has_q)}")
+                f"{lay.canon_len(has_q) + lin}")
         if not self._valid:
             raise WireFormatError(
                 "canonical dedup record before a seeding general "
@@ -795,15 +851,17 @@ class DedupStepDecoder:
             meta["q_sel"] = fb(payload, _F32, lanes, lay.small_end)
             meta["q_max"] = fb(payload, _F32, lanes,
                                lay.small_end + 4 * lanes)
+        _lineage_meta(payload, hdr["flags"], meta)
         self.records_canon += 1
         self.frames_reused += self._canon_reused
         self.bytes_saved += (lay.plain_len_q if has_q
-                             else lay.plain_len_nq) - len(payload)
+                             else lay.plain_len_nq) + lin - len(payload)
         chaos.mark_recovered("ingest.decode")
         return out, meta
 
     def _decode_general(self, payload, hdr, has_q: bool):
         lay = self.lay
+        lin = LINEAGE_BYTES if hdr["flags"] & FLAG_LINEAGE else 0
         base = lay.body_off(has_q)
         if len(payload) < base + lay.table_bytes + 2:
             raise WireFormatError(
@@ -814,10 +872,10 @@ class DedupStepDecoder:
                              ).reshape(lay.lanes, 2 * lay.fs)
         n_off = base + lay.table_bytes
         (n_inline,) = struct.unpack_from("<H", payload, n_off)
-        if len(payload) != lay.general_len(has_q, n_inline):
+        if len(payload) != lay.general_len(has_q, n_inline) + lin:
             raise WireFormatError(
                 f"dedup record length {len(payload)} != "
-                f"{lay.general_len(has_q, n_inline)} for "
+                f"{lay.general_len(has_q, n_inline) + lin} for "
                 f"{n_inline} inline frames")
         if self._valid:
             self._check_t(hdr)
@@ -878,6 +936,7 @@ class DedupStepDecoder:
         out["next_obs"] = next_stack.transpose(axes)
         self.records_general += 1
         self.frames_reused += 2 * lay.fs * lay.lanes - n_inline
-        self.bytes_saved += lay.plain_len(has_q) - len(payload)
+        self.bytes_saved += lay.plain_len(has_q) + lin - len(payload)
         chaos.mark_recovered("ingest.decode")
-        return out, self._meta(hdr, payload)
+        return out, _lineage_meta(payload, hdr["flags"],
+                                  self._meta(hdr, payload))

@@ -37,7 +37,13 @@ import numpy as np
 #: episode stream). Dedup itself is a HELLO CAPABILITY, not drift: a
 #: v3 actor that does not (or cannot) dedup simply never sets the
 #: flags, and the service decodes both layouts.
-PROTOCOL_VERSION = 3
+#: v4 = the experience-lineage lanes (ISSUE 16: FLAG_LINEAGE step
+#: records carry a birth wall-time + acting-params-version trailer,
+#: replies echo the learner's params version) — the staleness
+#: accounting input for dqn_replay_sample_age_seconds. Like dedup, the
+#: flag is optional per record; the VERSION is not: a v3 peer is
+#: refused loudly at hello/peek instead of mis-parsing the trailer.
+PROTOCOL_VERSION = 4
 
 
 @dataclasses.dataclass(frozen=True)

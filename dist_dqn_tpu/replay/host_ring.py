@@ -27,6 +27,7 @@ per slice against the link-priced fetch.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -123,6 +124,15 @@ class HostTimeRing:
         # _slot_gen, at t-slot granularity — a chunk overwrites whole
         # lane rows at once).
         self.slot_gen = np.zeros(num_slots, np.int64)
+        # Experience lineage (ISSUE 16): per-t-slot birth wall-time and
+        # acting-params version, stamped at append (chunk granularity —
+        # every lane of a slice shares the collect stamp) and aged at
+        # sample time into the dqn_replay_sample_* histograms. The loop
+        # advances ``current_params_version`` as it trains; appends
+        # default to it when the caller has no explicit stamp.
+        self.birth_time = np.zeros(num_slots, np.float64)
+        self.slot_version = np.zeros(num_slots, np.int64)
+        self.current_params_version = 0
         # Publish hooks (ISSUE 5): called under the fence lock with the
         # t-slot indices just written, AFTER the arrays/pos/size/
         # generation update — a prioritized sampler keeps its sum-tree
@@ -141,16 +151,26 @@ class HostTimeRing:
         self._c_sampled = reg.counter(tm.REPLAY_SAMPLED,
                                       "transitions drawn from the host "
                                       "ring", labels={"store": "host_ring"})
+        self._h_sample_age, self._h_sample_staleness = \
+            tm.lineage_histograms("host_replay", reg)
 
     @property
     def nbytes(self) -> int:
         return (self.obs.nbytes + self.action.nbytes + self.reward.nbytes
                 + self.terminated.nbytes + self.truncated.nbytes)
 
-    def add_chunk(self, obs, action, reward, terminated, truncated) -> None:
+    def add_chunk(self, obs, action, reward, terminated, truncated,
+                  birth_time: Optional[float] = None,
+                  params_version: Optional[int] = None) -> None:
         """Append [C, B, ...] arrays (one device chunk, or one streamed
         slice of one) in time order. Atomic under the generation fence:
-        ``generation`` bumps only after every array is written."""
+        ``generation`` bumps only after every array is written.
+
+        ``birth_time``/``params_version`` (ISSUE 16) stamp the slice's
+        lineage; omitted, the append wall-clock and the ring's
+        ``current_params_version`` stand in — right for the serial
+        collect->append path, one evacuation slice late in the
+        pipelined one (documented chunk-granularity accounting)."""
         C = action.shape[0]
         if C > self.num_slots:
             raise ValueError(f"chunk of {C} slices exceeds the "
@@ -162,6 +182,11 @@ class HostTimeRing:
             self.reward[idx] = reward
             self.terminated[idx] = terminated
             self.truncated[idx] = truncated
+            self.birth_time[idx] = (time.time() if birth_time is None
+                                    else float(birth_time))
+            self.slot_version[idx] = (self.current_params_version
+                                      if params_version is None
+                                      else int(params_version))
             self.pos = int((self.pos + C) % self.num_slots)
             self.size = int(min(self.size + C, self.num_slots))
             self.generation += 1
@@ -197,6 +222,8 @@ class HostTimeRing:
                 "terminated": self.terminated.copy(),
                 "truncated": self.truncated.copy(),
                 "slot_gen": self.slot_gen.copy(),
+                "birth_time": self.birth_time.copy(),
+                "slot_version": self.slot_version.copy(),
                 "pos": np.int64(self.pos), "size": np.int64(self.size),
                 "generation": np.int64(self.generation),
             }
@@ -220,6 +247,12 @@ class HostTimeRing:
             np.copyto(self.terminated, state["terminated"])
             np.copyto(self.truncated, state["truncated"])
             np.copyto(self.slot_gen, state["slot_gen"])
+            # Pre-v4 snapshots carry no lineage lanes: resume with
+            # zeroed stamps (staleness accounting restarts, training
+            # state is untouched) instead of refusing the checkpoint.
+            if "birth_time" in state:
+                np.copyto(self.birth_time, state["birth_time"])
+                np.copyto(self.slot_version, state["slot_version"])
             self.pos = int(state["pos"])
             self.size = int(state["size"])
             self.generation = int(state["generation"])
@@ -241,6 +274,23 @@ class HostTimeRing:
     # -- sampling -----------------------------------------------------------
     def _extra(self) -> int:
         return max(self.frame_stack - 1, 0)
+
+    def observe_lineage(self, t_idx: np.ndarray) -> None:
+        """Age the drawn slots' lineage stamps into the sample-age /
+        staleness histograms (ISSUE 16). Called by both samplers after
+        the fence is released — the stamps are telemetry, a racing
+        overwrite shifts an observation by one chunk at worst. Slots
+        never stamped (a resumed pre-v4 window) are skipped whole."""
+        births = self.birth_time[t_idx]
+        live = births > 0.0
+        if not live.any():
+            return
+        now = time.time()
+        self._h_sample_age.observe_many(
+            np.maximum(now - births[live], 0.0))
+        self._h_sample_staleness.observe_many(np.maximum(
+            self.current_params_version - self.slot_version[t_idx][live],
+            0))
 
     def can_sample(self, n_step: int) -> bool:
         return self.size > n_step + self._extra()
@@ -313,6 +363,7 @@ class HostTimeRing:
             generation = self.generation
             batch = self._gather_locked(t_idx, b_idx, n_step, gamma)
         self._c_sampled.inc(batch_size)
+        self.observe_lineage(t_idx)
         return HostSample(batch=batch, t_idx=t_idx, b_idx=b_idx,
                           generation=generation)
 
@@ -470,6 +521,7 @@ class RingPrioritySampler:
             generation = ring.generation
             batch = ring._gather_locked(t_idx, b_idx, self.n_step, gamma)
         ring._c_sampled.inc(batch_size)
+        ring.observe_lineage(t_idx)
         return batch, PerSample(leaf=leaf, t_idx=t_idx, b_idx=b_idx,
                                 slot_gen=slot_gen, weights=w,
                                 generation=generation)
@@ -509,6 +561,7 @@ class RingPrioritySampler:
             generation = ring.generation
             batch = ring._gather_locked(t_idx, b_idx, self.n_step, gamma)
         ring._c_sampled.inc(n)
+        ring.observe_lineage(t_idx)
         per = PerSample(leaf=leaf, t_idx=t_idx, b_idx=b_idx,
                         slot_gen=slot_gen,
                         weights=np.zeros(n, np.float32),

@@ -149,12 +149,25 @@ class ShardedHostReplay:
     def can_sample(self, n_step: int) -> bool:
         return all(r.can_sample(n_step) for r in self.rings)
 
+    @property
+    def current_params_version(self) -> int:
+        return self.rings[0].current_params_version
+
+    @current_params_version.setter
+    def current_params_version(self, v: int) -> None:
+        """Advance the lineage baseline on every shard (ISSUE 16): the
+        train loop is shard-agnostic, staleness accounting is per-ring."""
+        for r in self.rings:
+            r.current_params_version = int(v)
+
     def add_chunk(self, shard: int, obs, action, reward, terminated,
-                  truncated) -> None:
+                  truncated, birth_time: Optional[float] = None,
+                  params_version: Optional[int] = None) -> None:
         """Append one lane block to its owning shard's ring (atomic under
         that shard's generation fence)."""
         self.rings[shard].add_chunk(obs, action, reward, terminated,
-                                    truncated)
+                                    truncated, birth_time=birth_time,
+                                    params_version=params_version)
         self.bytes_by_shard[shard] += sum(
             np.asarray(a).nbytes
             for a in (obs, action, reward, terminated, truncated))

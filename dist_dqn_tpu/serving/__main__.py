@@ -105,6 +105,13 @@ def main():
                         help="bind address for --telemetry-port")
     parser.add_argument("--telemetry-snapshot", default=None,
                         help="dump a registry JSON snapshot here at exit")
+    parser.add_argument("--fleet-dir", default=None,
+                        help="fleet registry directory (ISSUE 16): "
+                             "announce this replica's metrics endpoint "
+                             "(the telemetry server when started, else "
+                             "the act server — it serves /metrics + "
+                             "/healthz too) to the run's aggregator; "
+                             "defaults to $DQN_FLEET_DIR")
     parser.add_argument("--forensics-dir", default=None,
                         help="arm the stall watchdog (serving.batcher "
                              "heartbeat) + forensics bundles, as on the "
@@ -193,6 +200,17 @@ def main():
         telemetry_server = telemetry.start_server(args.telemetry_port,
                                                   host=args.telemetry_host)
         print(json.dumps({"telemetry_port": telemetry_server.port}))
+    # Fleet registry (ISSUE 16): a replica is a fleet member like any
+    # actor — the descriptor points at whichever endpoint scrapes.
+    import os as _os
+    if args.fleet_dir:
+        _os.environ["DQN_FLEET_DIR"] = args.fleet_dir
+    from dist_dqn_tpu.telemetry import fleet as _fleet
+    if telemetry_server is not None:
+        _fleet.register_endpoint("serving", telemetry_server.port,
+                                 host=args.telemetry_host)
+    else:
+        _fleet.register_endpoint("serving", server.port, host=server.host)
     print(json.dumps({
         "serving_port": server.port, "serving_host": server.host,
         "policies": {pid: {"version": hdr["version"], "step": hdr["step"]}

@@ -207,6 +207,121 @@ FLIGHT_CAPACITY = "dqn_flight_capacity"
 FANIN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                  512.0, 1024.0, 2048.0, 4096.0, 8192.0)
 
+# Experience-lineage staleness accounting (ISSUE 16): every sampled
+# batch ages its records' wire lineage stamps. SAMPLE_AGE observes
+# now - birth wall-time (seconds); SAMPLE_STALENESS observes
+# current_grad_steps - acting_params_version — a count histogram, the
+# FANIN-style exception to the _seconds rule (docs/observability.md).
+# Both are labeled {loop="fused"|"apex"|"host_replay"} so the three
+# runtimes land in ONE family the fleet aggregator can federate.
+REPLAY_SAMPLE_AGE = "dqn_replay_sample_age_seconds"
+REPLAY_SAMPLE_STALENESS = "dqn_replay_sample_staleness_versions"
+
+#: Staleness-version buckets: grad-step gaps from lockstep (<=1) up to
+#: the deep off-policy tail a wedged actor or cold shard produces.
+STALENESS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                     256.0, 512.0, 1024.0, 4096.0, 16384.0, 65536.0)
+
+#: Sample-age buckets: sub-second lockstep sampling out to the
+#: hour-scale tail of a big, slowly-refreshed replay.
+SAMPLE_AGE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                      60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0)
+
+
+def lineage_histograms(loop: str, registry: Optional[Registry] = None):
+    """(sample-age, staleness-versions) histograms for one runtime loop
+    — the shared constructor all three runtimes use, so the families
+    cannot drift apart (the fused-vs-host-replay parity pin)."""
+    reg = registry if registry is not None else get_registry()
+    labels = {"loop": loop}
+    return (reg.histogram(REPLAY_SAMPLE_AGE,
+                          "age of sampled experience: sample wall-time "
+                          "minus the record's birth stamp",
+                          labels, buckets=SAMPLE_AGE_BUCKETS),
+            reg.histogram(REPLAY_SAMPLE_STALENESS,
+                          "grad steps between a sampled record's "
+                          "acting-params version and the current step",
+                          labels, buckets=STALENESS_BUCKETS))
+
+
+def observe_sample_lineage(items, current_version: float, age_hist,
+                           staleness_hist, now: Optional[float] = None
+                           ) -> bool:
+    """Age one sampled batch's lineage stamps into the histograms.
+    ``items`` is any mapping of sampled arrays; batches without lineage
+    keys (legacy-codec actors, pre-v4 checkpoints mid-migration) are a
+    silent no-op — staleness accounting degrades, it never gates
+    sampling. Returns whether anything was observed."""
+    births = items.get("lineage_birth_time")
+    if births is None or len(births) == 0:
+        return False
+    import time as _time
+
+    now = _time.time() if now is None else now
+    age_hist.observe_many([max(now - float(b), 0.0) for b in births])
+    versions = items.get("lineage_params_version")
+    if versions is not None:
+        cur = float(current_version)
+        staleness_hist.observe_many(
+            [max(cur - float(v), 0.0) for v in versions])
+    return True
+
+
+class FusedLineageTable:
+    """Host-side lineage accounting for the fused (on-device) runtime
+    (ISSUE 16). The device ring carries no wall-clock lanes — adding
+    them would cost HBM for data the compiled chunk never reads — so
+    the fused loop stamps at COLLECT instead: each chunk boundary
+    records (birth wall-time, params version) for the slots that chunk
+    appended. Sampling inside the compiled chunk is uniform over the
+    live ring window and every chunk contributes the same slot count,
+    so observing each live chunk once per boundary matches the true
+    sample-age distribution in expectation — same families, same
+    buckets as the off-device runtimes' record-granular stamps."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._age, self._staleness = lineage_histograms("fused", registry)
+        self._chunks: list = []  # (birth_time, params_version), newest last
+
+    def on_chunk(self, grad_steps_total: float, window_chunks: int,
+                 now: Optional[float] = None) -> None:
+        """Record one collect boundary and age the live window.
+        ``window_chunks`` is how many chunks the device ring holds
+        (ring slots // chunk_iters) — older stamps have been evicted."""
+        import time as _time
+
+        now = _time.time() if now is None else now
+        self._chunks.append((now, float(grad_steps_total)))
+        del self._chunks[:-max(1, int(window_chunks))]
+        cur = float(grad_steps_total)
+        self._age.observe_many([max(now - b, 0.0)
+                                for b, _ in self._chunks])
+        self._staleness.observe_many([max(cur - v, 0.0)
+                                      for _, v in self._chunks])
+
+
+def histogram_quantile(hist, q: float) -> float:
+    """Prometheus-style ``histogram_quantile``: linear interpolation
+    within the bucket where the q-th observation falls. Operates on any
+    instrument exposing ``cumulative_buckets()``/``count`` (including a
+    just-rendered snapshot via ``telemetry.registry``). NaN when empty;
+    the highest finite bound when the quantile lands in +Inf."""
+    total = hist.count
+    if not total:
+        return float("nan")
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in hist.cumulative_buckets():
+        if cum >= rank:
+            if bound == float("inf"):
+                return prev_bound
+            if cum == prev_cum:
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, cum
+    return prev_bound
+
 
 def replay_gauges(store: str, registry: Optional[Registry] = None):
     """(size, capacity, ratio) gauges for one replay store. ``store``

@@ -137,6 +137,17 @@ class Histogram:
             self._sum += v
             self._count += 1
 
+    def observe_many(self, values: Iterable[float]) -> None:
+        """Batch observe: one lock hold for a whole sample batch (the
+        replay lineage path observes batch_size values per draw)."""
+        vals = [float(v) for v in values]
+        idxs = [bisect.bisect_left(self.bounds, v) for v in vals]
+        with self._lock:
+            for i in idxs:
+                self._counts[i] += 1
+            self._sum += sum(vals)
+            self._count += len(vals)
+
     @property
     def count(self) -> int:
         return self._count
@@ -186,6 +197,9 @@ class _NullInstrument:
         pass
 
     def observe(self, v: float) -> None:
+        pass
+
+    def observe_many(self, values) -> None:
         pass
 
     def cumulative_buckets(self):

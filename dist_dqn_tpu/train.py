@@ -119,6 +119,10 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                                 "grad steps per second (last chunk)",
                                 {"loop": "fused"}),
     }
+    # Experience-lineage accounting (ISSUE 16): host-side chunk stamp
+    # table — the fused loop's collect-granular twin of the record
+    # stamps the wire-fed runtimes carry.
+    _lineage = tmc.FusedLineageTable()
     # Learner-utilization config surface (ISSUE 6): the replay ratio /
     # bucketed batch width / actor dtype this run's rates were shaped by.
     from dist_dqn_tpu import loop_common as _lc
@@ -139,6 +143,12 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
         telemetry_server = telemetry.start_server(telemetry_port,
                                                   host=telemetry_host)
         log_fn(json.dumps({"telemetry_port": telemetry_server.port}))
+        # Fleet registry (ISSUE 16): after bind, so the descriptor
+        # carries the resolved port; no-op without DQN_FLEET_DIR.
+        from dist_dqn_tpu.telemetry import fleet as _fleet
+        _fleet.register_endpoint("learner", telemetry_server.port,
+                                 host=telemetry_host,
+                                 labels={"loop": "fused"})
     seed = cfg.seed if seed is None else seed
     total = total_env_steps or cfg.total_env_steps
     env = make_jax_env(cfg.env_name)
@@ -307,7 +317,13 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             _tm["episodes"].inc(max(float(metrics["episodes"]), 0.0))
             if float(metrics["episodes"]):
                 _tm["ep_return"].set(float(metrics["episode_return"]))
-            tmc.observe_device_ring(carry.replay)
+            _, ring_slots = tmc.observe_device_ring(carry.replay)
+            # Experience lineage (ISSUE 16): the fused loop stamps at
+            # collect — one (birth, version) row per chunk, aged over
+            # the live ring window into the same families the apex and
+            # host-replay runtimes observe per sampled record.
+            _lineage.on_chunk(_tm["grad_steps"].value,
+                              max(1, ring_slots // chunk_iters))
             row = {
                 "env_frames": frames,
                 "episode_return": float(metrics["episode_return"]),
@@ -484,6 +500,17 @@ def main():
                         help="dump a JSON snapshot of the telemetry "
                              "registry to this path at exit (offline "
                              "runs; same data as /metrics.json)")
+    parser.add_argument("--fleet-dir", default=None,
+                        help="fleet registry directory (ISSUE 16): this "
+                             "process writes a role-labeled endpoint "
+                             "descriptor next to every other member of "
+                             "the run so the fleet aggregator (python "
+                             "-m dist_dqn_tpu.telemetry.fleet) can "
+                             "federate one /metrics pane + /fleet/"
+                             "status rollup. Exported as DQN_FLEET_DIR "
+                             "so spawned actor/feeder processes "
+                             "register their own endpoints. Requires "
+                             "--telemetry-port")
     parser.add_argument("--forensics-dir", default=None,
                         help="arm the stall watchdog + divergence "
                              "sentinel (telemetry/watchdog.py): a "
@@ -641,6 +668,10 @@ def main():
         from dist_dqn_tpu.telemetry import flight as _flight_mod
         _os.environ["DQN_FLIGHT_RECORDER"] = "0"
         _flight_mod.configure(enabled=False)
+    if args.fleet_dir:
+        # Through the environment (like DQN_FORENSICS_DIR) so spawned
+        # actor/feeder processes register their own fleet descriptors.
+        _os.environ["DQN_FLEET_DIR"] = args.fleet_dir
     if args.forensics_dir:
         from dist_dqn_tpu.telemetry import watchdog as _wd
         _os.environ["DQN_FORENSICS_DIR"] = args.forensics_dir
@@ -750,6 +781,10 @@ def main():
             _srv = _telemetry.start_server(args.telemetry_port,
                                            host=args.telemetry_host)
             print(json.dumps({"telemetry_port": _srv.port}))
+            from dist_dqn_tpu.telemetry import fleet as _fleet
+            _fleet.register_endpoint("learner", _srv.port,
+                                     host=args.telemetry_host,
+                                     labels={"loop": "host_replay"})
         out = run_host_replay(
             cfg, total_env_steps=args.total_env_steps or cfg.total_env_steps,
             chunk_iters=args.chunk_iters, log_fn=print,

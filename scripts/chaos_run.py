@@ -29,6 +29,13 @@ running, so replayability is checkable byte-for-byte):
                   fall back to the previous intact one, and still end
                   BIT-IDENTICAL to an uninterrupted dp=2 reference,
                   all trips recovered.
+  fleet_pane      fleet observability game day (ISSUE 16): a real
+                  apex learner + two real remote-actor CLI processes
+                  registered in one fleet dir; SIGKILL one worker —
+                  within one registry sweep /fleet/status must name it
+                  dead and trip ingest_degraded, a restarted worker
+                  must flip the fleet back to healthy, and the run
+                  still reaches its step target.
   serving_reload  hot-reload under live load with a slowed restore and
                   a slowed + failed dispatch — every request answers
                   (the one injected failure as a structured error),
@@ -453,6 +460,155 @@ def scenario_sharded_ckpt_crash(seed: int, workdir: str) -> dict:
             "injections": injected, "open_trips": open_trips}
 
 
+def plan_fleet_pane(seed: int) -> FaultPlan:
+    # No seam events: the fault here is PROCESS-LEVEL (a SIGKILL the
+    # runner itself delivers to a worker the seed picks), because the
+    # invariant under test is the fleet pane's VIEW of a death, not a
+    # seam's recovery path. The plan still derives from the seed so
+    # --print-plan shows the (empty) schedule and the victim choice
+    # replays byte-for-byte.
+    return FaultPlan(seed=seed, events=())
+
+
+def scenario_fleet_pane(seed: int, workdir: str) -> dict:
+    """Fleet observability game day (ISSUE 16): a real apex learner +
+    two REAL remote-actor CLI processes, all registered in one fleet
+    dir. SIGKILL one worker mid-run: within ONE registry sweep the
+    /fleet/status rollup must name it ``dead`` and trip
+    ``ingest_degraded`` (half the actor quorum gone); a restarted
+    worker must flip the fleet back to healthy; and the run itself must
+    still reach its step target — the pane observes the death, the
+    stateless-worker protocol absorbs it."""
+    import signal
+    import subprocess
+
+    from dist_dqn_tpu.actors.service import (ApexLearnerService,
+                                             ApexRuntimeConfig)
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.telemetry import fleet
+
+    fleet_dir = os.path.join(workdir, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    os.environ[fleet.FLEET_ENV] = fleet_dir
+    stop_file = os.path.join(workdir, "fleet_pane_stop")
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=150),
+        learner=dataclasses.replace(cfg.learner, batch_size=16, n_step=2))
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=4, total_env_steps=4000,
+                           inserts_per_grad_step=32,
+                           num_remote_actors=2,
+                           spawn_remote_actors=False,  # real CLI workers
+                           telemetry_port=0, log_every_s=5.0)
+    service = ApexLearnerService(cfg, rt, log_fn=lambda s: None)
+    host, port = service.tcp_address
+
+    def _spawn_worker(actor_id: int):
+        return subprocess.Popen(
+            [sys.executable, "-m", "dist_dqn_tpu.actors.remote",
+             "--address", f"127.0.0.1:{port}", "--actor-id",
+             str(actor_id), "--env", "CartPole-v1", "--num-envs", "4",
+             "--telemetry-port", "0", "--fleet-dir", fleet_dir,
+             "--stop-file", stop_file],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=REPO)
+
+    workers = {1: _spawn_worker(1), 2: _spawn_worker(2)}
+    agg = fleet.FleetAggregator(fleet_dir, sweep_interval_s=0.5,
+                                scrape_timeout_s=2.0)
+    out = {}
+    runner = threading.Thread(
+        target=lambda: out.update(service.run()), daemon=True)
+    runner.start()
+    try:
+        # Quorum up: learner + both workers on the pane.
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            agg.sweep_once()
+            st = agg.status()
+            if st["counts"]["live"] >= 3:
+                break
+            time.sleep(0.3)
+        _check(st["counts"]["live"] >= 3,
+               f"fleet never converged to 3 live members: {st['counts']}")
+        _check(not st["ingest_degraded"],
+               "degraded with the whole quorum live")
+
+        victim_id = random.Random(f"{seed}:fleet_pane").choice([1, 2])
+        victim = workers[victim_id]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30.0)
+        # ONE sweep later the pane must tell the truth: the descriptor
+        # is still on disk (SIGKILL skips the exit lifecycle), the pid
+        # is gone, so the member is dead — and one of two actors dead
+        # trips the quorum gauge.
+        agg.sweep_once()
+        st = agg.status()
+        dead_name = f"actor-{victim.pid}"
+        _check(st["members"][dead_name]["state"] == "dead",
+               f"killed worker not dead on the pane: "
+               f"{st['members'].get(dead_name)}")
+        _check(st["ingest_degraded"],
+               "half the actor fleet is dead but ingest_degraded is 0")
+        _check(any(dead_name in a for a in st["alerts"]),
+               f"no alert names the dead member: {st['alerts']}")
+
+        # Restart (new pid, same actor id): the stateless worker
+        # re-introduces itself and the fleet flips back to healthy.
+        workers[victim_id] = _spawn_worker(victim_id)
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            agg.sweep_once()
+            st = agg.status()
+            back = st["members"].get(
+                f"actor-{workers[victim_id].pid}", {})
+            if back.get("state") == "live" and not st["ingest_degraded"]:
+                break
+            time.sleep(0.3)
+        _check(back.get("state") == "live",
+               f"restarted worker never went live: {back}")
+        _check(not st["ingest_degraded"],
+               "fleet still degraded after the restart")
+        # The merged pane carries the workers' own families under
+        # process/role labels — one scrape for the whole fleet.
+        merged = agg.render_metrics()
+        _check('dqn_actor_env_steps_total' in merged
+               and 'role="actor"' in merged,
+               "worker families missing from the federated exposition")
+
+        runner.join(timeout=300.0)
+        _check(not runner.is_alive(), "apex run did not finish")
+        _check(out.get("env_steps", 0) >= rt.total_env_steps,
+               f"run stalled at {out.get('env_steps')} env steps")
+    finally:
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        for w in workers.values():
+            try:
+                w.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        os.environ.pop(fleet.FLEET_ENV, None)
+        try:
+            os.unlink(stop_file)
+        except OSError:
+            pass
+    return {"scenario": "fleet_pane",
+            "plan": plan_fleet_pane(seed).to_dict(),
+            "victim_actor_id": victim_id,
+            "env_steps": out.get("env_steps"),
+            "grad_steps": out.get("grad_steps"),
+            "fleet_counts": st["counts"],
+            "open_trips": []}
+
+
 def scenario_serving_reload(seed: int, workdir: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -565,6 +721,7 @@ def scenario_serving_reload(seed: int, workdir: str) -> dict:
 
 SCENARIOS = {
     "apex_fleet": scenario_apex_fleet,
+    "fleet_pane": scenario_fleet_pane,
     "pipeline_wedge": scenario_pipeline_wedge,
     "ckpt_crash": scenario_ckpt_crash,
     "sharded_ckpt_crash": scenario_sharded_ckpt_crash,
@@ -573,6 +730,7 @@ SCENARIOS = {
 
 PLANS = {
     "apex_fleet": plan_apex_fleet,
+    "fleet_pane": plan_fleet_pane,
     "pipeline_wedge": lambda seed: plan_pipeline_wedge(seed, 4.0),
     "ckpt_crash": plan_ckpt_crash,
     "sharded_ckpt_crash": plan_sharded_ckpt_crash,

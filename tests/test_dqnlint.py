@@ -30,15 +30,17 @@ from dist_dqn_tpu import analysis  # noqa: E402
 from dist_dqn_tpu.analysis import baseline as baseline_mod  # noqa: E402
 from dist_dqn_tpu.analysis import core, registry, report  # noqa: E402
 from dist_dqn_tpu.analysis.plugins import chaos_seams  # noqa: E402
+from dist_dqn_tpu.analysis.plugins import heartbeat_stages  # noqa: E402
 from dist_dqn_tpu.analysis.plugins import lock_discipline  # noqa: E402
 from dist_dqn_tpu.analysis.plugins import (donation, mesh_axis,  # noqa: E402
                                            metrics, sockets, threads,
                                            wire)
 
-#: The nine checks ISSUE 13's acceptance pins: seven migrated + two new.
+#: The nine checks ISSUE 13's acceptance pins (seven migrated + two
+#: new), plus heartbeat-stages (ISSUE 16).
 EXPECTED_CHECKS = ("chaos-seams", "ckpt-schema", "donation",
-                   "lock-discipline", "mesh-axis", "metrics", "sockets",
-                   "threads", "wire")
+                   "heartbeat-stages", "lock-discipline", "mesh-axis",
+                   "metrics", "sockets", "threads", "wire")
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +268,73 @@ def test_metrics_docs_allowlist_entries_are_real():
         assert allowed in names, (
             f"{allowed} is allowlisted but no longer registered — "
             "drop it from DOCS_ALLOWLIST")
+
+
+def _heartbeat_repo(tmp_path, code: str, table_rows: str):
+    pkg = tmp_path / "dist_dqn_tpu"
+    pkg.mkdir()
+    (pkg / "loopy.py").write_text(code)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "### Heartbeat stage names\n\n"
+        "| stage | beaten by | stale means |\n|---|---|---|\n"
+        + table_rows + "\n\n# next section\n")
+    return core.AnalysisContext(tmp_path)
+
+
+def test_heartbeat_stages_green_on_consistent_tree(tmp_path):
+    """Literals, constants and f-string patterns all line up with the
+    table (including a {N}-templated row)."""
+    ctx = _heartbeat_repo(
+        tmp_path,
+        'STAGE = "pump.loop"\n'
+        'a = wd.heartbeat("fused.chunk")\n'
+        'b = wd.heartbeat(STAGE)\n'
+        'c = wd.heartbeat(f"collect.s{shard}")\n',
+        "| `fused.chunk` | x | y |\n"
+        "| `pump.loop` | x | y |\n"
+        "| `collect.s{N}` | x | y |")
+    assert heartbeat_stages.HeartbeatStagesCheck().run(ctx) == []
+
+
+def test_heartbeat_stages_bites_on_undocumented_stage(tmp_path):
+    """Drift bites: a stage registered in code but absent from the
+    table is a finding naming the stage."""
+    ctx = _heartbeat_repo(
+        tmp_path,
+        'a = wd.heartbeat("fused.chunk")\n'
+        'b = wd.heartbeat("rogue.stage")\n',
+        "| `fused.chunk` | x | y |")
+    findings = heartbeat_stages.HeartbeatStagesCheck().run(ctx)
+    assert [f.key for f in findings] == ["undocumented-stage:rogue.stage"]
+    assert findings[0].path == "dist_dqn_tpu/loopy.py"
+
+
+def test_heartbeat_stages_bites_on_ghost_row(tmp_path):
+    """The other direction: a table row no registration can produce
+    (renamed/removed stage) is a docs finding."""
+    ctx = _heartbeat_repo(
+        tmp_path,
+        'a = wd.heartbeat("fused.chunk")\n',
+        "| `fused.chunk` | x | y |\n"
+        "| `removed.stage` | x | y |")
+    findings = heartbeat_stages.HeartbeatStagesCheck().run(ctx)
+    assert [f.key for f in findings] == ["ghost-stage:removed.stage"]
+    assert findings[0].path == "docs/observability.md"
+
+
+def test_heartbeat_stages_real_repo_table_is_live():
+    """Every row in the shipped table is producible, and every shipped
+    registration is covered (the repo-green assertion, but also pinning
+    that the scan actually FINDS the known stages)."""
+    stages = heartbeat_stages.scan_stages(REPO)
+    texts = {t for t, _, _, _ in stages}
+    assert "fused.chunk" in texts
+    assert "serving.batcher" in texts  # via the BATCHER_STAGE constant
+    assert any("{" in t for t in texts)  # the sharded-collect f-string
+    rows = heartbeat_stages.doc_stages(REPO)
+    assert "host_replay.collect.s{N}" in rows
 
 
 def test_threads_bites_on_anonymous_thread(tmp_path):

@@ -1,0 +1,625 @@
+"""Fleet observability plane (ISSUE 16): registry, federation, lineage.
+
+Three layers under test, all jax-free:
+
+  * the run-scoped ENDPOINT REGISTRY — atomic descriptor writes,
+    lifecycle removal, live-collision refusal, aggregator-only GC of
+    dead members' litter;
+  * FEDERATION edges — a member killed between sweeps degrades to
+    labeled staleness while the fleet scrape stays 200 with the
+    last-good families still served; the forensics bundle names every
+    member (live ones with stacks, others with their state);
+  * EXPERIENCE LINEAGE — the v4 birth/version stamps survive the plain
+    codec, the dedup codec's canonical AND general records, and
+    batched shm slot publishes bit-exactly; the fused and host-replay
+    loops build their histograms through the one shared constructor so
+    the families cannot drift apart (the parity pin).
+
+The live-demo test at the bottom runs the real ``python -m
+dist_dqn_tpu.telemetry.fleet`` CLI against two in-process telemetry
+servers and reads the one pane over HTTP.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu import ingest
+from dist_dqn_tpu.telemetry import fleet
+from dist_dqn_tpu.telemetry import collectors as tmc
+from dist_dqn_tpu.telemetry.registry import Registry
+from dist_dqn_tpu.telemetry.server import TelemetryServer
+
+
+def _get(url: str, timeout: float = 3.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        assert resp.status == 200
+        return resp.read()
+
+
+def _dead_pid() -> int:
+    """A pid that is definitely not running (spawned, exited, reaped)."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+# ---------------------------------------------------------------------------
+# Endpoint registry: descriptors, lifecycle, collision, GC ownership
+# ---------------------------------------------------------------------------
+
+def test_register_endpoint_noop_without_fleet_dir(monkeypatch):
+    monkeypatch.delenv(fleet.FLEET_ENV, raising=False)
+    assert fleet.register_endpoint("learner", 1234) is None
+
+
+def test_register_endpoint_descriptor_and_removal(tmp_path):
+    reg = fleet.register_endpoint(
+        "actor", 4321, labels={"actor_id": "7"}, fleet_dir=str(tmp_path))
+    path = tmp_path / f"actor-{os.getpid()}.json"
+    assert reg is not None and reg.path == str(path)
+    desc = json.loads(path.read_text())
+    assert desc["role"] == "actor"
+    assert desc["pid"] == os.getpid()
+    assert desc["port"] == 4321
+    assert desc["labels"] == {"actor_id": "7"}
+    assert desc["hostname"] == socket.gethostname()
+    assert not list(tmp_path.glob("*.tmp.*")), "no torn tmp litter"
+    reg.close()
+    assert not path.exists()
+    reg.close()  # idempotent
+
+
+def test_register_endpoint_refuses_live_collision(tmp_path):
+    """Same role+pid, different endpoint identity, claimant alive (it is
+    US) — the registry refuses rather than aliasing two processes into
+    one fleet series."""
+    first = fleet.register_endpoint("learner", 1111,
+                                    fleet_dir=str(tmp_path))
+    try:
+        with pytest.raises(fleet.FleetRegistrationError):
+            fleet.register_endpoint("learner", 2222,
+                                    fleet_dir=str(tmp_path))
+        # Same identity again is a refresh, not a collision.
+        again = fleet.register_endpoint("learner", 1111,
+                                        fleet_dir=str(tmp_path))
+        assert again is not None
+        again.close()
+    finally:
+        first.close()
+
+
+def test_register_endpoint_reclaims_dead_pid_slot(tmp_path):
+    """A descriptor whose claimant pid is gone is pid-recycling litter:
+    the new registration owns the slot (the aggregator would have GC'd
+    the file; a slow aggregator must not block a restart)."""
+    path = tmp_path / f"eval-{os.getpid()}.json"
+    stale = {"schema_version": 1, "role": "eval", "pid": _dead_pid(),
+             "host": "127.0.0.1", "port": 9999,
+             "hostname": socket.gethostname(), "labels": {},
+             "start_time": 1.0, "manifest_hash": None}
+    path.write_text(json.dumps(stale))
+    reg = fleet.register_endpoint("eval", 1234, fleet_dir=str(tmp_path))
+    try:
+        assert json.loads(path.read_text())["port"] == 1234
+    finally:
+        reg.close()
+
+
+def test_dead_member_gc_is_aggregator_only(tmp_path):
+    """A crashed local member stays visible as ``dead`` in the rollup;
+    its descriptor file survives a live peer's registration and is
+    removed only by the aggregator after the grace window."""
+    dead = {"schema_version": 1, "role": "actor", "pid": _dead_pid(),
+            "host": "127.0.0.1", "port": 1,  # nothing listens there
+            "hostname": socket.gethostname(), "labels": {},
+            "start_time": 2.0, "manifest_hash": None}
+    dead_path = tmp_path / f"actor-{dead['pid']}.json"
+    dead_path.write_text(json.dumps(dead))
+
+    peer = fleet.register_endpoint("learner", 1234,
+                                   fleet_dir=str(tmp_path))
+    assert dead_path.exists(), "a live peer never GCs another's slot"
+
+    agg = fleet.FleetAggregator(str(tmp_path), scrape_timeout_s=0.3)
+    for i in range(fleet.DEAD_GC_SWEEPS):
+        agg.sweep_once()
+        assert dead_path.exists() == (i < fleet.DEAD_GC_SWEEPS - 1)
+    st = agg.status()
+    name = f"actor-{dead['pid']}"
+    assert st["members"][name]["state"] == "dead"
+    assert any("dead" in a for a in st["alerts"])
+    # One dead actor of one total: the fleet-level quorum gauge trips.
+    assert st["ingest_degraded"] is True
+    agg.sweep_once()  # post-GC sweeps keep the member in memory
+    assert agg.status()["members"][name]["state"] == "dead"
+    peer.close()
+
+
+# ---------------------------------------------------------------------------
+# Federation: merge, staleness degradation, forensics
+# ---------------------------------------------------------------------------
+
+def test_merge_expositions_labels_every_sample_line():
+    page_a = ("# HELP dqn_x things\n# TYPE dqn_x counter\n"
+              "dqn_x 3\n"
+              "# HELP dqn_h lat\n# TYPE dqn_h histogram\n"
+              'dqn_h_bucket{le="1"} 2\ndqn_h_bucket{le="+Inf"} 2\n'
+              "dqn_h_sum 0.5\ndqn_h_count 2\n")
+    page_b = '# HELP dqn_x things\n# TYPE dqn_x counter\ndqn_x{k="v w"} 5\n'
+    out = fleet.merge_expositions([
+        {"text": page_a, "labels": {"process": "learner-1",
+                                    "role": "learner"}},
+        {"text": page_b, "labels": {"process": "actor-2",
+                                    "role": "actor"}},
+    ])
+    assert out.count("# HELP dqn_x") == 1 and out.count("# TYPE dqn_x") == 1
+    assert 'dqn_x{process="learner-1",role="learner"} 3' in out
+    # Existing labels (with a space in the value) are preserved.
+    assert ('dqn_x{k="v w",process="actor-2",role="actor"} 5') in out
+    # _bucket/_sum/_count lines are labeled and stay under dqn_h's block.
+    assert ('dqn_h_bucket{le="+Inf",process="learner-1",role="learner"} 2'
+            in out)
+    assert 'dqn_h_count{process="learner-1",role="learner"} 2' in out
+    assert out.index("# TYPE dqn_h") < out.index("dqn_h_bucket")
+
+
+def test_killed_member_degrades_to_stale_and_scrape_stays_200(tmp_path):
+    """THE federation edge: kill one member between sweeps. Its families
+    keep serving from the last good scrape, its liveness flips, and the
+    fleet's own /metrics answers 200 throughout."""
+    reg_a, reg_b = Registry(), Registry()
+    reg_a.counter("dqn_alpha_total", "a").inc(7)
+    reg_b.counter("dqn_beta_total", "b").inc(9)
+    srv_a = TelemetryServer(registry=reg_a)
+    srv_b = TelemetryServer(registry=reg_b)
+    ra = fleet.register_endpoint("learner", srv_a.port,
+                                 fleet_dir=str(tmp_path))
+    # Descriptors key on role-pid; both servers live in this pytest
+    # process, so the second member needs a distinct role.
+    rb = fleet.register_endpoint("actor", srv_b.port,
+                                 fleet_dir=str(tmp_path))
+    agg = fleet.FleetAggregator(str(tmp_path), scrape_timeout_s=1.0)
+    pane = fleet.FleetServer(agg)
+    try:
+        agg.sweep_once()
+        st = agg.status()
+        assert st["counts"] == {"live": 2, "stale": 0, "dead": 0}
+        merged = _get(f"http://127.0.0.1:{pane.port}/metrics").decode()
+        assert f'dqn_alpha_total{{process="learner-{os.getpid()}"' in merged
+        assert f'dqn_beta_total{{process="actor-{os.getpid()}"' in merged
+
+        srv_b.close()  # the mid-run kill (pid — this process — lives on)
+        agg.sweep_once()
+        st = agg.status()
+        assert st["counts"] == {"live": 1, "stale": 0, "dead": 0} or \
+            st["counts"] == {"live": 1, "stale": 1, "dead": 0}
+        assert st["members"][f"actor-{os.getpid()}"]["state"] == "stale"
+        assert st["members"][f"actor-{os.getpid()}"]["staleness_s"] >= 0
+
+        merged = _get(f"http://127.0.0.1:{pane.port}/metrics").decode()
+        # Last-good families still served, liveness labeled honestly.
+        assert "dqn_beta_total" in merged
+        assert (f'dqn_fleet_member_up{{process="actor-{os.getpid()}",'
+                f'role="actor"}} 0') in merged
+        assert (f'dqn_fleet_member_up{{process="learner-{os.getpid()}",'
+                f'role="learner"}} 1') in merged
+        assert "dqn_fleet_sweeps_total 2" in merged
+
+        status_body = json.loads(
+            _get(f"http://127.0.0.1:{pane.port}/fleet/status"))
+        assert status_body["members"][f"actor-{os.getpid()}"]["state"] \
+            == "stale"
+    finally:
+        pane.close()
+        srv_a.close()
+        ra.close()
+        rb.close()
+
+
+def test_forensics_names_every_member(tmp_path):
+    reg_live = Registry()
+    srv = TelemetryServer(registry=reg_live)
+    ra = fleet.register_endpoint("learner", srv.port,
+                                 fleet_dir=str(tmp_path))
+    dead = {"schema_version": 1, "role": "actor", "pid": _dead_pid(),
+            "host": "127.0.0.1", "port": 1,
+            "hostname": socket.gethostname(), "labels": {},
+            "start_time": 2.0, "manifest_hash": None}
+    (tmp_path / f"actor-{dead['pid']}.json").write_text(json.dumps(dead))
+    agg = fleet.FleetAggregator(str(tmp_path), scrape_timeout_s=0.3)
+    try:
+        agg.sweep_once()
+        bundle = agg.forensics()
+        names = set(bundle["members"])
+        assert names == {f"learner-{os.getpid()}", f"actor-{dead['pid']}"}
+        live = bundle["members"][f"learner-{os.getpid()}"]
+        assert live["state"] == "live"
+        # The correlated debug pulls: thread stacks name this thread's
+        # frames, the flight tail parses as JSON.
+        assert "MainThread" in live["stacks"]
+        assert isinstance(live["flight"], dict)
+        assert bundle["members"][f"actor-{dead['pid']}"] \
+            == {"state": "dead"}
+    finally:
+        srv.close()
+        ra.close()
+
+
+def test_fleet_pane_federates_lineage_families(tmp_path):
+    """The tentpole end-to-end at unit scale: a member whose registry
+    carries populated lineage histograms shows them on the one pane
+    under process/role/loop labels."""
+    reg = Registry()
+    age_h, stale_h = tmc.lineage_histograms("host_replay", reg)
+    age_h.observe_many([0.2, 1.5])
+    stale_h.observe_many([3, 40])
+    srv = TelemetryServer(registry=reg)
+    handle = fleet.register_endpoint("learner", srv.port,
+                                     fleet_dir=str(tmp_path))
+    agg = fleet.FleetAggregator(str(tmp_path), scrape_timeout_s=1.0)
+    try:
+        agg.sweep_once()
+        merged = agg.render_metrics()
+        assert ('dqn_replay_sample_age_seconds_bucket{'
+                'le="0.5",loop="host_replay",'
+                f'process="learner-{os.getpid()}",role="learner"}} 1'
+                ) in merged
+        assert "dqn_replay_sample_staleness_versions_count" in merged
+    finally:
+        srv.close()
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# Experience lineage: wire survival + family parity
+# ---------------------------------------------------------------------------
+
+_LANES, _H, _W, _FS = 3, 8, 6, 4
+_BIRTH = 1722470400.129883  # an exact f64 so bit-survival is checkable
+_VER = 0xDEADBEEF
+
+
+def _arrays(rng, lanes=_LANES):
+    nxt = rng.integers(0, 256, (lanes, _H, _W, _FS)).astype(np.uint8)
+    return {"obs": nxt.copy(), "reward":
+            rng.normal(size=lanes).astype(np.float32),
+            "terminated": np.zeros(lanes, np.uint8),
+            "truncated": np.zeros(lanes, np.uint8), "next_obs": nxt}
+
+
+def test_lineage_survives_plain_roundtrip():
+    schema = ingest.step_schema((_H, _W, _FS), np.uint8, _LANES)
+    enc = ingest.StepEncoder(schema)
+    dec = ingest.StepDecoder(schema)
+    rng = np.random.default_rng(0)
+    payload = bytes(enc.encode_step(_arrays(rng), actor=0, t=1,
+                                    birth_time=_BIRTH,
+                                    params_version=_VER))
+    _, meta = dec.decode(payload)
+    assert meta["birth_time"] == _BIRTH  # f64 bit-exact, not approx
+    assert meta["params_version"] == _VER
+    # Unstamped records decode without lineage keys (optional flag).
+    _, meta2 = dec.decode(bytes(enc.encode_step(_arrays(rng), actor=0,
+                                                t=2)))
+    assert "birth_time" not in meta2
+
+
+def _stacked_step(rng, prev_nxt):
+    """One HostVectorEnv-contract step: next_obs shifts one novel frame
+    in; obs == next_obs (no resets) — the canonical-record path."""
+    frame = rng.integers(0, 256, (_LANES, _H, _W, 1)).astype(np.uint8)
+    nxt = np.concatenate([prev_nxt[:, :, :, 1:], frame], axis=3)
+    return {"obs": nxt.copy(),
+            "reward": rng.normal(size=_LANES).astype(np.float32),
+            "terminated": np.zeros(_LANES, np.uint8),
+            "truncated": np.zeros(_LANES, np.uint8),
+            "next_obs": nxt}, nxt
+
+
+def test_lineage_survives_dedup_roundtrip_canon_and_general():
+    """The stamps ride the dedup wire too — on the general seed record
+    AND the canonical shorthand records, bit for bit."""
+    schema = ingest.step_schema((_H, _W, _FS), np.uint8, _LANES)
+    enc = ingest.DedupStepEncoder(schema, _FS)
+    dec = ingest.DedupStepDecoder(schema, _FS, t0=0)
+    rng = np.random.default_rng(1)
+    nxt = rng.integers(0, 256, (_LANES, _H, _W, _FS)).astype(np.uint8)
+    kinds = set()
+    for t in range(6):
+        arrays, nxt = _stacked_step(rng, nxt)
+        payload = bytes(enc.encode_step(arrays, actor=0, t=t + 1,
+                                        birth_time=_BIRTH + t,
+                                        params_version=_VER - t))
+        hdr = ingest.peek_header(payload)
+        kinds.add(bool(hdr["flags"] & ingest.FLAG_DEDUP_CANON))
+        out, meta = dec.decode(payload)
+        assert meta["birth_time"] == _BIRTH + t
+        assert meta["params_version"] == _VER - t
+        assert np.array_equal(out["obs"], arrays["obs"])
+    assert kinds == {False, True}, "both record kinds exercised"
+
+
+def test_lineage_survives_batched_shm_roundtrip():
+    """Stamped records coalesced into one batched slot publish come out
+    the other side with their stamps intact — the PR 14 near-data plane
+    and the v4 lineage lanes compose."""
+    schema = ingest.step_schema((_H, _W, _FS), np.uint8, _LANES)
+    enc = ingest.StepEncoder(schema)
+    dec = ingest.StepDecoder(schema)
+    rng = np.random.default_rng(2)
+    payloads = [bytes(enc.encode_step(_arrays(rng), actor=0, t=t + 1,
+                                      birth_time=_BIRTH + t,
+                                      params_version=_VER - t))
+                for t in range(4)]
+    from dist_dqn_tpu.ingest.shm_ring import batch_bytes
+    ring = ingest.ShmSlotRing("t_fleet_lineage",
+                              slot_size=batch_bytes(
+                                  [len(p) for p in payloads]),
+                              nslots=2, create=True)
+    try:
+        assert ring.push_batch(payloads)
+        for t in range(4):
+            got = ring.pop()
+            assert got is not None
+            _, meta = dec.decode(got)
+            assert meta["birth_time"] == _BIRTH + t
+            assert meta["params_version"] == _VER - t
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_reply_lineage_roundtrip():
+    action = np.arange(_LANES, dtype=np.int32)
+    payload = ingest.encode_reply(action, actor=1, t=5,
+                                  params_version=_VER)
+    out, _, _, hdr = ingest.decode_reply(payload)
+    assert np.array_equal(out, action)
+    assert hdr["params_version"] == _VER
+    _, _, _, hdr2 = ingest.decode_reply(ingest.encode_reply(action, actor=1,
+                                                            t=6))
+    assert "params_version" not in hdr2
+
+
+def test_lineage_family_parity_fused_vs_host_replay_vs_apex():
+    """All three runtimes build their lineage histograms through ONE
+    constructor: same family names, same buckets, loop label apart —
+    the fused-vs-host-replay parity pin from the issue."""
+    reg = Registry()
+    rows = {loop: tmc.lineage_histograms(loop, reg)
+            for loop in ("fused", "host_replay", "apex")}
+    names = {(a.name, s.name) for a, s in rows.values()}
+    assert names == {(tmc.REPLAY_SAMPLE_AGE, tmc.REPLAY_SAMPLE_STALENESS)}
+    bounds = {(a.bounds, s.bounds) for a, s in rows.values()}
+    assert len(bounds) == 1, "bucket layouts must not drift apart"
+    assert {a.labels["loop"] for a, _ in rows.values()} \
+        == {"fused", "host_replay", "apex"}
+    # FusedLineageTable (the device-loop adapter) feeds those exact
+    # families, not private ones.
+    table = tmc.FusedLineageTable(Registry())
+    table.on_chunk(10.0, window_chunks=2, now=100.0)
+    table.on_chunk(12.0, window_chunks=2, now=101.0)
+    assert table._age.name == tmc.REPLAY_SAMPLE_AGE
+    assert table._age.count == 3  # 1 + 2 live-window observations
+    assert table._staleness.count == 3
+
+
+# ---------------------------------------------------------------------------
+# Live fleet demo: the real CLI against real telemetry servers
+# ---------------------------------------------------------------------------
+
+def test_fleet_cli_live_demo(tmp_path):
+    """Two real telemetry servers + the ``python -m`` aggregator CLI:
+    one merged scrape with per-process labels, a JSON rollup counting
+    both live, and a clean SIGTERM exit."""
+    reg_l, reg_a = Registry(), Registry()
+    reg_l.counter("dqn_demo_learner_total", "x").inc(1)
+    reg_a.counter("dqn_demo_actor_total", "x").inc(2)
+    srv_l = TelemetryServer(registry=reg_l)
+    srv_a = TelemetryServer(registry=reg_a)
+    rl = fleet.register_endpoint("learner", srv_l.port,
+                                 fleet_dir=str(tmp_path))
+    ra = fleet.register_endpoint("actor", srv_a.port,
+                                 fleet_dir=str(tmp_path))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dist_dqn_tpu.telemetry.fleet",
+         "--fleet-dir", str(tmp_path), "--port", "0",
+         "--sweep-interval", "0.2", "--scrape-timeout", "1.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd="/root/repo")
+    try:
+        line = proc.stdout.readline()
+        port = json.loads(line)["fleet_port"]
+        deadline = time.time() + 20.0
+        status = {}
+        while time.time() < deadline:
+            status = json.loads(
+                _get(f"http://127.0.0.1:{port}/fleet/status"))
+            if status.get("counts", {}).get("live") == 2:
+                break
+            time.sleep(0.1)
+        assert status["counts"]["live"] == 2, status
+        assert not status["ingest_degraded"]
+        merged = _get(f"http://127.0.0.1:{port}/metrics").decode()
+        assert (f'dqn_demo_learner_total{{process="learner-{os.getpid()}"'
+                f',role="learner"}} 1') in merged
+        assert (f'dqn_demo_actor_total{{process="actor-{os.getpid()}"'
+                f',role="actor"}} 2') in merged
+        bundle = json.loads(
+            _get(f"http://127.0.0.1:{port}/fleet/forensics"))
+        assert set(bundle["members"]) == {f"learner-{os.getpid()}",
+                                          f"actor-{os.getpid()}"}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+        srv_l.close()
+        srv_a.close()
+        rl.close()
+        ra.close()
+    assert proc.returncode in (0, 128 + signal.SIGTERM)
+
+
+@pytest.mark.slow
+def test_fleet_live_demo_apex_remote_actors_and_serving(tmp_path):
+    """THE acceptance demo: a real apex learner, two EXTERNAL
+    remote-actor CLI processes and one serving replica, all registered
+    in one fleet dir — one merged scrape with per-process labels, a
+    rollup counting four live members, and a SIGKILL'd actor flipping
+    the rollup degraded while /fleet/forensics names every survivor."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from dist_dqn_tpu.actors.service import (ApexLearnerService,
+                                             ApexRuntimeConfig)
+    from dist_dqn_tpu.agents.dqn import make_learner
+    from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.envs import make_jax_env
+    from dist_dqn_tpu.models import build_network
+    from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
+
+    fleet_dir = str(tmp_path / "fleet")
+    os.makedirs(fleet_dir)
+    stop_file = str(tmp_path / "stop")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    # A checkpoint for the serving replica to restore.
+    scfg = CONFIGS["cartpole"]
+    senv = make_jax_env(scfg.env_name)
+    net = build_network(scfg.network, senv.num_actions)
+    init, _ = make_learner(net, scfg.learner)
+    state = init(jax.random.PRNGKey(0),
+                 jnp.zeros(senv.observation_shape,
+                           senv.observation_dtype))
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = TrainCheckpointer(ckpt_dir, save_every_frames=1)
+    ckpt.save(100, state)
+    ckpt.wait()
+    ckpt.close()
+
+    serving = subprocess.Popen(
+        [sys.executable, "-m", "dist_dqn_tpu.serving",
+         "--config", "cartpole", "--checkpoint-dir", ckpt_dir,
+         "--port", "0", "--telemetry-port", "0",
+         "--fleet-dir", fleet_dir],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        cwd="/root/repo")
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096,
+                                   min_fill=150),
+        learner=dataclasses.replace(cfg.learner, batch_size=16,
+                                    n_step=2))
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=1,
+                           envs_per_actor=4, total_env_steps=3000,
+                           inserts_per_grad_step=32,
+                           num_remote_actors=2,
+                           spawn_remote_actors=False,
+                           telemetry_port=0, log_every_s=5.0)
+    os.environ[fleet.FLEET_ENV] = fleet_dir
+    try:
+        service = ApexLearnerService(cfg, rt, log_fn=lambda s: None)
+    finally:
+        os.environ.pop(fleet.FLEET_ENV, None)
+    _, tcp_port = service.tcp_address
+
+    def _worker(actor_id):
+        return subprocess.Popen(
+            [sys.executable, "-m", "dist_dqn_tpu.actors.remote",
+             "--address", f"127.0.0.1:{tcp_port}",
+             "--actor-id", str(actor_id), "--env", "CartPole-v1",
+             "--num-envs", "4", "--telemetry-port", "0",
+             "--fleet-dir", fleet_dir, "--stop-file", stop_file],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env, cwd="/root/repo")
+
+    workers = [_worker(1), _worker(2)]
+    agg = fleet.FleetAggregator(fleet_dir, scrape_timeout_s=2.0)
+    out = {}
+    runner = threading.Thread(
+        target=lambda: out.update(service.run()), daemon=True)
+    try:
+        # Converge the fleet BEFORE starting the learner's run: the
+        # learner registers (and serves /metrics) at construction, the
+        # workers park on the service's TCP socket until run() drains
+        # their hellos, and the serving replica needs its bucket-ladder
+        # warmup — but this short demo run would otherwise finish and
+        # deregister the learner before the slowest member went live.
+        deadline = time.time() + 180.0
+        st = {}
+        while time.time() < deadline:
+            agg.sweep_once()
+            st = agg.status()
+            if st["counts"]["live"] >= 4:
+                break
+            time.sleep(0.3)
+        assert st["counts"]["live"] >= 4, st
+        runner.start()
+        roles = {m["role"] for m in st["members"].values()}
+        assert roles == {"learner", "actor", "serving"}
+
+        merged = agg.render_metrics()
+        for role in ("learner", "actor", "serving"):
+            assert f'role="{role}"' in merged
+        # Per-process labels split the two actors apart on one pane.
+        actor_procs = {m for m in st["members"] if m.startswith("actor-")}
+        assert len(actor_procs) == 2
+        for name in actor_procs:
+            assert f'process="{name}"' in merged
+
+        victim = workers[0]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30.0)
+        agg.sweep_once()
+        st = agg.status()
+        assert st["members"][f"actor-{victim.pid}"]["state"] == "dead"
+        assert st["ingest_degraded"] is True
+
+        bundle = agg.forensics()
+        survivors = {n for n, e in bundle["members"].items()
+                     if e.get("state") == "live"}
+        assert f"actor-{workers[1].pid}" in survivors
+        assert any(n.startswith("learner-") for n in survivors)
+        assert any(n.startswith("serving-") for n in survivors)
+        assert bundle["members"][f"actor-{victim.pid}"] \
+            == {"state": "dead"}
+
+        runner.join(timeout=300.0)
+        assert not runner.is_alive(), "apex run did not finish"
+        assert out.get("env_steps", 0) >= rt.total_env_steps
+    finally:
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        serving.send_signal(signal.SIGTERM)
+        for w in workers:
+            try:
+                w.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        try:
+            serving.wait(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            serving.kill()
