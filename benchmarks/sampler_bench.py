@@ -12,6 +12,11 @@ times, on whatever backend is active,
     ops/pallas_sampler.stratified_sample,
   * ``host_cpp``— replay/_native/sumtree.cc on the learner-step workload
     (sample S + 2x set S — priority write-back and new-item insert),
+  * ``sharded`` — ISSUE 18: per-shard DevicePrioritySampler planes
+    (cells/shards each, one train event = batched write-back + fused
+    draw per shard) vs ONE host tree serving the mesh's aggregate
+    demand; reports per-shard, wall- and mesh-aggregate draws/sec
+    (reading rule: docs/performance.md "sampling scales with the mesh"),
 
 and prints one JSON line per (impl, size): median/min seconds per draw.
 
@@ -132,6 +137,97 @@ def bench_device(jax, cells: int, batch: int, iters: int,
     }
 
 
+def _shard_event(s, cells: int, batch: int, u, wi, wv):
+    """One train event against a shard's plane: priority write-back
+    (ONE batched scatter) + the stratified draw (ONE fused dispatch) +
+    host materialization — the per-event device-sampling hot path."""
+    s.set(wi, wv)
+    return s.materialize_at(s.dispatch_at(u), cells)
+
+
+def bench_sharded(jax, cells: int, shards: int, batch: int, iters: int,
+                  one_shard_rate: float, host_rate: float) -> dict:
+    """ISSUE 18 arm: ``shards`` per-shard device priority planes, each
+    holding ``cells // shards`` cells and serving its own learner
+    replica's ``batch`` draws + write-backs per event — against ONE
+    host tree serving the same aggregate demand (``host_rate``).
+
+    Reports BOTH aggregates (reading rule in docs/performance.md):
+
+    * ``wall_agg_draws_per_s`` — shards*batch over the measured wall of
+      one concurrent round. Honest for THIS host: on a 1-core CPU
+      container the per-shard programs serialize, so this under-reports
+      a real mesh (``cpus`` is in the row for exactly that judgement).
+    * ``mesh_agg_draws_per_s`` — sum of per-shard rates, each shard
+      timed solo: the aggregate a mesh with one chip per shard
+      delivers, since each plane's work runs entirely on its own
+      sticky device and the host only enqueues. This is the
+      scales-with-the-mesh number the TPU procedure measures as true
+      wall clock.
+    """
+    from dist_dqn_tpu.replay.host import DevicePrioritySampler
+
+    devs = jax.devices()
+    shard_cells = cells // shards
+    r = np.random.default_rng(0)
+    samplers = []
+    for i in range(shards):
+        s = DevicePrioritySampler(shard_cells, seed=i,
+                                  device=devs[i % len(devs)], shard=i)
+        prios = np.abs(r.standard_cauchy(shard_cells)
+                       ).astype(np.float64) ** 0.6
+        s.set(np.arange(shard_cells), prios)
+        s._flush_writes()
+        samplers.append(s)
+    u = (np.arange(batch) + r.random(batch)) / batch
+    rounds = 2 * (iters + 5)
+    wi = r.integers(0, shard_cells, (rounds, shards, batch))
+    wv = np.abs(r.standard_cauchy((rounds, shards, batch))) ** 0.6
+    k = [0]  # round cursor shared by warmup and timed calls
+
+    # Per-shard solo medians -> the mesh aggregate.
+    per_shard = []
+    for j, s in enumerate(samplers):
+        def one(j=j, s=s):
+            _shard_event(s, shard_cells, batch, u,
+                         wi[k[0] % rounds, j], wv[k[0] % rounds, j])
+            k[0] += 1
+
+        for _ in range(5):
+            one()
+        per_shard.append(_timed(one, iters)["median_s"])
+
+    # Concurrent round -> the single-host wall aggregate: every shard's
+    # write-back + draw dispatched before the first materialization.
+    def one_round():
+        i = k[0] % rounds
+        k[0] += 1
+        handles = []
+        for j, s in enumerate(samplers):
+            s.set(wi[i, j], wv[i, j])
+            handles.append(s.dispatch_at(u))
+        for s, h in zip(samplers, handles):
+            s.materialize_at(h, shard_cells)
+
+    for _ in range(5):
+        one_round()
+    wall = _timed(one_round, iters)
+    mesh_agg = sum(batch / t for t in per_shard)
+    wall_agg = shards * batch / wall["median_s"]
+    return {
+        "shards": shards, "shard_cells": shard_cells,
+        "per_shard_event_s": [round(t, 6) for t in per_shard],
+        "wall_event_s": wall["median_s"],
+        "mesh_agg_draws_per_s": round(mesh_agg),
+        "wall_agg_draws_per_s": round(wall_agg),
+        "one_shard_draws_per_s": round(one_shard_rate),
+        "host_cpp_draws_per_s": round(host_rate),
+        "mesh_speedup_vs_host_cpp": round(mesh_agg / host_rate, 3),
+        "cpus": os.cpu_count(),
+        "devices": len(devs),
+    }
+
+
 def bench_host_cpp(cells: int, batch: int, iters: int) -> dict:
     from dist_dqn_tpu.replay.host import make_sum_tree
 
@@ -167,7 +263,14 @@ def main():
                         "marginal_s — per-draw kernel time with the ~70ms "
                         "axon-tunnel dispatch constant subtracted exactly")
     p.add_argument("--impls", nargs="*",
-                   default=["pallas", "xla", "host_cpp"])
+                   default=["pallas", "xla", "host_cpp", "sharded"])
+    p.add_argument("--shards", type=int, nargs="*", default=[2, 4],
+                   help="sharded-arm mesh widths (ISSUE 18): per-shard "
+                        "device planes of cells/shards each")
+    p.add_argument("--shard-batch", type=int, default=1024,
+                   help="sharded-arm per-shard (per learner replica) "
+                        "draw batch; the host tree serves "
+                        "shards*shard_batch per event")
     args = p.parse_args()
 
     import jax
@@ -186,6 +289,33 @@ def main():
         for impl in args.impls:
             if impl == "pallas" and platform == "cpu":
                 continue  # interpret mode would time the interpreter
+            if impl == "sharded":
+                # One row per (cells, shards) point, each carrying its
+                # own 1-shard and host_cpp references: the host tree
+                # serves the mesh's AGGREGATE demand (shards * batch
+                # draws + write-backs per event) from one thread — the
+                # serialized resource the per-shard planes remove.
+                guard = _watchdog(f"sharded@{cells}", 600.0)
+                one = bench_sharded(jax, cells, 1, args.shard_batch,
+                                    args.iters, 1.0, 1.0)
+                one_rate = one["mesh_agg_draws_per_s"]
+                for shards in args.shards:
+                    if shards < 2 or cells % shards:
+                        continue
+                    host = bench_host_cpp(cells,
+                                          shards * args.shard_batch,
+                                          args.iters)
+                    host_rate = (shards * args.shard_batch
+                                 / host["median_s"])
+                    out = bench_sharded(jax, cells, shards,
+                                        args.shard_batch, args.iters,
+                                        one_rate, host_rate)
+                    out.update(impl=impl, cells=cells, lanes=LANES,
+                               batch=args.shard_batch, sampler="device",
+                               platform=platform)
+                    print(json.dumps(out), flush=True)
+                guard.cancel()
+                continue
             guard = _watchdog(f"{impl}@{cells}", 600.0)
             if impl == "host_cpp":
                 out = bench_host_cpp(cells, args.batch, args.iters)

@@ -79,6 +79,10 @@ def _host_replay_leg(cfg, total, chunk_iters, dp):
         # Collect-scaling arm inputs (ISSUE 15): acting-side provenance
         # + the per-shard conservation evidence.
         "sharded_collect": out["sharded_collect"],
+        # ISSUE 18: which PER backend served the run's draws — "device"
+        # (per-shard priority planes) or "tree" (host sum-trees);
+        # "uniform" when PER is off.
+        "sampler": out["sampler"],
         "collect_lane_block": out["collect_lane_block"],
         "collect_dispatch_s_total": out["collect_dispatch_s_total"],
         "d2h_bytes_total": out["d2h_bytes_total"],
@@ -105,6 +109,7 @@ def _collect_arm(dp1_leg, dpn_leg, dp):
     wall = max(dpn_leg["wall_s"], 1e-9)
     return {
         "sharded": dpn_leg["sharded_collect"],
+        "sampler": dpn_leg["sampler"],
         "lane_block": dpn_leg["collect_lane_block"],
         # Acting-side rates: aggregate env-steps/sec over the mesh and
         # each shard's share (equal lane blocks => equal shares; the

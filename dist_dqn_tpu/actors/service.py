@@ -266,13 +266,21 @@ class ApexLearnerService:
                    "after one release of zerocopy A/B parity "
                    "(docs/ingest_pipeline.md §7; apex_feeder_bench "
                    "--ab rows are the parity evidence)")
+        if rt.device_sampling and rt.transport == "legacy":
+            raise ValueError(
+                "--transport legacy with --device-sampling is not "
+                "supported: the legacy concatenated bootstrap path is "
+                "the bit-pinned A/B fallback and stays on the host "
+                "tree sampler — use --transport zerocopy for the "
+                "device priority planes")
+        if rt.device_sampling and rt.shard_sampling:
+            raise ValueError(
+                "--shard-sampling with --device-sampling is redundant: "
+                "the per-shard worker threads exist to move HOST tree "
+                "draws off the learner thread, and the device planes "
+                "already run each shard's draw on its own chip — pick "
+                "one")
         if rt.ingest_shards > 1:
-            if rt.device_sampling:
-                raise ValueError(
-                    "ingest_shards > 1 with --device-sampling is not "
-                    "supported: the on-device priority plane is one "
-                    "contiguous buffer with no per-shard story yet — "
-                    "use the host tree sampler, or ingest_shards=1")
             if cfg.network.lstm_size <= 0 and not (
                     rt.transport == "zerocopy" and rt.actor_priorities):
                 raise ValueError(
@@ -576,12 +584,16 @@ class ApexLearnerService:
             # routed by the sticky shard id every frame header carries,
             # draws stratified across shards by tree mass, slot ids
             # globally encoded so the pipelined write-back path works
-            # unchanged (replay/sharded.py).
+            # unchanged (replay/sharded.py). --device-sampling (ISSUE
+            # 18) swaps every shard's tree for an on-device priority
+            # plane pinned to its sticky chip; the global ladder and
+            # the write-back/generation semantics are identical.
             from dist_dqn_tpu.replay.sharded import ShardedPrioritizedReplay
             self.replay = ShardedPrioritizedReplay(
                 rt.ingest_shards, cfg.replay.capacity,
                 alpha=cfg.replay.priority_exponent,
-                priority_eps=cfg.replay.priority_eps)
+                priority_eps=cfg.replay.priority_eps,
+                sampler="device" if rt.device_sampling else "tree")
         else:
             self.replay = PrioritizedHostReplay(
                 cfg.replay.capacity, alpha=cfg.replay.priority_exponent,
@@ -661,6 +673,9 @@ class ApexLearnerService:
         # program increments its kind here; the feeder bench divides by
         # ingest passes to report round-trips per pass.
         self.device_calls: Dict[str, int] = {}
+        # Device-sampling dispatch watermark: how many per-shard plane
+        # draws device_calls has already mirrored (ISSUE 18).
+        self._replay_draws_counted = 0
         self.ingest_passes = 0
         # H2D staging for the learner (replay/staging.py): single-device
         # only — multi-host/multi-learner batches are sharded by their
@@ -1752,6 +1767,18 @@ class ApexLearnerService:
         else:
             items, idx, weights = self.replay.sample(batch_size, beta)
             out = items, idx, weights, self.replay.generation(idx)
+            if self.rt.device_sampling:
+                # Dispatch-budget accounting (ISSUE 18 via PR 2's
+                # device_calls): one sample dispatch per shard per
+                # train event — counted from the samplers' own dispatch
+                # counters so the pin covers exactly what ran.
+                seen = (self.replay.device_sample_dispatches
+                        if hasattr(self.replay,
+                                   "device_sample_dispatches")
+                        else self.replay.device_sampler.draw_dispatches)
+                for _ in range(seen - self._replay_draws_counted):
+                    self._count_device_call("replay_sample")
+                self._replay_draws_counted = seen
         tmc.observe_sample_lineage(out[0], self.grad_steps,
                                    self._tm_sample_age,
                                    self._tm_sample_staleness)
@@ -2406,6 +2433,10 @@ class ApexLearnerService:
                 "dedup_bytes_saved": int(dedup_saved),
                 "shm_batch": self.rt.shm_batch,
                 "shard_sampling": self._shard_sampler is not None,
+                # Sampling-axis provenance (ISSUE 18): which backend
+                # drew this run's batches.
+                "sampler": ("device" if self.rt.device_sampling
+                            else "tree"),
                 "shard_sample_batches": (self._shard_sampler.batches
                                          if self._shard_sampler else 0),
                 "records_by_shard": dict(self.router.records_by_shard),

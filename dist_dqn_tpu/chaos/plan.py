@@ -77,6 +77,12 @@ SEAMS: Dict[str, Tuple[str, ...]] = {
     # applied to the payload BEFORE validation — a corrupt record must
     # reject whole, mirroring the transport.recv bit_flip invariant).
     "ingest.decode": ("bit_flip", "truncate"),
+    # replay/host.py DevicePrioritySampler draw path (ISSUE 18): fires
+    # once per SHARD draw dispatch, so an at_hit schedule can fail or
+    # stall any one shard's device plane. Recovery is anchored at the
+    # next draw that materializes on that path (mark_recovered in
+    # materialize_at/sample).
+    "replay.device_sample": ("exception", "stall"),
 }
 
 

@@ -229,7 +229,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     checkpoint_dir: Optional[str] = None,
                     save_every_frames: int = 0,
                     mesh_devices: int = 1,
-                    sharded_collect: Optional[bool] = None):
+                    sharded_collect: Optional[bool] = None,
+                    device_sampling: bool = False):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
@@ -347,6 +348,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                          f"{prio_writeback_batch}")
     per_enabled = (cfg.replay.prioritized if prioritized is None
                    else prioritized)
+    if device_sampling and not per_enabled:
+        raise ValueError(
+            "--device-sampling without --per has nothing to sample on "
+            "device: the priority planes hold p^alpha mass (uniform "
+            "draws never touch a tree). Add --per or drop "
+            "--device-sampling")
     dp = len(jax.devices()) if mesh_devices == 0 else int(mesh_devices)
     if dp < 1:
         raise ValueError(f"mesh_devices must be >= 0, got {mesh_devices}")
@@ -521,26 +528,44 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     # sum-tree per shard ring (per-shard fences, per-shard flushes).
     per_sampler = per_samplers = None
     if per_enabled and not mesh_mode:
-        from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
-        per_sampler = RingPrioritySampler(
-            ring, n_step=cfg.learner.n_step,
-            alpha=cfg.replay.priority_exponent,
-            beta=cfg.replay.importance_exponent,
-            eps=cfg.replay.priority_eps)
-        log_fn("# host-replay sampler: prioritized sum-tree "
-               f"({type(per_sampler.tree).__name__}, "
-               f"alpha={cfg.replay.priority_exponent}, "
-               f"beta={cfg.replay.importance_exponent}, "
-               f"prio_writeback_batch={prio_writeback_batch})")
+        if device_sampling:
+            from dist_dqn_tpu.replay.host_ring import \
+                RingDevicePrioritySampler
+            per_sampler = RingDevicePrioritySampler(
+                ring, n_step=cfg.learner.n_step,
+                alpha=cfg.replay.priority_exponent,
+                beta=cfg.replay.importance_exponent,
+                eps=cfg.replay.priority_eps,
+                device=jax.devices()[0], seed=cfg.seed)
+            log_fn("# host-replay sampler: prioritized device plane "
+                   f"({jax.devices()[0].platform}, "
+                   f"alpha={cfg.replay.priority_exponent}, "
+                   f"beta={cfg.replay.importance_exponent}, "
+                   f"prio_writeback_batch={prio_writeback_batch})")
+        else:
+            from dist_dqn_tpu.replay.host_ring import RingPrioritySampler
+            per_sampler = RingPrioritySampler(
+                ring, n_step=cfg.learner.n_step,
+                alpha=cfg.replay.priority_exponent,
+                beta=cfg.replay.importance_exponent,
+                eps=cfg.replay.priority_eps)
+            log_fn("# host-replay sampler: prioritized sum-tree "
+                   f"({type(per_sampler.tree).__name__}, "
+                   f"alpha={cfg.replay.priority_exponent}, "
+                   f"beta={cfg.replay.importance_exponent}, "
+                   f"prio_writeback_batch={prio_writeback_batch})")
     elif per_enabled:
         per_samplers = store.attach_priority_samplers(
             n_step=cfg.learner.n_step,
             alpha=cfg.replay.priority_exponent,
             beta=cfg.replay.importance_exponent,
-            eps=cfg.replay.priority_eps)
-        log_fn(f"# host-replay sampler: prioritized sum-tree x {dp} "
-               f"shards ({type(per_samplers[0].tree).__name__}, "
-               f"alpha={cfg.replay.priority_exponent}, "
+            eps=cfg.replay.priority_eps,
+            device_sampling=device_sampling,
+            devices=mesh_devs, seed=cfg.seed)
+        kind = ("device plane" if device_sampling
+                else f"sum-tree ({type(per_samplers[0].tree).__name__})")
+        log_fn(f"# host-replay sampler: prioritized {kind} x {dp} "
+               f"shards (alpha={cfg.replay.priority_exponent}, "
                f"beta={cfg.replay.importance_exponent}, "
                f"prio_writeback_batch={prio_writeback_batch})")
     else:
@@ -1068,6 +1093,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     "snapshot cannot honestly seed a sum-tree (and vice "
                     "versa); resume with the same sampler, or start a "
                     "fresh --checkpoint-dir")
+            if per_enabled and \
+                    int(side["per_sampler_kind"]) != int(device_sampling):
+                # The mass shadow would restore either way, but draw
+                # timing and fp reduction order differ between the host
+                # tree and the device plane — a silent backend swap
+                # breaks the bit-identical-resume contract (ISSUE 18).
+                _kinds = {0: "host sum-tree", 1: "device plane"}
+                _refuse_resume(
+                    "sampler_kind",
+                    f"checkpoint at {checkpoint_dir!r} was written with "
+                    f"the {_kinds[int(side['per_sampler_kind'])]} PER "
+                    f"backend, this run configures the "
+                    f"{_kinds[int(device_sampling)]} — resume with the "
+                    "same --device-sampling setting, or start a fresh "
+                    "--checkpoint-dir")
             _, tree = ckpt.restore_latest(example_tree, step=step)
             state = tree["learner"]
             if not mesh_mode:
@@ -1256,6 +1296,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             chunk_iters=np.int64(chunk_iters),
             dp=np.int64(dp),
             per=np.bool_(per_enabled),
+            per_sampler_kind=np.int64(int(device_sampling)),
             sharded_collect=np.bool_(mesh_mode),
             prio_writeback_batch=np.int64(prio_writeback_batch),
             wb_count=np.int64(len(wb_pending)),
@@ -1899,6 +1940,11 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         "prefetch": _prefetch_on,
         "prefetch_depth": prefetch_depth if _prefetch_on else 0,
         "prioritized": bool(_samplers),
+        # PER backend provenance (ISSUE 18): which priority-mass
+        # backend drew this run's batches — scaling_bench's collect arm
+        # records it beside the dp width.
+        "sampler": ("device" if (_samplers and device_sampling)
+                    else "tree" if _samplers else "uniform"),
         "sample_s_total": round(sample_s_total, 4),
         "prefetch_wait_s_total": round(prefetch_wait_s_total, 4),
         "stale_batches": (

@@ -166,16 +166,85 @@ def stratified_sample(w: Array, rng: Array, batch_size: int,
     """
     u01 = (jnp.arange(batch_size, dtype=jnp.float32)
            + jax.random.uniform(rng, (batch_size,))) / batch_size
+    return stratified_sample_at(w, u01, use_pallas=use_pallas,
+                                interpret=interpret)
+
+
+def stratified_sample_at(w: Array, u: Array, use_pallas: bool = False,
+                         interpret: bool = False
+                         ) -> Tuple[Array, Array, Array, Array]:
+    """Inverse-CDF draw from a [T, B] mass plane at EXPLICIT uniforms
+    ``u`` [S] in [0, 1) — the per-shard leg of a cross-shard stratified
+    draw (replay/sharded.py): the coordinator lays ONE global ladder
+    over the concatenated per-shard totals and hands each shard its
+    local positions as fractions of its own mass, so draws land here in
+    proportion to this plane's mass with exactly the single-plane P(i).
+    Same (t_idx, b_idx, mass_sel, total) contract and Pallas/XLA
+    routing as :func:`stratified_sample`.
+    """
     if use_pallas:
-        return pallas_stratified_sample(w, u01, interpret=interpret)
+        return pallas_stratified_sample(w, u, interpret=interpret)
     num_envs = w.shape[1]
     flat = w.reshape(-1)
     cdf = jnp.cumsum(flat)
     total = cdf[-1]
-    idx = jnp.clip(jnp.searchsorted(cdf, u01 * total), 0, flat.shape[0] - 1)
+    idx = jnp.clip(jnp.searchsorted(cdf, u * total), 0, flat.shape[0] - 1)
     t_idx = (idx // num_envs).astype(jnp.int32)
     b_idx = (idx % num_envs).astype(jnp.int32)
     return t_idx, b_idx, flat[idx], total
+
+
+SAMPLE_BLOCK = 32  # lanes per second-level block of the hierarchical draw
+
+
+def stratified_sample_rows(w: Array, blk_sums: Array, u: Array
+                           ) -> Tuple[Array, Array, Array, Array]:
+    """Three-level XLA inverse-CDF draw at explicit uniforms ``u`` [S]:
+    row pick by searchsorted over the [T] row-sum CDF (row sums reduced
+    from ``blk_sums`` — a [T, NB] pass, not a plane pass), then block
+    pick over the selected rows' [NB] block sums, then lane pick inside
+    one ``SAMPLE_BLOCK``-wide sub-block — O(T + S*(NB + BLOCK)) work
+    and O(S*(NB + BLOCK)) memory traffic against the flat path's O(T*B)
+    cumsum, which is what lets the device priority planes beat the host
+    sum-tree on aggregate draws/sec even on CPU
+    (benchmarks/sampler_bench.py ``sharded`` arm).
+
+    ``blk_sums`` [T, B // SAMPLE_BLOCK] must track the per-block
+    partial sums of ``w``; the device sampler maintains it
+    incrementally inside its write-back scatter (touched blocks only),
+    so no draw ever re-reduces the plane. Each level's residual is
+    clamped strictly inside the level's own mass (the kernel's
+    plateau-start argument) — the levels reduce in different fp orders,
+    so without the clamps a top-of-row target could walk one cell past
+    the last written one. Same (t_idx, b_idx, mass_sel, total) contract
+    as :func:`stratified_sample_at`.
+    """
+    T, B = w.shape
+    NB = blk_sums.shape[1]
+    BS = B // NB
+    row_sums = blk_sums.sum(axis=1)
+    cdf = jnp.cumsum(row_sums)
+    total = cdf[-1]
+    pos = u.astype(jnp.float32) * total
+    t_idx = jnp.clip(jnp.searchsorted(cdf, pos), 0, T - 1)
+    blk = blk_sums[t_idx]                                 # [S, NB]
+    blk_cdf = jnp.cumsum(blk, axis=1)
+    res = jnp.minimum(pos - (cdf[t_idx] - row_sums[t_idx]),
+                      blk_cdf[:, -1] * (1.0 - 1e-6))[:, None]
+    jb = jnp.minimum(
+        jnp.sum((blk_cdf < res).astype(jnp.int32), axis=1, keepdims=True),
+        NB - 1)                                           # [S, 1]
+    res2 = res - (jnp.take_along_axis(blk_cdf, jb, axis=1)
+                  - jnp.take_along_axis(blk, jb, axis=1))
+    sub = w.reshape(T, NB, BS)[t_idx, jb[:, 0]]           # [S, BS]
+    sub_cdf = jnp.cumsum(sub, axis=1)
+    res2 = jnp.minimum(res2, sub_cdf[:, -1:] * (1.0 - 1e-6))
+    b2 = jnp.minimum(
+        jnp.sum((sub_cdf < res2).astype(jnp.int32), axis=1, keepdims=True),
+        BS - 1)                                           # [S, 1]
+    mass = jnp.take_along_axis(sub, b2, axis=1)[:, 0]
+    b_idx = jb[:, 0] * BS + b2[:, 0]
+    return t_idx.astype(jnp.int32), b_idx.astype(jnp.int32), mass, total
 
 
 def importance_weights(mass_sel: Array, total: Array, n_valid: Array,

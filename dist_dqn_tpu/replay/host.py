@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from pathlib import Path
 from typing import Dict, Optional, Tuple
 
@@ -257,81 +258,292 @@ class DevicePrioritySampler:
     kernel above its crossover on TPU, the XLA path elsewhere — and return
     flat slot indices plus selected masses/total for importance weights.
     The caller gathers the ITEMS from host DRAM; only priorities live on
-    device."""
+    device.
+
+    Sharded stores (ISSUE 18): ``device`` pins the plane to one chip of
+    the mesh — the initial plane is committed there, and because jax
+    computations follow committed data, every subsequent donated scatter
+    and draw dispatch runs on that chip with no per-call placement (the
+    small uncommitted operands move to it). A host-side float64 MIRROR
+    of the plane (updated on every buffered ``set``, duplicate indices
+    deduped last-write-wins exactly like the flush scatter) maintains
+    ``total`` incrementally, so a cross-shard coordinator can lay its
+    global stratified ladder over per-shard totals with ZERO device
+    fetches; :meth:`dispatch_at`/:meth:`materialize_at` split the
+    explicit-uniform draw so N shards' dispatches enqueue concurrently
+    on their own chips before any result is awaited."""
+
+    #: Incremental-total drift bound: every N flushes the mirror is
+    #: re-summed exactly (one O(capacity) float64 pass, ~0.5 ms at 1M).
+    _TOTAL_RESUM_EVERY = 256
 
     def __init__(self, capacity: int, lanes: int = 512, seed: int = 0,
                  use_pallas: Optional[bool] = None,
-                 interpret: bool = False):
+                 interpret: bool = False, device=None,
+                 shard: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
         from dist_dqn_tpu.loop_common import pallas_routing
-        from dist_dqn_tpu.ops.pallas_sampler import (importance_weights,
-                                                     stratified_sample)
+        from dist_dqn_tpu.ops.pallas_sampler import (SAMPLE_BLOCK,
+                                                     importance_weights,
+                                                     stratified_sample_at,
+                                                     stratified_sample_rows)
+        from dist_dqn_tpu.telemetry import get_registry
         self.jax = jax
         self.capacity = capacity
         self.lanes = lanes
         self.rows = -(-capacity // lanes)
+        self.device = device
+        self.shard = 0 if shard is None else int(shard)
         if use_pallas is None:
             # Platform-aware default, same crossover story as the fused
             # loop: Pallas on TPU above ~1e5 cells, XLA otherwise.
             use_pallas, interpret = pallas_routing(
                 self.rows * lanes >= 100_000)
         self._plane = jnp.zeros((self.rows, lanes), jnp.float32)
+        # Incremental block partial sums (ISSUE 18), maintained by the
+        # write scatter (touched blocks only), so the XLA draw is the
+        # three-level O(rows + S*(NB+BLOCK)) stratified_sample_rows —
+        # never an O(rows*lanes) flat cumsum per draw.
+        self._blk = SAMPLE_BLOCK if lanes % SAMPLE_BLOCK == 0 else lanes
+        nb = lanes // self._blk
+        self._blk_sums = jnp.zeros((self.rows, nb), jnp.float32)
+        if device is not None:
+            self._plane = jax.device_put(self._plane, device)
+            self._blk_sums = jax.device_put(self._blk_sums, device)
         self._pending_idx: list = []
         self._pending_val: list = []
         self._rng = jax.random.PRNGKey(seed)
+        # Host float64 mirror of the (f32-rounded) plane mass + running
+        # total: the coordinator's ladder source. Stored post-f32-round
+        # so mirror totals and plane totals agree to reduction order.
+        self._mirror = np.zeros(self.rows * lanes, np.float64)
+        self._total = 0.0
+        self._flushes = 0
+        # Dispatch/write-back accounting (ISSUE 18): the dispatch-budget
+        # pin counts draws per train event; the rows counter feeds the
+        # per-shard write-back telemetry family.
+        self.draw_dispatches = 0
+        self.writeback_rows = 0
+        labels = {"shard": str(self.shard)}
+        reg = get_registry()
+        self._h_sample = reg.histogram(
+            tm.REPLAY_DEVICE_SAMPLE_SECONDS,
+            "on-device priority draw wall per shard: write-back flush + "
+            "dispatch + host materialization", labels)
+        self._c_wb_rows = reg.counter(
+            tm.REPLAY_DEVICE_WRITEBACK_ROWS,
+            "priority rows scattered into the shard's device plane "
+            "(post last-write-wins dedup, pre pow2 padding)", labels)
 
-        def apply_writes(plane, idx, vals):
-            return plane.at[idx // lanes, idx % lanes].set(vals)
+        blk = self._blk
 
-        self._apply = jax.jit(apply_writes, donate_argnums=0)
+        def apply_writes(plane, blk_sums, idx, vals, ub):
+            plane = plane.at[idx // lanes, idx % lanes].set(vals)
+            # Re-sum ONLY the touched SAMPLE_BLOCK blocks (``ub``:
+            # unique flat block ids) — O(writes * BLOCK) traffic, never
+            # O(writes * lanes). Padded duplicates re-scatter the same
+            # recomputed value: idempotent.
+            newb = plane.reshape(-1, blk)[ub].sum(axis=1)
+            blk_sums = blk_sums.at[ub // nb, ub % nb].set(newb)
+            return plane, blk_sums
 
-        def draw(plane, rng, batch, beta, n_valid):
-            t, b, mass, total = stratified_sample(
-                plane, rng, batch, use_pallas=use_pallas,
-                interpret=interpret)
+        self._apply = jax.jit(apply_writes, donate_argnums=(0, 1))
+
+        def select_at(plane, blk_sums, u):
+            # Trace-time routing: the Pallas kernel keeps the whole
+            # plane in VMEM (TPU / the CPU interpret pin); the XLA path
+            # draws three-level off the incremental partial sums.
+            if use_pallas:
+                return stratified_sample_at(plane, u, use_pallas=True,
+                                            interpret=interpret)
+            return stratified_sample_rows(plane, blk_sums, u)
+
+        def draw(plane, blk_sums, rng, batch, beta, n_valid):
+            u01 = (jnp.arange(batch, dtype=jnp.float32)
+                   + jax.random.uniform(rng, (batch,))) / batch
+            t, b, mass, total = select_at(plane, blk_sums, u01)
             w = importance_weights(mass, total, n_valid, beta)
             return t * lanes + b, w
 
-        self._draw = jax.jit(draw, static_argnums=2)
+        self._draw = jax.jit(draw, static_argnums=3)
+
+        def draw_at(plane, blk_sums, u):
+            t, b, mass, _ = select_at(plane, blk_sums, u)
+            return t * lanes + b, mass
+
+        self._draw_at_jit = jax.jit(draw_at)
+
+        # Fused write-back + draw: the per-event hot path. One program
+        # keeps the event at ONE device dispatch per shard (the
+        # dispatch-budget pin's unit) AND spares the donated plane a
+        # defensive copy — a standalone scatter donating a plane the
+        # still-queued previous draw references must copy all of it.
+        def apply_draw_at(plane, blk_sums, idx, vals, ub, u):
+            plane, blk_sums = apply_writes(plane, blk_sums, idx, vals,
+                                           ub)
+            t, b, mass, _ = select_at(plane, blk_sums, u)
+            return plane, blk_sums, t * lanes + b, mass
+
+        self._apply_draw_at = jax.jit(apply_draw_at,
+                                      donate_argnums=(0, 1))
+
+        def apply_draw(plane, blk_sums, idx, vals, ub, rng, batch, beta,
+                       n_valid):
+            plane, blk_sums = apply_writes(plane, blk_sums, idx, vals,
+                                           ub)
+            i, w = draw(plane, blk_sums, rng, batch, beta, n_valid)
+            return plane, blk_sums, i, w
+
+        self._apply_draw = jax.jit(apply_draw, static_argnums=6,
+                                   donate_argnums=(0, 1))
+
+    @property
+    def total(self) -> float:
+        """Total plane mass, from the host mirror — no device fetch."""
+        return max(self._total, 0.0)
 
     def set(self, idx: np.ndarray, mass: np.ndarray) -> None:
         """Buffer p^alpha mass writes (applied lazily before the next
         draw). Last write per slot wins, as with the trees."""
-        self._pending_idx.append(np.asarray(idx, np.int32))
-        self._pending_val.append(np.asarray(mass, np.float32))
+        idx = np.asarray(idx, np.int32)
+        vals = np.asarray(mass, np.float32)
+        # Dedup to last-wins up front (np.unique leaves idx SORTED —
+        # _prep_writes relies on that): the mirror delta below must see
+        # each slot once or the old mass is subtracted twice (batched
+        # write-backs concat several train steps), and XLA scatter
+        # order is unspecified for duplicate indices within one call.
+        if idx.shape[0] > 1:
+            _, last = np.unique(idx[::-1], return_index=True)
+            keep = idx.shape[0] - 1 - last
+            idx, vals = idx[keep], vals[keep]
+        self._pending_idx.append(idx)
+        self._pending_val.append(vals)
+        m64 = vals.astype(np.float64)
+        self._total += float(m64.sum() - self._mirror[idx].sum())
+        self._mirror[idx] = m64
+
+    def _prep_writes(self):
+        """Pad the pending write batch into the scatter operands
+        ``(idx, vals, unique block ids)``, or None when nothing is
+        pending. Each :meth:`set` batch arrives deduped AND sorted;
+        only a multi-batch flush needs the cross-batch last-wins pass
+        (XLA scatter order is unspecified for duplicates)."""
+        if not self._pending_idx:
+            return None
+        if len(self._pending_idx) == 1:
+            idx, vals = self._pending_idx[0], self._pending_val[0]
+        else:
+            idx = np.concatenate(self._pending_idx)
+            vals = np.concatenate(self._pending_val)
+            _, last = np.unique(idx[::-1], return_index=True)
+            keep = idx.shape[0] - 1 - last
+            idx, vals = idx[keep], vals[keep]
+        self._pending_idx, self._pending_val = [], []
+        self.writeback_rows += int(idx.shape[0])
+        self._c_wb_rows.inc(idx.shape[0])
+        self._flushes += 1
+        if self._flushes % self._TOTAL_RESUM_EVERY == 0:
+            self._total = float(self._mirror.sum())
+
+        # Pad every operand to a power-of-two bucket (repeat one real
+        # entry — both scatters set a recomputed value, so padded
+        # duplicates are idempotent) so the donated programs compile
+        # O(log) variants, not one per distinct write-batch length.
+        def pad(a):
+            p = pad_pow2(a.shape[0])
+            if p == a.shape[0]:
+                return a
+            return np.concatenate([a, np.repeat(a[:1], p - a.shape[0])])
+
+        # idx is sorted, so unique touched blocks are a diff away — no
+        # second sort.
+        blocks = idx // self._blk
+        ub = blocks[np.flatnonzero(np.diff(blocks, prepend=-1))]
+        return pad(idx), pad(vals), pad(ub.astype(np.int32))
 
     def _flush_writes(self) -> None:
-        if not self._pending_idx:
-            return
-        idx = np.concatenate(self._pending_idx)
-        vals = np.concatenate(self._pending_val)
-        self._pending_idx, self._pending_val = [], []
-        # Dedup to last-wins: XLA scatter order is unspecified for
-        # duplicate indices within one call.
-        _, last = np.unique(idx[::-1], return_index=True)
-        keep = idx.shape[0] - 1 - last
-        idx, vals = idx[keep], vals[keep]
-        # Pad to a power-of-two bucket (repeat one real pair — idempotent
-        # for .set) so the donated scatter compiles O(log) variants, not
-        # one per distinct write-batch length.
-        padded = pad_pow2(idx.shape[0])
-        if padded != idx.shape[0]:
-            pad = padded - idx.shape[0]
-            idx = np.concatenate([idx, np.repeat(idx[:1], pad)])
-            vals = np.concatenate([vals, np.repeat(vals[:1], pad)])
-        self._plane = self._apply(self._plane, idx, vals)
+        w = self._prep_writes()
+        if w is not None:
+            self._plane, self._blk_sums = self._apply(
+                self._plane, self._blk_sums, *w)
+
+    def _fire_draw_seam(self) -> None:
+        """Chaos seam (ISSUE 18): the per-shard device draw — exception
+        tests the coordinator's failure contract, stall its pipeline
+        slack; recovery is anchored at the next draw that MATERIALIZES
+        (mark_recovered in :meth:`materialize_at`/:meth:`sample`)."""
+        from dist_dqn_tpu import chaos
+        cev = chaos.fire("replay.device_sample")
+        if cev is not None:
+            if cev.fault == "exception":
+                raise chaos.ChaosInjectedError("replay.device_sample",
+                                               cev.fault)
+            chaos.sleep_for(cev)
+
+    def dispatch_at(self, u: np.ndarray):
+        """Enqueue one explicit-uniform draw (u [S] in [0, 1)) on the
+        plane's device and return the UNMATERIALIZED (idx, mass) device
+        arrays — jax dispatch is async, so a coordinator looping over
+        shards runs all their draws concurrently before the first
+        :meth:`materialize_at` blocks. One jitted program per call: the
+        dispatch-budget pin's unit of accounting."""
+        self._fire_draw_seam()
+        self.draw_dispatches += 1
+        u = np.asarray(u, np.float32)
+        w = self._prep_writes()
+        t0 = time.perf_counter()
+        if w is None:
+            return (t0, self._draw_at_jit(self._plane, self._blk_sums,
+                                          u))
+        (self._plane, self._blk_sums, idx,
+         mass) = self._apply_draw_at(self._plane, self._blk_sums, *w, u)
+        return (t0, (idx, mass))
+
+    def materialize_at(self, handle, size: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Block on a :meth:`dispatch_at` handle -> (flat idx [S] int64,
+        selected f64 mass [S] — zeroed where the draw walked onto an
+        unwritten/zero-mass cell, so the caller's IS weights zero those
+        rows exactly like :meth:`sample` does)."""
+        t0, (idx, mass) = handle
+        idx = np.asarray(idx, np.int64)
+        mass = np.asarray(mass, np.float64)
+        bad = (idx >= size) | (mass <= 0.0)
+        if bad.any():
+            idx = np.minimum(idx, size - 1)
+            mass = np.where(bad, 0.0, mass)
+        self._h_sample.observe(time.perf_counter() - t0)
+        from dist_dqn_tpu import chaos
+        chaos.mark_recovered("replay.device_sample")
+        return idx, mass
+
+    def sample_at(self, u: np.ndarray, size: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Synchronous explicit-uniform draw (dispatch + materialize)."""
+        return self.materialize_at(self.dispatch_at(u), size)
 
     def sample(self, batch_size: int, beta: float, size: int
                ) -> Tuple[np.ndarray, np.ndarray]:
         """-> (flat slot indices [S], IS weights [S])."""
-        self._flush_writes()
+        self._fire_draw_seam()
+        self.draw_dispatches += 1
+        pend = self._prep_writes()
+        t0 = time.perf_counter()
         self._rng, k = self.jax.random.split(self._rng)
-        idx, w = self._draw(self._plane, k, batch_size, np.float32(beta),
-                            np.float32(size))
+        if pend is None:
+            idx, w = self._draw(self._plane, self._blk_sums, k,
+                                batch_size, np.float32(beta),
+                                np.float32(size))
+        else:
+            (self._plane, self._blk_sums, idx,
+             w) = self._apply_draw(self._plane, self._blk_sums, *pend,
+                                   k, batch_size, np.float32(beta),
+                                   np.float32(size))
         idx = np.asarray(idx, np.int64)
         w = np.asarray(w, np.float32)
+        self._h_sample.observe(time.perf_counter() - t0)
         # A draw can land past the written region only through fp boundary
         # pathology on a zero-mass cell. Clamping alone would pair slot
         # size-1 with the OUT-OF-RANGE cell's IS weight; zero the weight
@@ -340,6 +552,8 @@ class DevicePrioritySampler:
         if oob.any():
             idx = np.minimum(idx, size - 1)
             w = np.where(oob, np.float32(0.0), w)
+        from dist_dqn_tpu import chaos
+        chaos.mark_recovered("replay.device_sample")
         return idx, w
 
 
@@ -360,13 +574,19 @@ class PrioritizedHostReplay:
 
     def __init__(self, capacity: int, alpha: float = 0.6,
                  priority_eps: float = 1e-6, seed: int = 0,
-                 native: Optional[bool] = None, sampler: str = "tree"):
+                 native: Optional[bool] = None, sampler: str = "tree",
+                 sampler_device=None, shard: Optional[int] = None):
         self.capacity = capacity
         self.alpha = alpha
         self.priority_eps = priority_eps
         self.sampler = sampler
-        self.device_sampler = (DevicePrioritySampler(capacity, seed=seed)
-                               if sampler == "device" else None)
+        # ``sampler_device``/``shard`` (ISSUE 18): the sharded facade
+        # pins each sub-store's plane to its sticky chip and labels its
+        # device-sampling telemetry with the shard id.
+        self.device_sampler = (
+            DevicePrioritySampler(capacity, seed=seed,
+                                  device=sampler_device, shard=shard)
+            if sampler == "device" else None)
         # Device mode never reads the host tree — don't pay its writes,
         # rebuilds, or the float64 allocation for nothing.
         self.tree = (None if self.device_sampler is not None

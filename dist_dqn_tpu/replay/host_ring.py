@@ -412,7 +412,7 @@ class RingPrioritySampler:
         self.eps = float(eps)
         B = ring.num_envs
         self.capacity = ring.num_slots * B
-        self.tree = make_sum_tree(self.capacity, native=native)
+        self._make_backend(native)
         # Authoritative p^alpha per flat slot; the tree holds
         # _mass * valid_region_mask.
         self._mass = np.zeros(self.capacity, np.float64)
@@ -450,6 +450,30 @@ class RingPrioritySampler:
                                  % ring.num_slots)
             ring.add_publish_hook(self._on_publish)
 
+    # -- priority-mass backend seams (ISSUE 18) -----------------------------
+    # RingDevicePrioritySampler overrides exactly these five; every
+    # fence/valid-mask/generation invariant lives ONCE, in the methods
+    # above and below them, so the two backends cannot drift on the
+    # semantics that matter.
+    def _make_backend(self, native: Optional[bool]) -> None:
+        from dist_dqn_tpu.replay.host import make_sum_tree
+        self.tree = make_sum_tree(self.capacity, native=native)
+
+    def _backend_set(self, flat: np.ndarray, vals: np.ndarray) -> None:
+        self.tree.set(flat, vals)
+
+    def _backend_total(self) -> float:
+        return self.tree.total
+
+    def _draw_at_mass(self, positions: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Inverse-CDF draw at explicit mass positions -> (leaf, mass)."""
+        leaf = self.tree.sample(positions)
+        return leaf, self.tree.get(leaf)
+
+    def _backend_get(self, leaf: np.ndarray) -> np.ndarray:
+        return self.tree.get(leaf)
+
     # -- ring-append synchronization (runs under the ring fence) ------------
     def _flat(self, t: np.ndarray) -> np.ndarray:
         B = self._ring.num_envs
@@ -478,9 +502,9 @@ class RingPrioritySampler:
         flat = self._flat(touched)
         vals = self._mass[flat].copy().reshape(touched.shape[0], -1)
         vals[np.isin(touched, cur_invalid)] = 0.0
-        self.tree.set(flat, vals.reshape(-1))
+        self._backend_set(flat, vals.reshape(-1))
         self._invalid_t = cur_invalid
-        self._g_mass.set(self.tree.total)
+        self._g_mass.set(self._backend_total())
 
     # -- sampling -----------------------------------------------------------
     def sample(self, rng: np.random.Generator, batch_size: int,
@@ -495,10 +519,9 @@ class RingPrioritySampler:
             if num_valid <= 0:
                 raise ValueError(
                     "ring not sampleable yet (gate on can_sample)")
-            total = self.tree.total
-            leaf = self.tree.sample(self._stratified(rng, batch_size,
-                                                     total))
-            mass = self.tree.get(leaf)
+            total = self._backend_total()
+            leaf, mass = self._draw_at_mass(
+                self._stratified(rng, batch_size, total))
             # A draw can land on a zero-mass (invalid-region) leaf only
             # through fp boundary pathology. Substitute the oldest valid
             # slot and zero the IS weight so the stand-in contributes
@@ -509,7 +532,7 @@ class RingPrioritySampler:
                 oldest_valid = ((ring.pos - ring.size + ring._extra())
                                 % ring.num_slots) * B
                 leaf = np.where(bad, oldest_valid, leaf)
-                mass = self.tree.get(leaf)
+                mass = self._backend_get(leaf)
             t_idx = (leaf // B).astype(np.int32)
             b_idx = (leaf % B).astype(np.int32)
             p_sel = mass / max(total, 1e-300)
@@ -547,14 +570,13 @@ class RingPrioritySampler:
             if num_valid <= 0:
                 raise ValueError(
                     "ring not sampleable yet (gate on can_sample)")
-            leaf = self.tree.sample(mass_positions)
-            mass = self.tree.get(leaf)
+            leaf, mass = self._draw_at_mass(mass_positions)
             bad = mass <= 0.0
             if bad.any():
                 oldest_valid = ((ring.pos - ring.size + ring._extra())
                                 % ring.num_slots) * B
                 leaf = np.where(bad, oldest_valid, leaf)
-                mass = np.where(bad, 0.0, self.tree.get(leaf))
+                mass = np.where(bad, 0.0, self._backend_get(leaf))
             t_idx = (leaf // B).astype(np.int32)
             b_idx = (leaf % B).astype(np.int32)
             slot_gen = self._ring.slot_gen[t_idx].copy()
@@ -588,9 +610,12 @@ class RingPrioritySampler:
             }
             # Exact tree heap (native delta-propagation drift + rebuild
             # cadence included) — what makes a PER resume bit-identical
-            # rather than merely ulp-close.
-            out.update({f"tree_{k}": v
-                        for k, v in self.tree.state_dict().items()})
+            # rather than merely ulp-close. The device twin has no host
+            # heap; its plane is a pure function of ``_mass`` too, so
+            # the shadow alone round-trips it.
+            if self.tree is not None:
+                out.update({f"tree_{k}": v
+                            for k, v in self.tree.state_dict().items()})
             return out
 
     def load_state_dict(self, state: dict) -> None:
@@ -614,13 +639,15 @@ class RingPrioritySampler:
                 "under a different replay config")
         saved_backend = bytes(np.asarray(
             state.get("tree_backend", b""))).decode() or None
-        live_backend = ("native" if type(self.tree).__name__
+        live_backend = (None if self.tree is None else
+                        "native" if type(self.tree).__name__
                         == "NativeSumTree" else "numpy")
         with self._ring._fence:
             np.copyto(self._mass, mass)
             self._max_priority = float(state["max_priority"])
             self._invalid_t = self._invalid_ts()
-            if saved_backend == live_backend and \
+            if live_backend is not None and \
+                    saved_backend == live_backend and \
                     "tree_nodes" in state and \
                     np.asarray(state["tree_nodes"]).shape[0] \
                     == 2 * self.tree.capacity:
@@ -631,16 +658,18 @@ class RingPrioritySampler:
                      if k.startswith("tree_")})
             else:
                 # Backend changed between save and resume (toolchain
-                # drift) or a pre-heap snapshot: rebuild from the shadow
-                # mass + valid-region mask — correct distribution, but
-                # interior sums may differ in the last ulp from the
-                # killed run's (documented in docs/fault_tolerance.md).
+                # drift), a pre-heap snapshot, or the device twin (whose
+                # plane is always a pure function of the shadow):
+                # rebuild from the shadow mass + valid-region mask —
+                # correct distribution, but interior sums may differ in
+                # the last ulp from the killed run's (documented in
+                # docs/fault_tolerance.md).
                 flat = np.arange(self.capacity, dtype=np.int64)
                 vals = self._mass.copy()
                 inv_flat = self._flat(self._invalid_t)
                 vals[inv_flat] = 0.0
-                self.tree.set(flat, vals)
-            total = self.tree.total
+                self._backend_set(flat, vals)
+            total = self._backend_total()
         (self.writeback_flushes, self.writeback_rows,
          self.writeback_dropped) = (int(x) for x in state["wb_counters"])
         self._g_max_prio.set(self._max_priority)
@@ -672,11 +701,11 @@ class RingPrioritySampler:
                 # currently inside the bootstrap/context boundary stays
                 # shadow-only until an append re-validates it.
                 inv = np.isin(leaf // ring.num_envs, self._invalid_t)
-                self.tree.set(leaf, np.where(inv, 0.0, mass))
-            # Still under the fence: tree.total must not race a
-            # concurrent publish hook's tree.set on the evacuation
+                self._backend_set(leaf, np.where(inv, 0.0, mass))
+            # Still under the fence: the backend total must not race a
+            # concurrent publish hook's backend set on the evacuation
             # worker thread.
-            total = self.tree.total
+            total = self._backend_total()
         applied = int(leaf.size)
         self.writeback_flushes += 1
         self.writeback_rows += applied
@@ -687,3 +716,69 @@ class RingPrioritySampler:
         self._g_max_prio.set(self._max_priority)
         self._g_mass.set(total)
         return applied, dropped
+
+
+class RingDevicePrioritySampler(RingPrioritySampler):
+    """``RingPrioritySampler`` with the priority mass living on an
+    accelerator plane instead of a host sum-tree — the host-replay twin
+    of the apex store's ``DevicePrioritySampler`` (ISSUE 18).
+
+    Only the five backend seams differ: mass writes land on the shard's
+    committed device plane (one batched last-write-wins scatter per
+    publish/write-back flush), the stratified total reads from the
+    plane's host f64 mirror (zero device fetches on the ladder path),
+    and draws run the inverse-CDF on device — the Pallas kernel on TPU,
+    plain XLA elsewhere (loop_common.pallas_routing decides). Every
+    fence, valid-mask, generation-filter, and boundary-substitution
+    invariant is inherited verbatim from the base class, so the device
+    path can never drift from the host tree on the semantics the PER
+    parity tests pin.
+
+    ``self.tree is None`` here: checkpoints carry only the ``_mass``
+    shadow (the plane is a pure function of it), and resume rebuilds
+    the plane through ``_backend_set`` — the base class's
+    backend-changed branch. ``device``/``shard`` pin the plane to one
+    mesh chip so a dp>1 loop gets one independent plane per shard.
+    """
+
+    def __init__(self, ring: HostTimeRing, n_step: int,
+                 alpha: float = 0.6, beta: float = 0.4,
+                 eps: float = 1e-6, name: str = "host_replay",
+                 device=None, shard: Optional[int] = None,
+                 seed: int = 0):
+        self._device = device
+        self._shard = shard
+        self._plane_seed = int(seed)
+        super().__init__(ring, n_step, alpha=alpha, beta=beta, eps=eps,
+                         native=None, name=name)
+
+    def _make_backend(self, native: Optional[bool]) -> None:
+        from dist_dqn_tpu.replay.host import DevicePrioritySampler
+        self.tree = None
+        self.plane = DevicePrioritySampler(
+            self.capacity, seed=self._plane_seed,
+            device=self._device, shard=self._shard)
+
+    def _backend_set(self, flat: np.ndarray, vals: np.ndarray) -> None:
+        self.plane.set(np.asarray(flat, np.int64),
+                       np.asarray(vals, np.float64))
+
+    def _backend_total(self) -> float:
+        return self.plane.total
+
+    def _draw_at_mass(self, positions: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        # The base class hands absolute mass positions (stratified over
+        # [0, total)); the plane draws at uniforms in [0, 1).
+        total = self.plane.total
+        u = np.asarray(positions, np.float64) / max(total, 1e-300)
+        return self.plane.sample_at(u, self.capacity)
+
+    def _backend_get(self, leaf: np.ndarray) -> np.ndarray:
+        # Substitution re-read: mass as the plane sees it — the shadow
+        # masked by the CURRENT valid region — without a device fetch.
+        mass = self._mass[np.asarray(leaf, np.int64)].copy()
+        inv = np.isin(np.asarray(leaf, np.int64) // self._ring.num_envs,
+                      self._invalid_t)
+        mass[inv] = 0.0
+        return mass

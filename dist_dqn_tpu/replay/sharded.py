@@ -116,10 +116,29 @@ class ShardedHostReplay:
     def attach_priority_samplers(self, n_step: int, alpha: float,
                                  beta: float, eps: float,
                                  native: Optional[bool] = None,
-                                 name: str = "host_replay"
+                                 name: str = "host_replay",
+                                 device_sampling: bool = False,
+                                 devices: Optional[List] = None,
+                                 seed: int = 0
                                  ) -> List[RingPrioritySampler]:
-        """One sum-tree sampler per shard, registered on each ring's
-        publish hook (per-shard generation fences stay per-shard)."""
+        """One priority sampler per shard, registered on each ring's
+        publish hook (per-shard generation fences stay per-shard).
+        ``device_sampling`` swaps the host sum-trees for per-shard
+        accelerator planes (RingDevicePrioritySampler, ISSUE 18), each
+        committed to ``devices[i]`` — pass the mesh's device list so
+        shard i's plane lives beside the chip shard i trains on."""
+        if device_sampling:
+            from dist_dqn_tpu.replay.host_ring import \
+                RingDevicePrioritySampler
+            devs = list(devices) if devices else [None] * self.num_shards
+            self.samplers = [
+                RingDevicePrioritySampler(
+                    ring, n_step=n_step, alpha=alpha, beta=beta, eps=eps,
+                    name=f"{name}_s{i}" if self.num_shards > 1 else name,
+                    device=devs[i % len(devs)], shard=i, seed=seed + 7 * i)
+                for i, ring in enumerate(self.rings)
+            ]
+            return self.samplers
         self.samplers = [
             RingPrioritySampler(ring, n_step=n_step, alpha=alpha,
                                 beta=beta, eps=eps, native=native,
@@ -365,14 +384,23 @@ class ShardedPrioritizedReplay:
     sum-trees, and slot ids are globally encoded
     (``shard * shard_capacity + local``) so the service's pipelined
     priority write-backs, generation guards and batched flushes work
-    unchanged. The host sampler backend only — the on-device priority
-    plane (``device_sampling``) owns one contiguous plane and has no
-    per-shard story yet (the constructor refuses, loudly).
+    unchanged.
+
+    ``sampler="device"`` (ISSUE 18) gives EVERY shard its own on-device
+    priority plane (replay/host.py DevicePrioritySampler) pinned to its
+    sticky chip — devices assigned round-robin over ``jax.devices()``,
+    shard i on chip ``i % n``. The coordinator lays the SAME global
+    stratified ladder over the per-shard totals (read from each plane's
+    host mirror, zero device fetches), dispatches every shard's
+    explicit-uniform draw before materializing any (jax async dispatch
+    — the draws run concurrently on their own chips), and computes the
+    global IS weights from the returned masses. Item storage stays in
+    host DRAM either way; only priorities live on device.
     """
 
     def __init__(self, num_shards: int, capacity: int, alpha: float = 0.6,
                  priority_eps: float = 1e-6, seed: int = 0,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None, sampler: str = "tree"):
         if num_shards < 2:
             raise ValueError(
                 "ShardedPrioritizedReplay needs num_shards >= 2; one "
@@ -383,10 +411,21 @@ class ShardedPrioritizedReplay:
         self.shard_capacity = -(-int(capacity) // self.num_shards)
         self.capacity = self.shard_capacity * self.num_shards
         self.alpha = float(alpha)
+        self.sampler = sampler
+        devices: List = [None] * self.num_shards
+        if sampler == "device":
+            # Deferred import: this module stays jax-free unless the
+            # device planes are actually requested (host DRAM residency
+            # is the point — see the module docstring).
+            import jax
+            devs = jax.devices()
+            devices = [devs[i % len(devs)] for i in range(self.num_shards)]
         self.shards: List[PrioritizedHostReplay] = [
             PrioritizedHostReplay(self.shard_capacity, alpha=alpha,
                                   priority_eps=priority_eps,
-                                  seed=seed + 7 * i, native=native)
+                                  seed=seed + 7 * i, native=native,
+                                  sampler=sampler,
+                                  sampler_device=devices[i], shard=i)
             for i in range(self.num_shards)
         ]
         # Per-shard locks (ISSUE 14): the ingest-side sampling service
@@ -438,6 +477,8 @@ class ShardedPrioritizedReplay:
         size = len(self)
         if size == 0:
             raise ValueError("sample() on an empty replay shard")
+        if self.sampler == "device":
+            return self._sample_device(batch_size, beta, size)
         totals = np.array([s.tree.total for s in self.shards], np.float64)
         T = float(totals.sum())
         mass = stratified_mass(self._rng, batch_size, T)
@@ -452,6 +493,72 @@ class ShardedPrioritizedReplay:
         weights = (weights / weights.max()).astype(np.float32)
         self.sampled += batch_size
         return out, idx_g, weights
+
+    def _sample_device(self, batch_size: int, beta: float, size: int
+                       ) -> Tuple[Dict[str, np.ndarray], np.ndarray,
+                                  np.ndarray]:
+        """Device-plane leg of :meth:`sample`: the SAME global ladder
+        (so P(i) is exactly the single-tree distribution), but each
+        shard's rows are one explicit-uniform jit dispatch on ITS chip.
+        All dispatches enqueue before any result is awaited — jax's
+        async dispatch runs the per-shard draws concurrently — and the
+        IS weights come from the global total with one batch-wide max
+        normalization, zero-mass substitutions zeroed (the same
+        discipline as the tree path / DevicePrioritySampler.sample)."""
+        totals = np.array([s.device_sampler.total for s in self.shards],
+                          np.float64)
+        T = float(totals.sum())
+        mass = stratified_mass(self._rng, batch_size, T)
+        shard_of, local_mass = _map_mass_to_shards(mass, totals)
+        handles: List = [None] * self.num_shards
+        for s_id in range(self.num_shards):
+            rows = shard_of == s_id
+            if not rows.any():
+                continue
+            u = local_mass[rows] / max(totals[s_id], 1e-300)
+            with self._locks[s_id]:
+                handles[s_id] = (rows,
+                                 self.shards[s_id].device_sampler
+                                 .dispatch_at(u))
+        idx_g = np.empty(batch_size, np.int64)
+        p_sel = np.zeros(batch_size, np.float64)
+        out: Optional[Dict[str, np.ndarray]] = None
+        for s_id, h in enumerate(handles):
+            if h is None:
+                continue
+            rows, handle = h
+            s = self.shards[s_id]
+            with self._locks[s_id]:
+                idx, mass_sel = s.device_sampler.materialize_at(
+                    handle, len(s))
+                # Masses come back relative to the SHARD's plane; the
+                # global P(i) divides by the global total below.
+                p_sel[rows] = mass_sel / max(T, 1e-300)
+                idx_g[rows] = idx + s_id * self.shard_capacity
+                if out is None:
+                    out = {k: np.empty((batch_size,) + v.shape[1:],
+                                       v.dtype)
+                           for k, v in s._data.items()}
+                for k, v in s._data.items():
+                    out[k][rows] = v[idx]
+                n_rows = int(rows.sum())
+                s.sampled += n_rows
+                s._c_sampled.inc(n_rows)
+                s._g_mass.set(s.device_sampler.total)
+        bad = p_sel <= 0.0
+        weights = (size * np.maximum(p_sel, 1e-12)) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        if bad.any():
+            weights[bad] = 0.0
+        self.sampled += batch_size
+        return out, idx_g, weights
+
+    @property
+    def device_sample_dispatches(self) -> int:
+        """Total per-shard device draw dispatches (the dispatch-budget
+        pin's observable: one per shard per train event)."""
+        return sum(s.device_sampler.draw_dispatches for s in self.shards
+                   if s.device_sampler is not None)
 
     def _shard_draw(self, s_id: int, rows: np.ndarray,
                     local_mass: np.ndarray, T: float, batch_size: int,

@@ -185,6 +185,14 @@ INGEST_SHM_BATCH_FANIN = "dqn_ingest_shm_batch_fanin"
 REPLAY_SHARD_SAMPLE_SECONDS = "dqn_replay_shard_sample_seconds"
 REPLAY_SHARD_SAMPLE_WAIT = "dqn_replay_shard_sample_wait_seconds"
 
+# Sharded on-device priority sampling (ISSUE 18): DEVICE_SAMPLE_SECONDS
+# is the per-{shard} device-plane draw wall (write-back flush + jit
+# dispatch + host materialization — what the host tree's sample+get
+# used to cost the learner thread), DEVICE_WRITEBACK_ROWS the priority
+# rows scattered into each shard's plane (post last-write-wins dedup).
+REPLAY_DEVICE_SAMPLE_SECONDS = "dqn_replay_device_sample_seconds"
+REPLAY_DEVICE_WRITEBACK_ROWS = "dqn_replay_device_writeback_rows_total"
+
 #: Slot-publish fan-in buckets: a feeder batch is bounded by slot
 #: sizing well below the act-dispatch fan-ins FANIN_BUCKETS covers.
 SHM_FANIN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)

@@ -596,9 +596,13 @@ def main():
                              "(actors/remote.py) on this port; 0 = "
                              "ephemeral")
     parser.add_argument("--device-sampling", action="store_true",
-                        help="apex runtime: sample the host replay shard's "
-                             "priorities ON DEVICE (Pallas stratified "
-                             "kernel; items stay in host DRAM)")
+                        help="sample replay priorities ON DEVICE (Pallas "
+                             "stratified kernel; items stay in host "
+                             "DRAM). Apex runtime: one priority plane "
+                             "per --ingest-shards replay shard, each on "
+                             "its own chip. Host-replay runtime (with "
+                             "--per): one plane per --mesh-devices "
+                             "shard, replacing the host sum-trees")
     parser.add_argument("--transport", choices=("zerocopy", "legacy"),
                         default="zerocopy",
                         help="apex runtime experience path (ISSUE 9): "
@@ -621,8 +625,9 @@ def main():
                              "the spread). N > 1 requires the zerocopy "
                              "transport with actor priorities (or a "
                              "recurrent config) for per-actor insert "
-                             "attribution, and the host tree sampler "
-                             "(no --device-sampling)")
+                             "attribution; sampling runs on the host "
+                             "trees or, with --device-sampling, on one "
+                             "per-shard device priority plane each")
     parser.add_argument("--no-wire-dedup", action="store_true",
                         help="apex runtime (ISSUE 14): disable the "
                              "frame-stack dedup wire plane — actors on "
@@ -797,7 +802,8 @@ def main():
             prioritized=True if args.per else None,
             checkpoint_dir=args.checkpoint_dir,
             save_every_frames=args.save_every_frames,
-            mesh_devices=args.mesh_devices)
+            mesh_devices=args.mesh_devices,
+            device_sampling=args.device_sampling)
         out.pop("history", None)
         print(json.dumps(out))
         return
@@ -889,6 +895,10 @@ def main():
               "--runtime host-replay only; ignored under the fused "
               "runtime (its replay samples on device — "
               "replay.prioritized selects the device sampler there)")
+    if args.device_sampling:
+        print("# --device-sampling applies to the apex/host-replay "
+              "runtimes; ignored under the fused runtime (its replay "
+              "is device-resident already)")
     stop_fn = None
     if args.stop_at_return is not None:
         target = args.stop_at_return

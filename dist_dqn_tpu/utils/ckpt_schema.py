@@ -34,7 +34,7 @@ from typing import Dict, Iterable, Tuple
 #: Bump on ANY change to the field set below, and append the new
 #: (version, digest) pair to SIDECAR_HISTORY — scripts/check_ckpt_schema.py
 #: prints the expected digest on mismatch.
-SIDECAR_VERSION = 2
+SIDECAR_VERSION = 3
 
 #: Scalar fields present in every host_loop sidecar.
 SIDECAR_SCALAR_FIELDS: Tuple[str, ...] = (
@@ -58,6 +58,13 @@ SIDECAR_SCALAR_FIELDS: Tuple[str, ...] = (
                          # sidecar (carry{s}_leaf{i}), single-collect
                          # runs keep the one carry in the orbax tree;
                          # a mismatch cannot restore either way
+    "per_sampler_kind",  # v3 (ISSUE 18): PER backend pin — 0 = host
+                         # sum-tree, 1 = device priority plane. The
+                         # mass shadow restores either way, but draw
+                         # timing/fp-reduction order differ, so a
+                         # resume that silently swapped backends would
+                         # break the bit-identical-resume contract;
+                         # refuse loudly instead (reason=sampler_kind)
 )
 
 #: Conditional scalars: present only when their ``has_*`` flag is set.
@@ -109,6 +116,7 @@ def sidecar_digest() -> str:
 SIDECAR_HISTORY: Dict[int, str] = {
     1: "948b5e00114da529",
     2: "0e038b7fe0331a3d",
+    3: "8ef0d7a524f3d7d3",
 }
 
 _COMPILED = None

@@ -1,0 +1,410 @@
+"""Sharded on-device priority sampling (ISSUE 18) — the acceptance
+pins for per-shard priority planes:
+
+* FACADE PARITY: ``ShardedPrioritizedReplay(sampler="device")`` draws
+  the SAME P(i) ~ p^alpha distribution as the tree facade (10x
+  oversampled frequency pin) and its IS weights follow the global
+  (N * P)^-beta formula;
+* DISPATCH BUDGET: one device draw dispatch per shard per train event,
+  and ZERO host-tree state on the device path (``tree is None`` per
+  sub-store);
+* WRITE-BACK GUARD PARITY: stale-generation rows are dropped
+  identically by the device planes and the host trees;
+* RING LOCKSTEP (dp=2 hammer): two ``RingDevicePrioritySampler`` planes
+  on separate mesh chips, fed through the add_chunk publish hook under
+  the generation fence, stay mass-ladder-identical to the host-tree
+  reference across appends / wraps / guarded write-backs;
+* KILL/RESUME: the dp=2 ``--per --device-sampling`` host-replay run
+  killed at chunk 4 resumes BIT-IDENTICALLY, and a checkpoint written
+  with one sampler kind refuses the other loudly (sidecar
+  ``per_sampler_kind``, counted under ``reason="sampler_kind"``);
+* INTERPRET PIN: the Pallas kernel (interpret mode on CPU) and the
+  three-level XLA draw agree exactly at explicit uniforms.
+
+Needs the 8-device CPU mesh conftest.py forces.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dist_dqn_tpu import chaos
+from dist_dqn_tpu.config import CONFIGS
+from dist_dqn_tpu.replay.host import DevicePrioritySampler
+from dist_dqn_tpu.replay.sharded import ShardedPrioritizedReplay
+
+
+def _require_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs >= {n} CPU devices from conftest")
+
+
+def _filled_facade(sampler, shards=2, per_shard=128, seed=11):
+    """A facade with every shard full and a fixed spiky priority vector
+    (identical across sampler kinds, so distributions must agree)."""
+    store = ShardedPrioritizedReplay(shards, shards * per_shard,
+                                     alpha=1.0, seed=seed, sampler=sampler)
+    rng = np.random.default_rng(seed)
+    pr = rng.uniform(0.5, 4.0, size=shards * per_shard)
+    pr[::37] *= 10.0  # spikes: the prioritized regime, not near-uniform
+    for s in range(shards):
+        lo = s * per_shard
+        store.add({"x": np.arange(lo, lo + per_shard, dtype=np.float32)},
+                  priorities=pr[lo:lo + per_shard], shard=s)
+    return store, pr + 1e-6  # facade adds priority_eps before ^alpha
+
+
+# ---------------------------------------------------------------------------
+# Facade parity + dispatch budget
+# ---------------------------------------------------------------------------
+
+def test_facade_device_matches_tree_distribution():
+    """Device facade vs tree facade vs theory: 10x-oversampled empirical
+    P(i) within tolerance of p^alpha/total for BOTH, and device-vs-tree
+    L1 distance in the same band — the per-shard planes under the
+    global ladder ARE the single-tree distribution."""
+    dev, pr = _filled_facade("device")
+    tre, _ = _filled_facade("tree")
+    n_slots = pr.shape[0]
+    want = pr / pr.sum()
+    counts = {"device": np.zeros(n_slots), "tree": np.zeros(n_slots)}
+    w_dev = None
+    for _ in range(40):
+        items, idx, w = dev.sample(256, beta=1.0)
+        np.testing.assert_allclose(items["x"], idx.astype(np.float32))
+        counts["device"] += np.bincount(idx, minlength=n_slots)
+        w_dev = (idx, w)
+        _, idx_t, _ = tre.sample(256, beta=1.0)
+        counts["tree"] += np.bincount(idx_t, minlength=n_slots)
+    f_dev = counts["device"] / counts["device"].sum()
+    f_tre = counts["tree"] / counts["tree"].sum()
+    np.testing.assert_allclose(f_dev, want, atol=0.01)
+    np.testing.assert_allclose(f_tre, want, atol=0.01)
+    # IS compensation: weights follow (N * P(i))^-beta with the GLOBAL
+    # total, batch-max-normalized — same formula as the tree facade.
+    idx, w = w_dev
+    p_sel = pr[idx] / pr.sum()
+    ref = (n_slots * p_sel) ** -1.0
+    np.testing.assert_allclose(w, (ref / ref.max()).astype(np.float32),
+                               rtol=1e-4)
+
+
+def test_facade_device_dispatch_budget_and_no_host_tree():
+    """The dispatch-budget pin: one device draw dispatch per shard per
+    train event, counted from the samplers' own counters; the device
+    path allocates NO host sum-tree to fall back on."""
+    store, _ = _filled_facade("device", shards=2)
+    for s in store.shards:
+        assert s.tree is None            # zero host-tree ops possible
+        assert s.device_sampler is not None
+    assert store.device_sample_dispatches == 0
+    events = 5
+    for _ in range(events):
+        store.sample(64, beta=0.4)
+    # The stratified ladder spans [0, T): with balanced shard mass every
+    # event lands rows on both shards — exactly one dispatch each.
+    assert store.device_sample_dispatches == events * store.num_shards
+
+
+def test_facade_writeback_generation_guard_parity():
+    """Stale write-backs (slot overwritten since sample) drop
+    IDENTICALLY on device planes and host trees: after the same guarded
+    update, per-slot p^alpha mass agrees between the two backends."""
+    shards, per_shard = 2, 64
+    dev, _ = _filled_facade("device", shards=shards, per_shard=per_shard)
+    tre, _ = _filled_facade("tree", shards=shards, per_shard=per_shard)
+    # Capture generations for the first 8 slots of each shard, then wrap
+    # the ring over half of them so the guard has stale rows to drop.
+    idx = np.concatenate([np.arange(8) + s * per_shard
+                          for s in range(shards)])
+    gen_d, gen_t = dev.generation(idx), tre.generation(idx)
+    np.testing.assert_array_equal(gen_d, gen_t)
+    for st in (dev, tre):
+        for s in range(shards):
+            st.add({"x": np.full(4, -1.0, np.float32)},
+                   priorities=np.full(4, 2.0), shard=s)
+    dev.update_priorities(idx, np.full(idx.shape[0], 99.0),
+                          expected_gen=gen_d)
+    tre.update_priorities(idx, np.full(idx.shape[0], 99.0),
+                          expected_gen=gen_t)
+    for s in range(shards):
+        d = dev.shards[s].device_sampler
+        d._flush_writes()
+        plane = np.asarray(d._plane, np.float64).reshape(-1)[:per_shard]
+        tree = tre.shards[s].tree.get(np.arange(per_shard, dtype=np.int64))
+        np.testing.assert_allclose(plane, tree, rtol=1e-6)
+        # Wrapped slots kept their fresh (2.0 + eps) mass...
+        np.testing.assert_allclose(plane[:4], 2.0 + 1e-6, rtol=1e-6)
+        # ...while the still-live rows took the 99.0 write-back.
+        np.testing.assert_allclose(plane[4:8], 99.0 + 1e-6, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Interpret-mode kernel pin (the TPU kernel, exercised on CPU)
+# ---------------------------------------------------------------------------
+
+def test_interpret_kernel_matches_xla_three_level_draw():
+    """The Pallas kernel (interpret mode) and the three-level XLA draw
+    (stratified_sample_rows over the incremental block sums) pick the
+    SAME cells at the same explicit uniforms — the parity that lets the
+    CPU suite pin the TPU kernel's routing."""
+    kernels = DevicePrioritySampler(capacity=1024, lanes=128, seed=1,
+                                    use_pallas=True, interpret=True)
+    xla = DevicePrioritySampler(capacity=1024, lanes=128, seed=1,
+                                use_pallas=False)
+    rng = np.random.default_rng(5)
+    pr = rng.uniform(0.2, 3.0, size=900).astype(np.float32)
+    for s in (kernels, xla):
+        s.set(np.arange(900), pr)
+    # Stratum midpoints: off every plateau boundary, so fp reduction
+    # order cannot legally flip a pick between the two implementations.
+    u = (np.arange(256) + 0.5) / 256.0
+    idx_k, mass_k = kernels.sample_at(u, 900)
+    idx_x, mass_x = xla.sample_at(u, 900)
+    np.testing.assert_array_equal(idx_k, idx_x)
+    np.testing.assert_allclose(mass_k, mass_x, rtol=1e-5)
+    np.testing.assert_allclose(mass_k, pr[idx_k], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ring lockstep: dp=2 fence hammer
+# ---------------------------------------------------------------------------
+
+def _fresh_ring(num_envs=2, slots=32):
+    from dist_dqn_tpu.replay.host_ring import HostTimeRing
+
+    return HostTimeRing(slots, num_envs, (3,), np.float32)
+
+
+def _push_chunk(ring, t0, n, num_envs=2):
+    obs = np.full((n, num_envs, 3), float(t0), np.float32)
+    obs += np.arange(n, dtype=np.float32)[:, None, None]
+    ring.add_chunk(obs, np.zeros((n, num_envs), np.int32),
+                   np.ones((n, num_envs), np.float32),
+                   np.zeros((n, num_envs), bool),
+                   np.zeros((n, num_envs), bool))
+
+
+def test_ring_device_planes_lockstep_dp2_hammer():
+    """Two device planes on separate mesh chips + the host-tree
+    reference, all fed the same append/write-back stream through the
+    publish hook under the generation fence: totals agree and draws at
+    the same explicit mass ladder land on the same leaves — through
+    appends, a full ring wrap, and guarded priority write-backs."""
+    _require_devices(2)
+    import jax
+
+    from dist_dqn_tpu.replay.host_ring import (RingDevicePrioritySampler,
+                                               RingPrioritySampler)
+
+    devs = jax.devices()
+    rings = [_fresh_ring(), _fresh_ring(), _fresh_ring()]
+    samplers = [
+        RingDevicePrioritySampler(rings[0], n_step=1, alpha=1.0,
+                                  device=devs[0], shard=0, name="hm0"),
+        RingDevicePrioritySampler(rings[1], n_step=1, alpha=1.0,
+                                  device=devs[1], shard=1, name="hm1"),
+        RingPrioritySampler(rings[2], n_step=1, alpha=1.0, name="hmref"),
+    ]
+    rng = np.random.default_rng(9)
+    t0 = 0
+    for round_i in range(12):  # 12 * 6 steps: wraps the 32-slot ring twice
+        n = 6
+        for ring in rings:
+            _push_chunk(ring, t0, n)
+        t0 += n
+        # The planes hold f32-rounded mass (their mirrors round through
+        # f32 by design); the host tree keeps f64 — agree to f32 ulp.
+        totals = [s._backend_total() for s in samplers]
+        np.testing.assert_allclose(totals, totals[-1], rtol=1e-6)
+        if totals[-1] <= 0:
+            continue
+        # One stratified ladder, handed to all three backends verbatim
+        # (the sharded coordinator's contract): midpoint strata.
+        pos = (np.arange(16) + 0.5) / 16.0 * totals[-1]
+        draws = []
+        for s in samplers:
+            _, per, mass = s.sample_at_mass(pos, gamma=0.99)
+            draws.append((per, mass))
+        ref_per, ref_mass = draws[2]
+        for per, mass in draws[:2]:
+            np.testing.assert_array_equal(per.leaf, ref_per.leaf)
+            np.testing.assert_allclose(mass, ref_mass, rtol=1e-6)
+        # Guarded write-back on the drawn slots: same |TD|s everywhere;
+        # stale rows must drop identically across all three backends.
+        p_new = rng.uniform(0.1, 5.0, size=ref_per.leaf.shape[0])
+        stats = [s.update_priorities(per.leaf, p_new, per.slot_gen)
+                 for s, (per, _) in zip(samplers, draws)]
+        assert stats[0] == stats[1] == stats[2]
+
+
+def test_ring_device_sample_statistical_pin():
+    """The host-replay sampler's rng-driven path (what SamplePrefetcher
+    calls): 10x-oversampled draw frequency matches p^alpha/total over
+    the valid region, and IS weights compensate with the
+    (N * P)^-beta formula — the host tree is the statistically-pinned
+    reference for exactly this distribution."""
+    from dist_dqn_tpu.replay.host_ring import RingDevicePrioritySampler
+
+    ring = _fresh_ring(num_envs=2, slots=32)
+    s = RingDevicePrioritySampler(ring, n_step=1, alpha=1.0, beta=0.5,
+                                  name="hmstat")
+    _push_chunk(ring, 0, 24)
+    # Spike a few slots so the draw is decidedly non-uniform.
+    rng = np.random.default_rng(3)
+    batch, per = s.sample(rng, 64, gamma=0.99)
+    p_new = np.where(per.leaf % 7 == 0, 20.0, 0.5)
+    s.update_priorities(per.leaf, p_new, per.slot_gen)
+    want = s._mass.copy()
+    want[s._flat(s._invalid_t)] = 0.0
+    want /= want.sum()
+    counts = np.zeros(s.capacity)
+    w_seen = None
+    for _ in range(20):
+        _, per = s.sample(rng, 512, gamma=0.99)  # ~10x the mass support
+        counts += np.bincount(per.leaf, minlength=s.capacity)
+        w_seen = per
+    np.testing.assert_allclose(counts / counts.sum(), want, atol=0.01)
+    num_valid = (ring.size - 1 - ring._extra()) * ring.num_envs
+    p_sel = s._backend_get(w_seen.leaf) / s._backend_total()
+    ref = (num_valid * np.maximum(p_sel, 1e-12)) ** -0.5
+    np.testing.assert_allclose(w_seen.weights,
+                               (ref / ref.max()).astype(np.float32),
+                               rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Host-replay runtime: kill/resume + sampler-kind refusal
+# ---------------------------------------------------------------------------
+
+def _dp_cfg():
+    cfg = CONFIGS["cartpole"]
+    return dataclasses.replace(
+        cfg,
+        actor=dataclasses.replace(cfg.actor, num_envs=8),
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096, min_fill=64,
+                                   prioritized=True),
+        learner=dataclasses.replace(cfg.learner, batch_size=16),
+    )
+
+
+def test_dp2_device_sampling_killed_resume_bit_identical(tmp_path):
+    """The ISSUE 12 PER resume pin lifted to the device planes: dp=2
+    --per --device-sampling (serial mode for determinism) killed at
+    chunk 4 resumes bit-identically — the plane is a pure function of
+    the checkpointed mass shadow, so the rebuilt plane continues the
+    exact draw sequence."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+
+    cfg = _dp_cfg()
+    kw = dict(total_env_steps=2400, chunk_iters=50, mesh_devices=2,
+              prefetch=False, prio_writeback_batch=4,
+              device_sampling=True)
+    ref = run_host_replay(cfg, **kw, log_fn=lambda s: None)
+    assert ref["sampler"] == "device"
+    assert ref["prio_writeback_rows"] > 0
+
+    ckpt = str(tmp_path / "dp2dev")
+    plan = chaos.FaultPlan(seed=9, events=(
+        chaos.FaultEvent("host_replay.chunk", "crash", at_hit=4),))
+    with chaos.installed(plan) as inj:
+        with pytest.raises(chaos.ChaosInjectedError,
+                           match="host_replay.chunk"):
+            run_host_replay(cfg, **kw, log_fn=lambda s: None,
+                            checkpoint_dir=ckpt, save_every_frames=400)
+        assert [e["hit"] for e in inj.injected] == [4]
+        logs = []
+        out = run_host_replay(cfg, **kw, checkpoint_dir=ckpt,
+                              save_every_frames=400,
+                              log_fn=lambda s: logs.append(s))
+        assert inj.open_trips() == [], inj.open_trips()
+    assert out["param_checksum"] == ref["param_checksum"]
+    assert out["grad_steps"] == ref["grad_steps"]
+    hist_ref = [r["loss"] for r in ref["history"] if "loss" in r]
+    hist_out = [r["loss"] for r in out["history"] if "loss" in r]
+    assert hist_out == hist_ref[len(hist_ref) - len(hist_out):]
+    assert out["prio_writeback_rows"] == ref["prio_writeback_rows"]
+
+
+def test_sampler_kind_mismatch_resume_refused(tmp_path):
+    """A checkpoint written under one PER backend refuses the other —
+    draw timing and fp reduction order differ, so a silent swap would
+    break the bit-identical-resume contract. The refusal lands in
+    dqn_checkpoint_refused_resumes_total{reason="sampler_kind"}."""
+    _require_devices(2)
+    from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.telemetry.exposition import render_prometheus
+
+    cfg = _dp_cfg()
+    ckpt = str(tmp_path / "kindmix")
+    kw = dict(total_env_steps=1600, chunk_iters=50, mesh_devices=2,
+              prefetch=False, prio_writeback_batch=4,
+              checkpoint_dir=ckpt, save_every_frames=400,
+              log_fn=lambda s: None)
+    run_host_replay(cfg, **kw, device_sampling=True)
+    with pytest.raises(ValueError, match="device-sampling"):
+        run_host_replay(cfg, **kw, device_sampling=False)
+    assert 'reason="sampler_kind"' in render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Apex service: refusals fast, e2e slow
+# ---------------------------------------------------------------------------
+
+def test_apex_device_sampling_refuses_legacy_and_shard_sampling():
+    """The two honest refusals: the legacy bit-pinned bootstrap path
+    stays on the host tree, and per-shard sampling THREADS are redundant
+    once each shard's draw already runs on its own chip."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+
+    cfg = CONFIGS["apex"]
+    base = dict(host_env="CartPole-v1", num_actors=1, envs_per_actor=2,
+                total_env_steps=100, device_sampling=True)
+    with pytest.raises(ValueError, match="legacy"):
+        run_apex(cfg, ApexRuntimeConfig(**base, transport="legacy"),
+                 log_fn=lambda s: None)
+    with pytest.raises(ValueError, match="redundant"):
+        run_apex(cfg, ApexRuntimeConfig(**base, ingest_shards=2,
+                                        shard_sampling=True),
+                 log_fn=lambda s: None)
+
+
+@pytest.mark.slow
+def test_apex_ingest2_device_sampling_end_to_end():
+    """THE apex acceptance pin: a real 2-actor fleet into a 2-shard
+    store with --device-sampling — every shard's plane on its own chip,
+    sampling/learning/priority write-backs end to end, and the
+    dispatch budget holding at one draw dispatch per shard per event
+    (device_calls["replay_sample"] counts dispatches, so it must be an
+    exact multiple of the shard count)."""
+    from dist_dqn_tpu.actors.service import ApexRuntimeConfig, run_apex
+
+    cfg = CONFIGS["apex"]
+    cfg = dataclasses.replace(
+        cfg,
+        network=dataclasses.replace(cfg.network, torso="mlp",
+                                    mlp_features=(32,), hidden=0,
+                                    dueling=False,
+                                    compute_dtype="float32"),
+        replay=dataclasses.replace(cfg.replay, capacity=4096,
+                                   min_fill=200),
+        learner=dataclasses.replace(cfg.learner, batch_size=32),
+    )
+    rt = ApexRuntimeConfig(host_env="CartPole-v1", num_actors=2,
+                           envs_per_actor=4, total_env_steps=1200,
+                           inserts_per_grad_step=32, ingest_shards=2,
+                           device_sampling=True)
+    result = run_apex(cfg, rt, log_fn=lambda s: None)
+    assert result["sampler"] == "device"
+    assert result["env_steps"] >= 1200
+    assert result["grad_steps"] >= 10
+    assert result["ring_dropped"] == 0
+    assert set(result["records_by_shard"]) == {0, 1}
+    draws = result["device_calls"]["replay_sample"]
+    assert draws > 0 and draws % rt.ingest_shards == 0
