@@ -252,12 +252,15 @@ def main() -> int:
 
 
 def _learner_step_flops(jax, cfg, env, net):
-    """Op-census FLOPs of ONE learner grad step, compiled standalone.
+    """Op-census FLOPs of ONE learner grad step, lowered standalone.
 
     The fused chunk's census also counts env physics, acting and replay
     ops; the conventional MFU definition counts model fwd+bwd+optimizer
     only (ADVICE round 2) — so the ``mfu`` field is derived from this
-    compile, exactly the program benchmarks/learner_bench.py times.
+    program, exactly the one benchmarks/learner_bench.py times. The
+    census registers as ``fused.train_step`` in the chip-time
+    ProgramRegistry (ISSUE 19) so the caller can derive the
+    ``dqn_learner_mfu`` gauge the runtimes publish.
     """
     import numpy as np
 
@@ -292,9 +295,14 @@ def _learner_step_flops(jax, cfg, env, net):
                                 jax.numpy.float32),
         next_obs=obs(),
     )
-    compiled = jax.jit(train_step, donate_argnums=0).lower(
-        state, batch, jax.numpy.ones(B, jax.numpy.float32)).compile()
-    return flops_util.compiled_flops(compiled)
+    from dist_dqn_tpu.telemetry import devtime as _devtime
+
+    jitted = jax.jit(train_step, donate_argnums=0)
+    prog = _devtime.register_program(  # cost census of `jitted` above
+        "fused.train_step", loop="fused", role="train",
+        cost=lambda: jitted.lower(state, batch,
+                                  jax.numpy.ones(B, jax.numpy.float32)))
+    return prog.flops
 
 
 def _measure(jax, device, smoke: bool):
@@ -357,6 +365,12 @@ def _measure(jax, device, smoke: bool):
 
     carry = init(jax.random.PRNGKey(0))
     compiled = run.lower(carry, chunk).compile()
+    # Chip-time attribution (ISSUE 19): the measured program registers
+    # with its census so the BENCH row's `programs` block and the
+    # registry-derived mfu come from the same plane the runtimes use.
+    from dist_dqn_tpu.telemetry import devtime as _devtime
+    _prog_chunk = _devtime.register_program(  # census of `run`'s chunk
+        "fused.chunk", loop="fused", role="chunk", cost=compiled)
     for _ in range(2):  # warmup + fill past min_fill into steady state
         carry, metrics = compiled(carry)
         fence(metrics)
@@ -366,6 +380,8 @@ def _measure(jax, device, smoke: bool):
         carry, metrics = compiled(carry)
     fence(metrics)
     dt = time.perf_counter() - t0
+    _prog_chunk.count_dispatch(measure_chunks)
+    _prog_chunk.add_device_seconds(dt)
 
     value = measure_chunks * chunk * num_envs / dt
     extras = {"platform": device.platform,
@@ -430,22 +446,31 @@ def _measure(jax, device, smoke: bool):
     # count uses the last chunk's census — the cadence is deterministic in
     # steady state, so every measured chunk ran the same number (reading
     # each chunk's metric would insert a host fence into the timed loop).
+    # The gauge itself is registry-derived (ISSUE 19): the train-step
+    # census program gets the window's dispatches + wall and
+    # set_learner_mfu does the same division every runtime publishes.
     grad_steps = float(jax.device_get(metrics["grad_steps_in_chunk"])) \
         * measure_chunks
     train_flops = _learner_step_flops(jax, cfg, env, net)
+    _prog_train = _devtime.get_program_registry().get(
+        "fused.train_step", "fused")
+    if grad_steps:
+        _prog_train.count_dispatch(grad_steps)
+        _prog_train.add_device_seconds(dt)
     learner = flops_util.mfu_fields(train_flops, grad_steps, dt, device)
     if "model_flops_per_sec" in learner:
         extras["model_flops_per_sec"] = learner["model_flops_per_sec"]
         extras["learner_grad_steps_per_sec"] = round(grad_steps / dt, 2)
-    if "mfu" in learner:
-        extras["mfu"] = learner["mfu"]
-        reg.gauge(tmc.LEARNER_MFU,
-                  "achieved learner FLOP/s over chip bf16 peak",
-                  {"loop": "fused"}).set(learner["mfu"])
+    mfu_val = _devtime.set_learner_mfu("fused", device=device, reg=reg)
+    if mfu_val is not None:
+        extras["mfu"] = round(mfu_val, 4)
     if grad_steps:
         reg.gauge(tmc.LEARNER_GRAD_RATE,
                   "grad steps per second (measured window)",
                   {"loop": "fused"}).set(grad_steps / dt)
+    # Per-program chip-time census (ISSUE 19): flops/bytes/dispatches/
+    # device-seconds + arithmetic intensity for every registered program.
+    extras["programs"] = _devtime.programs_snapshot("fused")
     # Snapshot LAST so the embedded registry block carries the learner-
     # utilization gauges set above.
     extras["telemetry"] = telemetry.snapshot(reg)

@@ -93,6 +93,7 @@ def _r2d2_case(cfg):
 
 def bench_config(name: str, iters: int, cfg=None) -> dict:
     from dist_dqn_tpu.config import CONFIGS
+    from dist_dqn_tpu.telemetry import devtime as devtime_mod
     from dist_dqn_tpu.utils import flops as flops_util
 
     if cfg is None:
@@ -107,6 +108,15 @@ def bench_config(name: str, iters: int, cfg=None) -> dict:
     # recurrent configs (scanned time loop) the analytic R2D2 model is
     # the honest source instead.
     compiled = step.lower(state, *args).compile()
+    # Chip-time attribution (ISSUE 19): each config leg gets a fresh
+    # process registry so the row's `programs` block tallies this leg
+    # only. The census is `step`'s Compiled — for the recurrent configs
+    # it under-counts by the scan trip (see above); the analytic model
+    # stays the mfu source for those rows.
+    devtime_mod.reset_program_registry()
+    prog = devtime_mod.register_program(  # census of `step`'s Compiled
+        f"learner_bench.{name}", loop="learner_bench", role="train",
+        cost=compiled)
     if cfg.network.lstm_size:
         from dist_dqn_tpu import loop_common as _lc
         T = (cfg.replay.burn_in + cfg.replay.unroll_length
@@ -125,6 +135,8 @@ def bench_config(name: str, iters: int, cfg=None) -> dict:
         state, metrics = compiled(state, *args)
     jax.device_get(state.steps)    # fence: steps depends on every iteration
     dt = time.perf_counter() - t0
+    prog.count_dispatch(iters)
+    prog.add_device_seconds(dt)
     device = jax.devices()[0]
     from dist_dqn_tpu import loop_common
     train_batch = loop_common.resolve_train_batch(cfg)
@@ -139,6 +151,8 @@ def bench_config(name: str, iters: int, cfg=None) -> dict:
         "replay_ratio": loop_common.resolve_replay_ratio(cfg),
         "train_batch": train_batch,
         "actor_dtype": cfg.network.actor_dtype or "float32",
+        # Per-program chip-time census (ISSUE 19).
+        "programs": devtime_mod.programs_snapshot("learner_bench"),
     }
     out.update(flops_util.mfu_fields(flops_per_step, iters, dt, device))
     if not cfg.network.lstm_size:
@@ -269,6 +283,13 @@ def replay_ratio_sweep(iters: int, ratios=(1, 2, 4, 8),
         compiled = jax.jit(run_chunk, static_argnums=1,
                            donate_argnums=0).lower(carry,
                                                    chunk_iters).compile()
+        # Chip-time attribution (ISSUE 19): per-ratio leg registry so
+        # each row's `programs` block tallies that leg's chunk program.
+        from dist_dqn_tpu.telemetry import devtime as devtime_mod
+        devtime_mod.reset_program_registry()
+        _prog = devtime_mod.register_program(
+            "learner_bench.chunk", loop="learner_bench", role="train",
+            cost=compiled, execs_per_dispatch=ratio)
         # Aliasing audit (ISSUE 6): the scan carry must keep updating
         # in place at every ratio — an unintended copy would show here
         # before it shows as an OOM on the chip.
@@ -281,6 +302,8 @@ def replay_ratio_sweep(iters: int, ratios=(1, 2, 4, 8),
             carry, metrics = compiled(carry)
         g = float(jax.device_get(metrics["grad_steps_in_chunk"]))
         dt = time.perf_counter() - t0
+        _prog.count_dispatch(iters)
+        _prog.add_device_seconds(dt)
         rate = g * iters / dt
         row = {
             "replay_ratio": ratio,
@@ -293,6 +316,8 @@ def replay_ratio_sweep(iters: int, ratios=(1, 2, 4, 8),
             "platform": jax.devices()[0].platform,
             "aliased_pairs": audit.get("aliased_pairs"),
             "alias_bytes": audit.get("alias_bytes"),
+            # Per-program chip-time census (ISSUE 19).
+            "programs": devtime_mod.programs_snapshot("learner_bench"),
         }
         if base_rate is None:
             base_rate = rate

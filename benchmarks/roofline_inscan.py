@@ -80,6 +80,12 @@ def _measure_fused(cfg, train_every: int, chunk_iters: int, chunks: int):
     run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
     carry = init(jax.random.PRNGKey(0))
     compiled = run.lower(carry, chunk_iters).compile()
+    # Chip-time attribution (ISSUE 19): this tool reports its own
+    # roofline columns, so the registry entry is provenance only (no
+    # per-row `programs` block).
+    from dist_dqn_tpu.telemetry import devtime as devtime_mod
+    devtime_mod.register_program(  # census of `run`'s fused chunk
+        "roofline.chunk", loop="roofline", role="chunk", cost=compiled)
 
     def fence(metrics):
         return float(jax.device_get(metrics["loss"]))

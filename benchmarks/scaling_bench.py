@@ -62,11 +62,21 @@ def _parse_args():
 
 def _host_replay_leg(cfg, total, chunk_iters, dp):
     from dist_dqn_tpu.host_replay_loop import run_host_replay
+    from dist_dqn_tpu.telemetry import devtime as devtime_mod
 
+    # Chip-time attribution (ISSUE 19): fresh registry per leg so the
+    # re-emitted `programs`/`chip_time` blocks tally this leg only
+    # (the dp1 and dpN legs run in the same process).
+    devtime_mod.reset_program_registry()
     out = run_host_replay(cfg, total_env_steps=total,
                           chunk_iters=chunk_iters,
                           log_fn=lambda s: None, mesh_devices=dp)
     return {
+        # Per-program census + busy/idle decomposition from the run's
+        # summary (ISSUE 19): per-chip rows carry WHERE the chip time
+        # went, not just how much of it there was.
+        "programs": out["programs"],
+        "chip_time": out["chip_time"],
         "dp_size": out["dp_size"],
         "env_steps_per_sec": out["env_steps_per_sec"],
         "grad_steps_per_sec": out["grad_steps_per_sec"],

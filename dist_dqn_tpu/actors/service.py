@@ -224,6 +224,12 @@ class ApexRuntimeConfig:
     # metric/debug surface is unauthenticated); "0.0.0.0" exposes it to
     # scrapers outside the container/VM (--telemetry-host).
     telemetry_host: str = "127.0.0.1"
+    # --profile-dir (ISSUE 19 satellite): capture a jax.profiler trace
+    # of the FIRST train event (dispatch through priority materialize —
+    # the apex analogue of the fused loop's first post-warmup chunk)
+    # into this directory. For a window at an arbitrary point of a live
+    # run, hit /debug/profile?seconds=N on the telemetry server instead.
+    profile_dir: Optional[str] = None
 
 
 class ApexLearnerService:
@@ -570,6 +576,31 @@ class ApexLearnerService:
                    "(multi-host batches shard from learner.batch_size); "
                    "ignored")
             self.train_batch = cfg.learner.batch_size
+        # Chip-time attribution (ISSUE 19): every device-call kind the
+        # service dispatches gets a ProgramRegistry row (created lazily
+        # in _count_device_call, so only the kinds this configuration
+        # actually runs appear). The train program registers eagerly —
+        # it carries role="train" (the registry-derived MFU numerator)
+        # and its cost is harvested at the first dispatch.
+        from dist_dqn_tpu.telemetry import devtime as _devtime
+        self._devtime = _devtime
+        self._prog_train = _devtime.register_program(
+            "apex.train_scan" if self._train_scan is not None
+            else "apex.train_step", loop="apex", role="train",
+            execs_per_dispatch=(self.replay_ratio
+                                if self._train_scan is not None else 1))
+        self._prog_by_kind: Dict[str, object] = {"train": self._prog_train}
+        # Retirement-interval anchor for the train program's device-
+        # seconds attribution (see _finalize_train).
+        self._devtime_anchor = time.perf_counter()
+        self._ledger = _devtime.UtilizationLedger("apex")
+        self._ledger_busy_seen = 0.0
+        self._ledger_t_last = time.perf_counter()
+        # --profile-dir (ISSUE 19 satellite): one-shot trace of the
+        # first train event, started at its first dispatch and stopped
+        # at its first retirement fence (_finalize_train).
+        self._profile_tracer = _devtime.maybe_trace_first_chunk(
+            rt.profile_dir)
         # The apex actors pull the live learner params for acting; the
         # once-per-chunk bf16 snapshot the fused/host-replay loops cast
         # has no natural boundary here yet — say so, act in fp32.
@@ -878,8 +909,26 @@ class ApexLearnerService:
                 labels={"call": kind})
             self._tm_device_calls[kind] = c
         c.inc()
+        # ProgramRegistry dispatch tally (ISSUE 19): one registry row
+        # per device-call kind (act / fused / bootstrap / ...; "train"
+        # pre-registered with role="train" in __init__).
+        prog = self._prog_by_kind.get(kind)
+        if prog is None:
+            prog = self._devtime.register_program(f"apex.{kind}",
+                                                  loop="apex", role=kind)
+            self._prog_by_kind[kind] = prog
+        prog.count_dispatch()
         if rows is not None:
             self._tm_fanin.observe(float(rows))
+
+    def _attach_train_cost(self, fn, *args) -> None:
+        """One-shot FLOPs/bytes harvest for the train program at its
+        first dispatch (trace-only fn.lower — no second compile; the
+        wrapped mesh/multi-host steps have no .lower and degrade to
+        cost-absent, exactly once)."""
+        if not self._prog_train.cost_attached:
+            st = self.state
+            self._prog_train.attach_cost(lambda: fn.lower(st, *args))
 
     def _step_specs(self, axis: str):
         """(data_specs, metric_specs) PartitionSpecs for the train step:
@@ -1895,6 +1944,7 @@ class ApexLearnerService:
                    + (1 - cfg.replay.importance_exponent)
                    * progress_steps / max(self.rt.total_env_steps, 1))
         while self.grad_steps < target_grad_steps:
+            self._profile_tracer.start()
             if self._train_scan is not None:
                 # Replay-ratio scan path (ISSUE 6): one dispatch runs N
                 # sub-steps over independently-drawn stacked batches.
@@ -1904,6 +1954,7 @@ class ApexLearnerService:
                     if len(self._stager) == 0:
                         self._stage_scan_batch(batch_size, beta)
                     args, (idx, gen) = self._stager.pop()
+                    self._attach_train_cost(self._train_scan, *args)
                     with self.tracer.span("train_step.dispatch",
                                           substeps=self.replay_ratio):
                         self.state, metrics = self._train_scan(self.state,
@@ -1916,6 +1967,7 @@ class ApexLearnerService:
                     args, (idx, gen) = self._sample_scan_args(batch_size,
                                                               beta)
                     args = self.jax.tree.map(jnp.asarray, args)
+                    self._attach_train_cost(self._train_scan, *args)
                     with self.tracer.span("train_step.dispatch",
                                           substeps=self.replay_ratio):
                         self.state, metrics = self._train_scan(self.state,
@@ -1937,6 +1989,7 @@ class ApexLearnerService:
                 if len(self._stager) == 0:
                     self._stage_batch(batch_size, beta)
                 args, (idx, gen) = self._stager.pop()
+                self._attach_train_cost(self._train_step, *args)
                 with self.tracer.span("train_step.dispatch"):
                     self.state, metrics = self._train_step(self.state,
                                                            *args)
@@ -1950,6 +2003,7 @@ class ApexLearnerService:
                 with self.tracer.span("train_step.dispatch"):
                     if self.recurrent:
                         sample = self._sequence_sample(items, weights)
+                        self._attach_train_cost(self._train_step, sample)
                         self.state, metrics = self._train_step(self.state,
                                                                sample)
                     else:
@@ -1960,8 +2014,11 @@ class ApexLearnerService:
                             reward=jnp.asarray(items["reward"]),
                             discount=jnp.asarray(items["discount"]),
                             next_obs=jnp.asarray(items["next_obs"]))
+                        w_dev = jnp.asarray(weights)
+                        self._attach_train_cost(self._train_step,
+                                                batch, w_dev)
                         self.state, metrics = self._train_step(
-                            self.state, batch, jnp.asarray(weights))
+                            self.state, batch, w_dev)
                 self._count_device_call("train")
             self.grad_steps += 1
             self._tm_grad_steps.inc()
@@ -1971,6 +2028,19 @@ class ApexLearnerService:
             # has had the longest to finish, so this rarely blocks.
             while len(self._in_flight) > self.rt.pipeline_depth:
                 self._finalize_train()
+
+    def _flush_ledger_window(self):
+        """Close the current utilization-ledger window: wall since the
+        last flush against the train program's device-seconds delta.
+        The apex loop has no chunk boundary, so the log cadence (and a
+        final flush before the summary) is its decomposition unit;
+        unattributed wall lands in the `other` bucket."""
+        now = time.perf_counter()
+        busy_total = self._prog_train.device_seconds
+        self._ledger.observe_chunk(now - self._ledger_t_last,
+                                   busy_total - self._ledger_busy_seen)
+        self._ledger_t_last = now
+        self._ledger_busy_seen = busy_total
 
     def _finalize_train(self):
         """Materialize the oldest in-flight step's priorities and queue
@@ -1988,7 +2058,19 @@ class ApexLearnerService:
         # device finished this step, so this IS the grad-step round-trip
         # (pipelining means it includes up to pipeline_depth-1 queued
         # steps — the operationally honest number for the host loop).
-        self._tm_grad_latency.observe(time.perf_counter() - t_dispatch)
+        t_retire = time.perf_counter()
+        self._tm_grad_latency.observe(t_retire - t_dispatch)
+        # Device-seconds attribution (ISSUE 19), at this fence the loop
+        # already holds: the wall from max(dispatch, previous
+        # retirement) to now is the interval this step occupied the
+        # device queue — overlapping in-flight steps never double-
+        # count. An upper-bound estimate (queue-occupied, not
+        # kernel-active), same spirit as the grad latency above.
+        self._prog_train.add_device_seconds(
+            t_retire - max(t_dispatch, self._devtime_anchor))
+        self._devtime_anchor = t_retire
+        if self._profile_tracer.stop():
+            print(f"# profile_trace {self.rt.profile_dir}")
         self._last_loss = float(metrics["loss"])
         # Divergence sentinel (ISSUE 4): every retired step's loss and
         # grad norm — NaN/Inf dumps a forensics bundle once instead of
@@ -2381,6 +2463,16 @@ class ApexLearnerService:
                     self._tm_ring_pending.set(self.req_ring.pending_bytes)
                     self._tm_record_age.set(now - self._last_record)
                     self._sweep_dedup_counters()
+                    # Chip-time plane sweep (ISSUE 19), once per log
+                    # period: ledger the window's wall against the
+                    # train program's device-seconds delta (the apex
+                    # loop has no chunk boundary — the log window is
+                    # its decomposition unit; unattributed wall lands
+                    # in the `other` bucket), refresh the registry-
+                    # derived MFU, and sweep device memory stats.
+                    self._flush_ledger_window()
+                    self._devtime.set_learner_mfu("apex")
+                    self._devtime.sweep_device_memory()
                     self.tracer.counter("replay_size", len(self.replay))
                     self.tracer.counter("env_steps", self.env_steps)
                     self.tracer.flush()
@@ -2417,6 +2509,7 @@ class ApexLearnerService:
             self.tracer.close()
             self.shutdown()
         dedup_frames, dedup_saved = self._dedup_totals()
+        self._flush_ledger_window()
         return {"env_steps": self.env_steps, "grad_steps": self.grad_steps,
                 # Zero-copy ingest provenance (ISSUE 9): which transport
                 # carried the run, what it cost on the wire, and where
@@ -2468,6 +2561,10 @@ class ApexLearnerService:
                 # count means the learner is not keeping up with actors.
                 "tcp_backpressure": (self.tcp_server.backpressure_events
                                      if self.tcp_server else 0),
+                # Chip-time attribution plane (ISSUE 19): per-program
+                # cost census + the busy/idle decomposition of wall time.
+                "chip_time": self._ledger.snapshot(),
+                "programs": self._devtime.programs_snapshot("apex"),
                 "bad_records": self.bad_records,
                 "actor_restarts": self.actor_restarts}
 

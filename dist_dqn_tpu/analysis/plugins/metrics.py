@@ -81,8 +81,11 @@ ALLOWLIST = {
     # +2 at ISSUE 8: the resumed_at_frames and per-save checkpoint
     # announcement lines (run-lifecycle output contracts, mirroring
     # train.py's resume line; the chaos/crash metrics go through the
-    # registry).
-    "dist_dqn_tpu/host_replay_loop.py": 3,
+    # registry). +1 at ISSUE 19: the one-shot profile_trace
+    # announcement after the --profile-dir first-chunk capture lands
+    # (a path, not a metric; chip-time metrics go through the
+    # registry's dqn_program_*/dqn_chip_* families).
+    "dist_dqn_tpu/host_replay_loop.py": 4,
     # ISSUE 7: the serving CLI's startup announcements (serving_port +
     # optional telemetry_port) — output contracts like train.py's; act
     # metrics go through the registry. +1 at ISSUE 8: the shutdown

@@ -1,0 +1,169 @@
+"""Check ``program_registry``: every jitted train/collect entry point
+must register in the chip-time ProgramRegistry — or carry a
+``devtime:`` rationale comment.
+
+ISSUE 19's attribution plane (telemetry/devtime.py) only answers "where
+did the chip-time go" if every program that can occupy the device shows
+up in its census. The runtime cannot notice an unregistered program —
+its device-seconds simply land in the ledger's ``other`` bucket and the
+MFU denominator silently under-counts. This lint is the static guard:
+the same TARGET vocabulary the donation check uses to recognise
+learner/collector entry points, but the obligation here is a
+``register_program``/``attach_cost`` wiring instead of
+``donate_argnums``.
+
+AST-based: any ``jax.jit(...)`` call (or ``partial(jax.jit, ...)``,
+or the decorator spellings) whose jitted expression mentions
+``train``/``collect``/``chunk``/``shard``/``snapshot``/``lane`` must
+either
+
+* bind to a name that later appears in the same file on a line that
+  wires the registry (``register_program``, ``devtime.``,
+  ``attach_cost``/``attach_*_cost``, ``.register(``), or
+* be preceded (within two lines, or on the same line) by a comment
+  containing ``devtime:`` stating why it is out of census scope
+  (e.g. a trace-only helper, a test fixture, a per-call throwaway).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import List, Tuple
+
+from dist_dqn_tpu.analysis.core import AnalysisContext, Check, Finding
+from dist_dqn_tpu.analysis.plugins.donation import (TARGET, _is_jit_call,
+                                                    _jitted_expr_text)
+from dist_dqn_tpu.analysis.registry import register
+
+SCAN_ROOTS = ("dist_dqn_tpu", "benchmarks", "bench.py")
+
+#: Rationale escape hatch: a nearby comment owning the decision.
+RATIONALE = re.compile(r"#.*devtime:")
+
+#: A line that wires a program into the registry. ``attach_\w*cost``
+#: also matches helper wrappers like ``_attach_train_cost(...)``.
+REG_LINE = re.compile(
+    r"register_program|devtime\.|attach_\w*cost|\.register\(")
+
+
+def _has_rationale(lines, lineno: int) -> bool:
+    """A ``devtime:`` comment on the call line or the two above it."""
+    lo = max(lineno - 3, 0)
+    return any(RATIONALE.search(ln) for ln in lines[lo:lineno])
+
+
+def _bound_names(tree: ast.AST, call: ast.Call) -> List[str]:
+    """Names the jit result is bound to: assignment targets (including
+    the terminal attribute of ``self.x = ...``). The call may be nested
+    inside the assigned value (``x = jit(f).lower(...).compile()``) —
+    the bound artifact still carries the program's census."""
+
+    def _contains(value: ast.AST) -> bool:
+        return any(n is call for n in ast.walk(value))
+
+    names: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _contains(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.append(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    names.append(tgt.attr)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _contains(node.value):
+            tgt = node.target
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                names.append(tgt.attr)
+    return names
+
+
+def _is_registered(lines, names: List[str]) -> bool:
+    """True when any bound name appears anywhere in the file on (or
+    within two lines below — wrapped call arguments) a line that wires
+    the ProgramRegistry."""
+    pats = [re.compile(rf"\b{re.escape(n)}\b") for n in names if n]
+    if not pats:
+        return False
+    for i, ln in enumerate(lines):
+        if not REG_LINE.search(ln):
+            continue
+        window = "\n".join(lines[i:i + 3])
+        if any(p.search(window) for p in pats):
+            return True
+    return False
+
+
+def scan(repo_root: Path, ctx: AnalysisContext = None
+         ) -> List[Tuple[str, int, str]]:
+    """[(relpath, lineno, jitted expr), ...] for violating sites.
+    Pass the run's shared ``ctx`` to reuse its parse cache."""
+    if ctx is None:
+        ctx = AnalysisContext(Path(repo_root))
+    failures: List[Tuple[str, int, str]] = []
+    for rel in ctx.iter_py_files(SCAN_ROOTS):
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError as e:
+            failures.append((rel, e.lineno or 0, "<unparseable>"))
+            continue
+        lines = ctx.source(rel).splitlines()
+        decorator_calls = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                    decorator_calls.add(id(dec))
+                elif not (isinstance(dec, ast.Attribute)
+                          and dec.attr == "jit"):
+                    continue
+                if not TARGET.search(node.name):
+                    continue
+                if _has_rationale(lines, dec.lineno):
+                    continue
+                if _is_registered(lines, [node.name]):
+                    continue
+                failures.append((rel, dec.lineno, node.name))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_jit_call(node)) \
+                    or id(node) in decorator_calls:
+                continue
+            expr = _jitted_expr_text(node)
+            if not TARGET.search(expr):
+                continue
+            if _has_rationale(lines, node.lineno):
+                continue
+            if _is_registered(lines, _bound_names(tree, node)):
+                continue
+            failures.append((rel, node.lineno, expr.split("\n")[0]))
+    return failures
+
+
+class ProgramRegistryCheck(Check):
+    name = "program_registry"
+    description = ("every jitted train/collect entry point registers in "
+                   "the chip-time ProgramRegistry or carries a "
+                   "'# devtime:' rationale (attribution-census guard)")
+    rationale_tag = "devtime:"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings = []
+        for rel, lineno, expr in scan(ctx.root, ctx=ctx):
+            findings.append(self.finding(
+                rel, lineno,
+                f"jax.jit({expr!r}) is a train/collect entry point "
+                "that never registers in the ProgramRegistry — wire "
+                "telemetry.register_program(...).attach_cost(...) so "
+                "its chip-time is attributable, or add a '# devtime: "
+                "<why out of scope>' rationale comment "
+                "(docs/observability.md, chip-time attribution)",
+                key=f"jit:{rel}:{expr[:60]}"))
+        return findings
+
+
+register(ProgramRegistryCheck())

@@ -230,7 +230,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     save_every_frames: int = 0,
                     mesh_devices: int = 1,
                     sharded_collect: Optional[bool] = None,
-                    device_sampling: bool = False):
+                    device_sampling: bool = False,
+                    profile_dir: Optional[str] = None):
     """Run the hybrid loop; returns a summary dict.
 
     Cadence matches the fused loop: one train event every
@@ -418,6 +419,18 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     collect_jit = jax.jit(collect, static_argnums=2, donate_argnums=0)
     init_learner, train_step = make_learner(
         net, cfg.learner, axis_name="dp" if mesh_mode else None)
+    # Chip-time attribution (ISSUE 19): both hot programs register in
+    # the process ProgramRegistry; cost is harvested at the first
+    # dispatch (trace-only lowering against the live args — no second
+    # XLA compile) and device-seconds at the fences the loop already
+    # holds. The collect program is deliberately left without device
+    # time in pipeline mode — it overlaps evac+train by design and
+    # fencing it would be a new hot-path sync.
+    from dist_dqn_tpu.telemetry import devtime as _devtime
+    _prog_collect = _devtime.register_program(
+        "host_replay.collect", loop="host_replay", role="collect")
+    _prog_train = _devtime.register_program(
+        "host_replay.train_step", loop="host_replay", role="train")
     mesh = mesh_devs = weights_sharding = None
     if not mesh_mode:
         train_jit = jax.jit(train_step, donate_argnums=0)
@@ -436,6 +449,19 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                                             data_specs, metric_specs)
         weights_sharding = NamedSharding(mesh, P("dp"))
         repl_sharding = NamedSharding(mesh, P())
+
+    def _train_dispatch(state, batch, w):
+        """Every train-step launch goes through here so the registry
+        sees one dispatch count per grad step and the cost analysis is
+        harvested exactly once, at the first launch (when real args
+        exist). The mesh train step is a shard_map wrapper without
+        .lower — attach_cost degrades to flops=None there, one shot."""
+        if not _prog_train.cost_attached:
+            _prog_train.attach_cost(
+                lambda: train_jit.lower(state, batch, w))
+        _prog_train.count_dispatch()
+        return train_jit(state, batch, w)
+
     # Replay-ratio engine (ISSUE 6): multiplies the grad steps each
     # train event runs — the SamplePrefetcher simply draws that many
     # batches ahead, so the ratio rides the existing sample pipeline.
@@ -471,8 +497,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         # copy (never an alias of the live params) is what lets the
         # donated train step overwrite its state while the async shard
         # collects are still reading the snapshot.
-        # donation: the snapshot must COPY — the learner still owns
-        # (and the train step donates) the params tree it reads.
+        # donation: the snapshot must COPY (the learner still owns the
+        # params the train step donates); devtime: one cast per chunk.
         @jax.jit
         def snapshot_collect_params(params):
             params = _cast_actor(params) if _actor_split else params
@@ -806,6 +832,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
     g_grad_rate = reg.gauge(tmc.LEARNER_GRAD_RATE,
                             "grad steps per second (whole loop)",
                             _labels)
+    # Utilization ledger (ISSUE 19): per-chunk wall decomposed into
+    # device-busy (train section minus its host-blocked share) and the
+    # named idle buckets — evac_fence is the publication-fence wait,
+    # prefetch_wait/sample the sample-side blocking, everything else
+    # (dispatch enqueues, stat fetches, logging) lands in `other`.
+    _ledger = _devtime.UtilizationLedger("host_replay", reg)
     # Sharded-collect surface (ISSUE 15): the lane block each shard's
     # own collect acts over, and the per-shard dispatch enqueue wall
     # (async dispatch — growth means that shard's device queue is full,
@@ -854,10 +886,15 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                             "host_replay.collect", cev.fault)
                     chaos.sleep_for(cev)
                     stalled = True
+                if not _prog_collect.cost_attached:
+                    _c, _v = carries[s], views[s]
+                    _prog_collect.attach_cost(
+                        lambda: collect_jit.lower(_c, _v, chunk_iters))
                 t_d = time.perf_counter()
                 carries[s], r, st = collect_jit(carries[s], views[s],
                                                 chunk_iters)
                 dt = time.perf_counter() - t_d
+                _prog_collect.count_dispatch()
                 h_collect_disp[s].observe(dt)
                 collect_dispatch_s_total += dt
                 hb_collects[s].beat()
@@ -1447,10 +1484,21 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         nonlocal carry
         if mesh_mode:
             return dispatch_collect(state)
+        if not _prog_collect.cost_attached:
+            _c, _p = carry, collect_params(state)
+            _prog_collect.attach_cost(
+                lambda: collect_jit.lower(_c, _p, chunk_iters))
         carry, r, st = collect_jit(carry, collect_params(state),
                                    chunk_iters)
+        _prog_collect.count_dispatch()
         return r, st
 
+    # --profile-dir (ISSUE 19 satellite): same contract as the fused
+    # loop — trace the first post-warmup chunk (chunk 1; a run that is
+    # all one chunk traces that one) into the given directory.
+    _tracer = _devtime.maybe_trace_first_chunk(profile_dir)
+    _profile_chunk = (min(start_chunk + 1, num_chunks - 1)
+                      if profile_dir else -1)
     try:
         if num_chunks and not resumed:
             # Chunk 0: prologue dispatch + evacuation submit.
@@ -1485,6 +1533,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             else:
                 records = resume_pending
         for g in range(start_chunk, num_chunks):
+            if g == _profile_chunk:
+                _tracer.start()
             t0 = time.perf_counter()
             next_records = next_stats = None
             if pipeline:
@@ -1628,7 +1678,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                             batch = assemble_tree(parts)
                             w = (assemble_tree(w_parts)
                                  if per_samplers is not None else weights)
-                            state, metrics = train_jit(state, batch, w)
+                            state, metrics = _train_dispatch(state, batch, w)
                             _wb_add(auxes, metrics)
                         for s, p in enumerate(prefetchers):
                             ev_sample_s += p.sample_s_total - s0[s][0]
@@ -1656,7 +1706,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                             batch = assemble_tree(parts)
                             w = (assemble_tree(w_parts)
                                  if per_samplers is not None else weights)
-                            state, metrics = train_jit(state, batch, w)
+                            state, metrics = _train_dispatch(state, batch, w)
                             _wb_add(auxes, metrics)
                     did = grads_this_chunk
                     grad_steps += did
@@ -1692,7 +1742,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                             dev, aux = prefetcher.pop(fence_gen)
                             ev_depth_sum += len(prefetcher)
                             batch, w = _unpack(dev)
-                            state, metrics = train_jit(state, batch, w)
+                            state, metrics = _train_dispatch(state, batch, w)
                             _wb_add(aux, metrics)
                         ev_sample_s = prefetcher.sample_s_total - s0[0]
                         ev_wait_s = prefetcher.wait_s_total - s0[1]
@@ -1711,7 +1761,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                         for i in range(grads_this_chunk):
                             dev, aux = stager.pop()
                             batch, w = _unpack(dev)
-                            state, metrics = train_jit(state, batch, w)
+                            state, metrics = _train_dispatch(state, batch, w)
                             _wb_add(aux, metrics)
                             if i + 1 < grads_this_chunk:
                                 t_s = time.perf_counter()
@@ -1730,7 +1780,7 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                         sample_k += 1
                         for i in range(grads_this_chunk):
                             batch, w = _unpack(dev)
-                            state, metrics = train_jit(state, batch, w)
+                            state, metrics = _train_dispatch(state, batch, w)
                             _wb_add(aux, metrics)
                             if i + 1 < grads_this_chunk:
                                 t_s = time.perf_counter()
@@ -1777,6 +1827,30 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             t_stats = time.perf_counter()
             ep = float(cr) / max(float(cc), 1.0)
 
+            # Chip-time attribution (ISSUE 19), all from timestamps the
+            # loop already took. The train section (t_fence -> t_train)
+            # ends at a real fence (block_until_ready above), so minus
+            # its host-blocked share it is the chunk's measured train
+            # device time; `sample` only blocks when no prefetcher runs
+            # (with one, the blocking share is prefetch_wait).
+            _prefetching = (prefetcher is not None
+                            or prefetchers is not None)
+            sample_blocked = 0.0 if _prefetching else ev_sample_s
+            train_busy = max((t_train - t_fence) - sample_blocked
+                             - ev_wait_s, 0.0)
+            if did:
+                _prog_train.add_device_seconds(train_busy)
+            if not pipeline and t_evac_parts is not None:
+                # Serial reference: the monolithic blocking fetch waits
+                # out the collect program — the one place its device
+                # time is fenced and attributable.
+                _prog_collect.add_device_seconds(t_evac_parts[0])
+            chip = _ledger.observe_chunk(
+                t_stats - t0, train_busy, sample=sample_blocked,
+                evac_fence=fence_wait_s, prefetch_wait=ev_wait_s)
+            _devtime.set_learner_mfu("host_replay", reg=reg)
+            _devtime.sweep_device_memory(reg)
+
             row = {
                 "env_frames": env_steps, "grad_steps": grad_steps,
                 "episode_return": round(ep, 3),
@@ -1809,6 +1883,12 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                 # thread; prefetch_depth the mean batches staged ahead
                 # at pop time; stale_batches the generation-fence drops.
                 "sample_s": round(ev_sample_s, 4),
+                # Ledger view of this chunk (ISSUE 19): measured device-
+                # busy and the derived unattributed host residual; the
+                # cumulative per-cause series is
+                # dqn_chip_idle_seconds_total{loop="host_replay"}.
+                "chip_busy_s": round(chip["busy"], 4),
+                "idle_other_s": round(chip["other"], 4),
                 "prefetch_wait_s": round(ev_wait_s, 4),
                 "prefetch_depth": round(ev_depth_sum / (did * dp), 2)
                 if did else 0.0,
@@ -1833,6 +1913,8 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                                                step=grad_steps)
             history.append(row)
             log_fn(json.dumps(row))
+            if g == _profile_chunk and _tracer.stop():
+                log_fn(json.dumps({"profile_trace": profile_dir}))
             if ckpt is not None and env_steps >= next_save:
                 next_save = env_steps + save_period
                 _save_checkpoint(g)
@@ -1959,5 +2041,10 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
         "is_weight_mean": round(is_w_sum / is_w_count, 6)
         if is_w_count else 1.0,
         "is_weight_min": round(is_w_min, 6) if is_w_count else 1.0,
+        # Chip-time attribution (ISSUE 19): cumulative ledger buckets
+        # and the per-program registry rows this run produced — what
+        # scaling_bench re-emits as its `programs` block.
+        "chip_time": _ledger.snapshot(),
+        "programs": _devtime.programs_snapshot("host_replay"),
         "history": history,
     }

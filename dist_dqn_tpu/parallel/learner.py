@@ -95,8 +95,8 @@ def _mesh_wrap(mesh: Mesh, specs, init_local, run_local):
     """Lift per-device (init, run_chunk) bodies to jit-compiled functions on
     GLOBAL arrays; the carry is donated so replay shards update in place in
     each device's HBM."""
-    # donation: init consumes only a PRNG key (run() donates the carry).
-    # mesh-axis: specs name the dp axis (built by the _carry_specs family).
+    # donation: PRNG-key-only init (run() donates the carry); devtime:
+    # one-shot, not hot-path. mesh-axis: dp specs via _carry_specs.
     init = jax.jit(
         compat.shard_map(init_local, mesh=mesh, in_specs=P(),
                          out_specs=specs, check_vma=False))
@@ -239,6 +239,8 @@ def make_sharded_train_step(train_step, mesh: Mesh, data_specs,
             out_specs=(state_spec, metric_specs), check_vma=False)
         return body(state, *data)
 
+    # devtime: registered by the callers that own the dispatch fence —
+    # apex service `_attach_train_cost` / host-replay `_train_dispatch`.
     return jax.jit(sharded, donate_argnums=0)
 
 

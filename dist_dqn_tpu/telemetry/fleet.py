@@ -30,7 +30,10 @@ run ONE pane:
     degradation. ``/fleet/forensics`` pulls ``/debug/flight`` +
     ``/debug/stacks`` from every live member into one correlated
     bundle — the first step of the hang runbook
-    (docs/observability.md).
+    (docs/observability.md). ``/fleet/profile?seconds=N`` (ISSUE 19)
+    fans ``/debug/profile`` out to every live member in parallel for
+    one correlated cross-fleet xprof window; dead members are labeled,
+    never fatal.
 
 Descriptor hygiene: a registration REFUSES (raises
 ``FleetRegistrationError``) when a live descriptor already claims the
@@ -62,6 +65,7 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 from typing import Dict, List, Optional
 
 from dist_dqn_tpu.telemetry import lifecycle
@@ -558,6 +562,62 @@ class FleetAggregator:
             bundle["members"][m.name] = entry
         return bundle
 
+    def profile(self, seconds: float = 1.0) -> Dict:
+        """The ``/fleet/profile`` bundle (ISSUE 19): fan
+        ``/debug/profile?seconds=N`` out to every LIVE member IN
+        PARALLEL, so the per-process jax.profiler windows overlap and
+        the traces correlate into one cross-fleet xprof view. Stale/
+        dead members appear by name with their state — a capture with
+        a dead actor still succeeds and still says who was missing.
+        Each member entry is that member's own capture result JSON
+        (trace_dir on its host, or its error)."""
+        try:
+            seconds = max(0.0, float(seconds))
+        except (TypeError, ValueError):
+            seconds = 1.0
+        bundle: Dict = {"generated_unix": time.time(),
+                        "seconds": seconds, "members": {}}
+        with self._lock:
+            members = list(self.members.values())
+        live = [m for m in members if m.state == "live"]
+        for m in members:
+            if m.state != "live":
+                bundle["members"][m.name] = {"state": m.state}
+
+        def _capture(member: _Member) -> None:
+            url = (member.base_url
+                   + f"/debug/profile?seconds={seconds:g}")
+            # The member holds its trace window open for `seconds`
+            # before answering — the scrape timeout alone would kill
+            # every non-trivial capture.
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=seconds
+                        + self.scrape_timeout_s) as resp:
+                    body = resp.read()
+            except urllib.error.HTTPError as e:  # 409 busy carries JSON
+                body = e.read()
+            except Exception:  # noqa: BLE001 — connection-level failure
+                bundle["members"][member.name] = {
+                    "state": "live", "error": "capture request failed"}
+                return
+            entry: Dict = {"state": "live",
+                           "role": member.desc.get("role")}
+            try:
+                entry.update(json.loads(body.decode()))
+            except ValueError:
+                entry["error"] = "unparseable capture response"
+            bundle["members"][member.name] = entry
+
+        threads = [threading.Thread(target=_capture, args=(m,),
+                                    name=f"fleet-profile-{m.name}",
+                                    daemon=True) for m in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 2 * self.scrape_timeout_s + 5.0)
+        return bundle
+
     # -- lifecycle ----------------------------------------------------
 
     def start(self) -> None:
@@ -584,8 +644,9 @@ class FleetAggregator:
 
 class FleetServer:
     """HTTP face of the aggregator: ``/metrics`` (merged exposition),
-    ``/fleet/status``, ``/fleet/forensics``, ``/healthz``. Same stdlib
-    ThreadingHTTPServer-on-a-daemon-thread shape as TelemetryServer."""
+    ``/fleet/status``, ``/fleet/forensics``, ``/fleet/profile``,
+    ``/healthz``. Same stdlib ThreadingHTTPServer-on-a-daemon-thread
+    shape as TelemetryServer."""
 
     def __init__(self, aggregator: FleetAggregator, port: int = 0,
                  host: str = "127.0.0.1"):
@@ -605,6 +666,12 @@ class FleetServer:
                 elif path == "/fleet/forensics":
                     body = (json.dumps(agg.forensics(), sort_keys=True)
                             + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/fleet/profile":
+                    qs = parse_qs(urlsplit(self.path).query)
+                    seconds = (qs.get("seconds") or ["1"])[0]
+                    body = (json.dumps(agg.profile(seconds),
+                                       sort_keys=True) + "\n").encode()
                     ctype = "application/json"
                 elif path == "/healthz":
                     body, ctype = b"ok\n", "text/plain"

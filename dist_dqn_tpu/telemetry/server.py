@@ -17,6 +17,13 @@ daemon thread.
   * ``/debug/flight`` — the flight recorder's event tail as JSON
   * ``/debug/config`` — the run manifest (git sha, versions, config
     hash/dict, argv; telemetry/manifest.py) of this process
+  * ``/debug/profile?seconds=N`` — ON-DEMAND PROFILING (ISSUE 19):
+    capture a jax.profiler trace of the next N seconds (clamped to
+    ``devtime.PROFILE_MAX_SECONDS``) into the forensics dir and return
+    the trace directory as JSON — an xprof window is one HTTP call
+    instead of a restart. 409 while another capture is running; JSON
+    ``error`` (status 200) on jax-free processes so fleet fan-out can
+    label rather than fail.
 
 The handler renders under the registry's own locks, so a scrape never
 blocks the training hot path for more than an instrument read. Loopback
@@ -29,7 +36,9 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
+from dist_dqn_tpu.telemetry import devtime as devtime_mod
 from dist_dqn_tpu.telemetry import flight as flight_mod
 from dist_dqn_tpu.telemetry import manifest as manifest_mod
 from dist_dqn_tpu.telemetry import watchdog as watchdog_mod
@@ -83,6 +92,15 @@ class TelemetryServer:
                     man = manifest_mod.get_run_manifest()
                     body = (json.dumps(man if man is not None else {},
                                        sort_keys=True) + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/debug/profile":
+                    qs = parse_qs(urlsplit(self.path).query)
+                    seconds = (qs.get("seconds") or ["1"])[0]
+                    result = devtime_mod.capture_profile(seconds)
+                    if result.get("error") == "busy":
+                        status = 409
+                    body = (json.dumps(result, sort_keys=True)
+                            + "\n").encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

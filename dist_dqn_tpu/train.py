@@ -179,6 +179,15 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                                           num_episodes=cfg.eval_episodes))
     if not use_mesh:
         run = jax.jit(run_chunk, static_argnums=1, donate_argnums=0)
+    # Chip-time attribution (ISSUE 19): the fused chunk is ONE program —
+    # acting, replay and the grad scan fused into a single dispatch — so
+    # it registers with role="train" and execs_per_dispatch=1 (the XLA
+    # cost census already spans the whole chunk body, scan-once caveat
+    # noted in telemetry/devtime.py). Cost is harvested at the first
+    # dispatch below via run.lower(...) — trace-only, no second compile.
+    _prog_chunk = telemetry.register_program(
+        "fused.chunk", loop="fused", role="train")
+    _ledger = telemetry.UtilizationLedger("fused", _reg)
 
     # Eval-path choice, decided once: multi-process runs eval only on the
     # logging process, from the host copy of the replicated params (the
@@ -272,6 +281,7 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     # eval_every_steps); otherwise the first chunk gets a baseline eval.
     next_eval = frames if cfg.eval_every_steps else float("inf")
     chunk_index = 0
+    _t_prev_fence = None  # previous chunk's fence, for the ledger wall
     # Trace the second chunk (the first is compile+warmup noise) — unless
     # the whole run fits in one chunk, then trace that one rather than none.
     profile_chunk = 1 if total > frames + chunk_iters * B else 0
@@ -281,10 +291,20 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
                          and chunk_index == profile_chunk)
             if profiling:
                 jax.profiler.start_trace(profile_dir)
+            if not _prog_chunk.cost_attached:
+                # Trace-only lowering against the live args; shares no
+                # state with the jit cache, so the dispatch below still
+                # hits the already-compiled executable.
+                _c, _ci = carry, chunk_iters
+                _prog_chunk.attach_cost(lambda: run.lower(_c, _ci))
             t0 = time.perf_counter()
             carry, metrics = run(carry, chunk_iters)
             metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
             dt = time.perf_counter() - t0
+            _prog_chunk.count_dispatch()
+            # The device_get above IS the chunk fence: dt bounds the
+            # program's device time (one fused program fills the chunk).
+            _prog_chunk.add_device_seconds(dt)
             if profiling:
                 jax.profiler.stop_trace()
                 log_fn(json.dumps({"profile_trace": profile_dir}))
@@ -324,6 +344,18 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
             # host-replay runtimes observe per sampled record.
             _lineage.on_chunk(_tm["grad_steps"].value,
                               max(1, ring_slots // chunk_iters))
+            # Utilization ledger (ISSUE 19): the fused loop's wall is
+            # the dispatch-to-fence dt (device busy, one program) plus
+            # whatever host bookkeeping separated it from the previous
+            # fence — no sample/evac/prefetch seams here, so the host
+            # share lands in the derived `other` bucket.
+            _t_now = time.perf_counter()
+            _ledger.observe_chunk(
+                _t_now - (_t_prev_fence if _t_prev_fence is not None
+                          else t0), dt)
+            _t_prev_fence = _t_now
+            telemetry.set_learner_mfu("fused", reg=_reg)
+            telemetry.sweep_device_memory(_reg)
             row = {
                 "env_frames": frames,
                 "episode_return": float(metrics["episode_return"]),
@@ -478,7 +510,12 @@ def main():
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of the first "
                              "post-warmup chunk into this directory "
-                             "(view with TensorBoard / xprof)")
+                             "(view with TensorBoard / xprof). All three "
+                             "runtimes. For a window at an arbitrary "
+                             "point of a LIVE run, use the telemetry "
+                             "server's /debug/profile?seconds=N endpoint "
+                             "(or /fleet/profile on the aggregator) "
+                             "instead — no restart needed")
     parser.add_argument("--trace-path", default=None,
                         help="apex runtime: write a Chrome trace-event "
                              "file of the host loop (ingest/sample/train "
@@ -747,11 +784,9 @@ def main():
         # down once, sampled batches stream back double-buffered. The
         # window is DRAM-priced — set replay.capacity accordingly
         # (e.g. --set replay.capacity=8000000 with frame_dedup).
-        for val, name in ((args.profile_dir, "--profile-dir"),
-                          (args.stop_at_return, "--stop-at-return")):
-            if val is not None:
-                print(f"# {name} is not supported by --runtime "
-                      "host-replay (prototype surface); ignored")
+        if args.stop_at_return is not None:
+            print("# --stop-at-return is not supported by --runtime "
+                  "host-replay (prototype surface); ignored")
         if args.checkpoint_replay:
             print("# --checkpoint-replay is implied by --runtime "
                   "host-replay --checkpoint-dir: its checkpoints are "
@@ -803,14 +838,12 @@ def main():
             checkpoint_dir=args.checkpoint_dir,
             save_every_frames=args.save_every_frames,
             mesh_devices=args.mesh_devices,
-            device_sampling=args.device_sampling)
+            device_sampling=args.device_sampling,
+            profile_dir=args.profile_dir)
         out.pop("history", None)
         print(json.dumps(out))
         return
     if args.runtime == "apex":
-        if args.profile_dir:
-            print("# --profile-dir applies to the fused runtime only; "
-                  "ignored under --runtime apex")
         if args.mesh_devices != 1:
             print("# --mesh-devices applies to the fused/host-replay "
                   "runtimes; use --learner-devices for apex batch "
@@ -868,7 +901,8 @@ def main():
             shm_batch=args.shm_batch,
             shard_sampling=args.shard_sampling,
             telemetry_port=args.telemetry_port,
-            telemetry_host=args.telemetry_host)
+            telemetry_host=args.telemetry_host,
+            profile_dir=args.profile_dir)
         print(json.dumps(run_apex(cfg, rt)))
         return
     if args.transport != parser.get_default("transport") \

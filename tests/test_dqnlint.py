@@ -33,14 +33,16 @@ from dist_dqn_tpu.analysis.plugins import chaos_seams  # noqa: E402
 from dist_dqn_tpu.analysis.plugins import heartbeat_stages  # noqa: E402
 from dist_dqn_tpu.analysis.plugins import lock_discipline  # noqa: E402
 from dist_dqn_tpu.analysis.plugins import (donation, mesh_axis,  # noqa: E402
-                                           metrics, sockets, threads,
-                                           wire)
+                                           metrics, program_registry,
+                                           sockets, threads, wire)
 
 #: The nine checks ISSUE 13's acceptance pins (seven migrated + two
-#: new), plus heartbeat-stages (ISSUE 16).
+#: new), plus heartbeat-stages (ISSUE 16) and the chip-time
+#: attribution-census guard (ISSUE 19).
 EXPECTED_CHECKS = ("chaos-seams", "ckpt-schema", "donation",
                    "heartbeat-stages", "lock-discipline", "mesh-axis",
-                   "metrics", "sockets", "threads", "wire")
+                   "metrics", "program_registry", "sockets", "threads",
+                   "wire")
 
 
 # ---------------------------------------------------------------------------
@@ -436,6 +438,81 @@ def test_donation_recognizes_the_real_entry_points():
     for expected in ("dist_dqn_tpu/train.py",
                      "dist_dqn_tpu/host_replay_loop.py",
                      "dist_dqn_tpu/actors/service.py",
+                     "benchmarks/learner_bench.py", "bench.py"):
+        assert expected in seen, (expected, sorted(seen))
+
+
+def test_program_registry_bites_and_honors_wiring(tmp_path):
+    """ISSUE 19 drift-bites: a jitted train/collect entry point that
+    never registers in the chip-time ProgramRegistry fails the census
+    guard; wiring the bound name through ``register_program`` (same
+    line or wrapped across the call's continuation lines, and even
+    with the jit call nested inside a chained ``.lower().compile()``)
+    or a ``# devtime:`` rationale excuses it."""
+    pkg = tmp_path / "dist_dqn_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import jax\n"
+        "from dist_dqn_tpu.telemetry import devtime\n"
+        "def train_step(s, b):\n"
+        "    return s\n"
+        "bad = jax.jit(train_step, donate_argnums=0)\n"
+        "wired = jax.jit(train_step, donate_argnums=0)\n"
+        "prog = devtime.register_program('t', cost=wired)\n"
+        "chained = jax.jit(train_step, donate_argnums=0).lower(1).compile()\n"
+        "prog2 = devtime.register_program(\n"
+        "    't2', cost=chained)\n"
+        "# devtime: trace-only helper, out of census scope\n"
+        "excused = jax.jit(train_step, donate_argnums=0)\n"
+        "act = jax.jit(lambda p, o: o)\n")
+    failures = program_registry.scan(tmp_path)
+    assert [(rel, line) for rel, line, _ in failures] == [
+        ("dist_dqn_tpu/rogue.py", 5)]
+
+
+def test_program_registry_covers_decorator_spelling(tmp_path):
+    pkg = tmp_path / "dist_dqn_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def run_chunk(c):\n"
+        "    return c\n"
+        "# devtime: test fixture, out of census scope\n"
+        "@jax.jit\n"
+        "def run_chunk_excused(c):\n"
+        "    return c\n"
+        "@jax.jit\n"
+        "def run_chunk_wired(c):\n"
+        "    return c\n"
+        "prog = register_program('c', cost=lambda: run_chunk_wired)\n")
+    failures = program_registry.scan(tmp_path)
+    assert [(rel, line) for rel, line, _ in failures] == [
+        ("dist_dqn_tpu/rogue.py", 2)]
+
+
+def test_program_registry_recognizes_the_real_entry_points():
+    """Green-by-coverage, not green-by-blindness: the census guard has
+    to SEE the known jitted train/collect dispatch sites it holds to
+    the registration obligation."""
+    import ast
+
+    ctx = core.AnalysisContext(REPO)
+    seen = set()
+    for rel in ctx.iter_py_files(program_registry.SCAN_ROOTS):
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and donation._is_jit_call(node) \
+                    and donation.TARGET.search(
+                        donation._jitted_expr_text(node)):
+                seen.add(rel)
+    for expected in ("dist_dqn_tpu/host_replay_loop.py",
+                     "dist_dqn_tpu/actors/service.py",
+                     "dist_dqn_tpu/parallel/learner.py",
                      "benchmarks/learner_bench.py", "bench.py"):
         assert expected in seen, (expected, sorted(seen))
 

@@ -256,6 +256,43 @@ def test_forensics_names_every_member(tmp_path):
         ra.close()
 
 
+def test_fleet_profile_fans_out_with_dead_member(tmp_path):
+    """ISSUE 19 on-demand profiling: ``/fleet/profile`` fans the capture
+    out to every live member's ``/debug/profile`` and answers 200 with a
+    correlated map — a dead member degrades to its state entry, it must
+    not poison the fan-out or the live member's trace."""
+    reg_live = Registry()
+    srv = TelemetryServer(registry=reg_live)
+    ra = fleet.register_endpoint("learner", srv.port,
+                                 fleet_dir=str(tmp_path))
+    dead = {"schema_version": 1, "role": "actor", "pid": _dead_pid(),
+            "host": "127.0.0.1", "port": 1,
+            "hostname": socket.gethostname(), "labels": {},
+            "start_time": 2.0, "manifest_hash": None}
+    (tmp_path / f"actor-{dead['pid']}.json").write_text(json.dumps(dead))
+    # A cold capture pays jax's profiler init (~6 s on CPU); the
+    # fan-out timeout is seconds + scrape_timeout_s, so leave slack.
+    agg = fleet.FleetAggregator(str(tmp_path), scrape_timeout_s=15.0)
+    pane = fleet.FleetServer(agg)
+    try:
+        agg.sweep_once()
+        body = json.loads(_get(
+            f"http://127.0.0.1:{pane.port}/fleet/profile?seconds=0",
+            timeout=30.0))
+        members = body["members"]
+        assert set(members) == {f"learner-{os.getpid()}",
+                                f"actor-{dead['pid']}"}
+        assert members[f"actor-{dead['pid']}"] == {"state": "dead"}
+        live = members[f"learner-{os.getpid()}"]
+        assert live["state"] == "live" and live["role"] == "learner"
+        assert "error" not in live, live
+        assert os.path.isdir(live["trace_dir"])
+    finally:
+        pane.close()
+        srv.close()
+        ra.close()
+
+
 def test_fleet_pane_federates_lineage_families(tmp_path):
     """The tentpole end-to-end at unit scale: a member whose registry
     carries populated lineage histograms shows them on the one pane
