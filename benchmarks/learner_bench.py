@@ -344,6 +344,11 @@ def main():
                         "ratio (replay.updates_per_chunk) 1..8 — "
                         "whole-program grad-steps/sec + the chunk-"
                         "carry donation audit (ISSUE 6)")
+    p.add_argument("--population-sweep", action="store_true",
+                   help="sweep the member-axis width M 1..8 — solo vs "
+                        "vmap-stacked population chunk, aggregate + "
+                        "per-member grad-steps/sec (ISSUE 20; same "
+                        "sweep as benchmarks/population_bench.py)")
     p.add_argument("--chunk-iters", type=int, default=200,
                    help="replay-ratio sweep: fused chunk length")
     args = p.parse_args()
@@ -360,6 +365,10 @@ def main():
         return
     if args.replay_ratio_sweep:
         replay_ratio_sweep(args.iters, chunk_iters=args.chunk_iters)
+        return
+    if args.population_sweep:
+        from benchmarks.population_bench import population_sweep
+        population_sweep(args.iters, chunk_iters=args.chunk_iters)
         return
     for name in args.configs:
         print(json.dumps(bench_config(name, args.iters)), flush=True)

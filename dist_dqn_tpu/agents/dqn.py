@@ -81,8 +81,55 @@ def make_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
     return optax.chain(*tx_parts)
 
 
+def make_population_optimizer(cfg: LearnerConfig
+                              ) -> optax.GradientTransformation:
+    """Optimizer for the vmap-stacked population learner (ISSUE 20).
+
+    Same clip+Adam chain as :func:`make_optimizer`, but built through
+    ``optax.inject_hyperparams`` so the learning rate lives in the
+    optimizer STATE — a per-member [M] leaf under ``jax.vmap`` instead
+    of a trace-time constant. :func:`set_member_lr` writes member k's
+    rate into a freshly-initialized state; every subsequent update reads
+    it back as a traced scalar. The injected Adam applies bit-identically
+    to ``make_optimizer``'s at the same rate (the member-independence
+    pin, tests/test_population.py), so a population member matches a
+    solo run exactly.
+
+    Per-member rates compose with ``lr_schedule="constant"`` only: the
+    annealed schedules close over their horizon at trace time, and a
+    per-member horizon is a different axis than a per-member rate.
+    """
+    if cfg.lr_schedule != "constant":
+        raise ValueError(
+            f"population per-member learning rates require "
+            f"lr_schedule='constant', got {cfg.lr_schedule!r} (the "
+            "anneal horizon is a trace-time constant, not a stackable "
+            "member axis)")
+
+    def _build(learning_rate):
+        tx_parts = []
+        if cfg.max_grad_norm:
+            tx_parts.append(optax.clip_by_global_norm(cfg.max_grad_norm))
+        tx_parts.append(optax.adam(learning_rate, eps=cfg.adam_eps))
+        return optax.chain(*tx_parts)
+
+    return optax.inject_hyperparams(_build)(
+        learning_rate=cfg.learning_rate)
+
+
+def set_member_lr(state: LearnerState, lr: Array) -> LearnerState:
+    """Write a (traced) per-member learning rate into an opt_state built
+    by :func:`make_population_optimizer` — called inside the vmapped
+    population init, where ``lr`` is member k's scalar."""
+    opt = state.opt_state
+    hyper = dict(opt.hyperparams)
+    hyper["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    return state._replace(opt_state=opt._replace(hyperparams=hyper))
+
+
 def make_learner(net: nn.Module, cfg: LearnerConfig,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None,
+                 tx: Optional[optax.GradientTransformation] = None):
     """Build (init, train_step) for a feed-forward Q-network.
 
     train_step(state, batch, weights) -> (state, metrics); metrics includes
@@ -98,8 +145,13 @@ def make_learner(net: nn.Module, cfg: LearnerConfig,
     the single-device full-batch step (rtol 2e-5 — cross-shard pmean
     reorders the reduction, so exact bit-equality is not expected;
     tests/test_distributed.py).
+
+    ``tx`` overrides the optimizer (default :func:`make_optimizer`) —
+    the population path passes :func:`make_population_optimizer` so the
+    learning rate is a per-member state leaf.
     """
-    tx = make_optimizer(cfg)
+    if tx is None:
+        tx = make_optimizer(cfg)
 
     num_atoms = getattr(net, "num_atoms", 1)
     quantile = num_atoms > 1 and getattr(net, "quantile", False)

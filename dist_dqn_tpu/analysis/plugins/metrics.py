@@ -93,7 +93,12 @@ ALLOWLIST = {
     "dist_dqn_tpu/serving/__main__.py": 3,
     # +1 at ISSUE 4: the one-per-run {"manifest": ...} provenance line
     # (telemetry/manifest.py) — run identity, not a metric stream.
-    "dist_dqn_tpu/train.py": 11,
+    # +4 at ISSUE 20: the population loop's telemetry_port /
+    # resumed_at_frames / profile_trace announcements and its per-chunk
+    # metric row — the same output contracts as the solo loop's sites;
+    # the population metrics themselves go through the registry
+    # (dqn_population_*).
+    "dist_dqn_tpu/train.py": 15,
     "dist_dqn_tpu/utils/metrics.py": 1,  # MetricLogger.flush itself
 }
 

@@ -176,6 +176,26 @@ class ActorConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PopulationConfig:
+    """Population training plane (ISSUE 20, fused runtime only).
+
+    ``size`` M > 1 vmap-stacks M independent policies — params, optimizer
+    state, target params, replay and collect carries all gain a leading
+    member axis — and advances all of them in ONE dispatched chunk
+    program per chunk (Podracer's "one program, many policies",
+    PAPERS.md). ``spec_json`` optionally carries per-member
+    hyperparameter vectors (``epsilon`` / ``lr`` / ``gamma``, each a
+    length-M JSON array — the raw text of the ``--population-spec``
+    file; dist_dqn_tpu/population.py parses and validates it). size=1
+    runs the exact pre-knob program (bit-identity pin,
+    tests/test_population.py).
+    """
+
+    size: int = 1
+    spec_json: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     """One runnable experiment = env + net + replay + learner + actors."""
 
@@ -185,6 +205,7 @@ class ExperimentConfig:
     replay: ReplayConfig = ReplayConfig()
     learner: LearnerConfig = LearnerConfig()
     actor: ActorConfig = ActorConfig()
+    population: PopulationConfig = PopulationConfig()
     total_env_steps: int = 500_000
     train_every: int = 1               # learner updates per env *vector* step
     updates_per_train: int = 1

@@ -32,7 +32,8 @@ def _ckpt_prefix(checkpoint_dir: str):
             else ())
 
 
-def _restore_latest(checkpoint_dir: str, example_params, step=None):
+def _restore_latest(checkpoint_dir: str, example_params, step=None,
+                    member=None):
     """(frames, params) from the newest checkpoint (or a specific
     retained ``step``). Read-only surface: never create the directory on
     a typo'd path, and release the orbax manager after the one restore.
@@ -41,7 +42,10 @@ def _restore_latest(checkpoint_dir: str, example_params, step=None):
     params subtree (utils/checkpoint.py restore_params): the training
     run's optimizer structure (e.g. lr-schedule state) never constrains
     an eval invocation, and carry-kind (--checkpoint-replay) runs are
-    evaluable without a ring-sized carry template.
+    evaluable without a ring-sized carry template. ``member`` selects
+    one policy out of a --population run's [M]-stacked tree (ISSUE 20);
+    restore_params refuses the solo/stacked direction mismatches with
+    the actual cause.
     """
     from dist_dqn_tpu.utils.checkpoint import TrainCheckpointer
 
@@ -52,7 +56,7 @@ def _restore_latest(checkpoint_dir: str, example_params, step=None):
     ckpt = TrainCheckpointer(checkpoint_dir)
     try:
         restored = ckpt.restore_params(example_params, step=step,
-                                       prefix=prefix)
+                                       prefix=prefix, member=member)
     except FileNotFoundError as e:
         # Convert to the skippable type ONLY when the requested step is
         # genuinely gone from the retained set (live retention race) —
@@ -105,7 +109,8 @@ def _build_eval(cfg: ExperimentConfig, episodes: int, epsilon: float,
 def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
                         episodes: int = 10, seed: int = 0,
                         epsilon: float = 0.001, step: int = None,
-                        export_params: str = None) -> dict:
+                        export_params: str = None,
+                        member: int = None) -> dict:
     """Restore the newest checkpoint (or retained ``step``) and play
     greedy episodes.
 
@@ -120,10 +125,12 @@ def evaluate_checkpoint(cfg: ExperimentConfig, checkpoint_dir: str,
     """
     example, evaluator, k_eval = _build_eval(cfg, episodes, epsilon, seed)
     frames, params = _restore_latest(checkpoint_dir, example.params,
-                                     step=step)
+                                     step=step, member=member)
     mean_return = float(evaluator(params, k_eval))
     out = {"eval_return": mean_return, "frames": frames,
            "episodes": episodes, "config": cfg.name}
+    if member is not None:
+        out["member"] = member
     if export_params:
         from dist_dqn_tpu.utils.checkpoint import save_pytree
 
@@ -142,7 +149,7 @@ def _skip_row(step: int) -> dict:
 def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
                               episodes: int = 10, seed: int = 0,
                               epsilon: float = 0.001,
-                              log_fn=None) -> list:
+                              log_fn=None, member: int = None) -> list:
     """Evaluate EVERY retained checkpoint step (oldest first) — the
     learning curve of a run directory. One env/net/evaluator build and
     one compile serve all steps; one checkpoint manager restores each
@@ -175,7 +182,8 @@ def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
         for step in steps:
             try:
                 frames, params = ckpt.restore_params(
-                    example.params, step=step, prefix=prefix)
+                    example.params, step=step, prefix=prefix,
+                    member=member)
             except FileNotFoundError:
                 # Narrow scope: only the restore is guarded, so an
                 # unrelated FileNotFoundError cannot be mislabeled.
@@ -185,6 +193,8 @@ def evaluate_checkpoint_curve(cfg: ExperimentConfig, checkpoint_dir: str,
             row = {"eval_return": float(evaluator(params, k_eval)),
                    "frames": frames, "episodes": episodes,
                    "config": cfg.name}
+            if member is not None:
+                row["member"] = member
             rows.append(row)
             if log_fn:
                 log_fn(row)
@@ -197,7 +207,7 @@ def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
                              host_env: str, episodes: int = 10,
                              seed: int = 0, epsilon: float = 0.001,
                              max_steps: int = 20_000,
-                             step: int = None) -> dict:
+                             step: int = None, member: int = None) -> dict:
     """Greedy checkpoint episodes on a HOST env (real ALE / DM-Control /
     gymnasium) — the deploy-side counterpart of an Ape-X split training
     run, which steps host envs the JAX stand-ins only approximate.
@@ -232,15 +242,18 @@ def evaluate_checkpoint_host(cfg: ExperimentConfig, checkpoint_dir: str,
     rng, k_init = jax.random.split(rng)
     example = init(k_init, jax.numpy.asarray(obs[0]))
     frames, params = _restore_latest(checkpoint_dir, example.params,
-                                     step=step)
+                                     step=step, member=member)
 
     returns, truncated, _ = run_greedy_episodes(
         env, act, params, rng, episodes=episodes,
         recurrent_carry=carry if recurrent else None, epsilon=epsilon,
         max_steps=max_steps)
-    return {"eval_return": float(returns.mean()), "frames": frames,
-            "episodes": episodes, "config": cfg.name, "host_env": host_env,
-            "episodes_truncated": truncated}
+    out = {"eval_return": float(returns.mean()), "frames": frames,
+           "episodes": episodes, "config": cfg.name, "host_env": host_env,
+           "episodes_truncated": truncated}
+    if member is not None:
+        out["member"] = member
+    return out
 
 
 def _apply_risk_eta(cfg: ExperimentConfig, eta) -> ExperimentConfig:
@@ -280,6 +293,14 @@ def main():
                         help="override config fields by dotted path (must "
                              "match how the checkpoint was trained, e.g. "
                              "--set network.dueling=true)")
+    parser.add_argument("--member", type=int, default=None, metavar="K",
+                        help="population checkpoints (ISSUE 20, "
+                             "--population runs): evaluate member K of "
+                             "the [M]-stacked tree (0-based). Required "
+                             "for population directories — a member-less "
+                             "restore of a stacked tree is refused with "
+                             "the cause — and refused on solo "
+                             "directories")
     parser.add_argument("--all-steps", action="store_true",
                         help="evaluate EVERY retained checkpoint step "
                              "(oldest first, one JSON line each) — a "
@@ -361,12 +382,13 @@ def main():
         if args.host_env:
             out = evaluate_checkpoint_host(
                 cfg, args.checkpoint_dir, args.host_env,
-                episodes=args.episodes, seed=args.seed, step=step)
+                episodes=args.episodes, seed=args.seed, step=step,
+                member=args.member)
         else:
             out = evaluate_checkpoint(
                 cfg, args.checkpoint_dir,
                 episodes=args.episodes, seed=args.seed, step=step,
-                export_params=args.export_params)
+                export_params=args.export_params, member=args.member)
         tag_and_print(out)
 
     def dispatch():
@@ -387,7 +409,7 @@ def main():
             evaluate_checkpoint_curve(
                 cfg, args.checkpoint_dir, episodes=args.episodes,
                 seed=args.seed,
-                log_fn=tag_and_print)
+                log_fn=tag_and_print, member=args.member)
         elif args.all_steps:
             # Host envs: per-step restores through the single-point
             # surface (episode stepping dominates; no scan-evaluator

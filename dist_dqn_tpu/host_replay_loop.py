@@ -1121,6 +1121,18 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
                     f"{int(side['prio_writeback_batch'])}, this run "
                     f"uses {prio_writeback_batch} — resume with the "
                     "same PER write-back cadence")
+            if int(side.get("population", 1)) != 1:
+                # v4 (ISSUE 20): this loop has no stacked-member plane —
+                # a population sidecar's state shapes carry a leading
+                # [M] axis its solo restore templates cannot absorb.
+                _refuse_resume(
+                    "population",
+                    f"checkpoint at {checkpoint_dir!r} was written with "
+                    f"population={int(side['population'])} stacked "
+                    "members, but --runtime host-replay trains a single "
+                    "policy — the member axis is checkpoint structure. "
+                    "Resume it under the fused --population runtime, or "
+                    "start a fresh --checkpoint-dir")
             if bool(side["per"]) != per_enabled:
                 _refuse_resume(
                     "per",
@@ -1334,6 +1346,9 @@ def run_host_replay(cfg: ExperimentConfig, total_env_steps: int,
             dp=np.int64(dp),
             per=np.bool_(per_enabled),
             per_sampler_kind=np.int64(int(device_sampling)),
+            # v4 (ISSUE 20): member-axis width pin — this loop always
+            # trains ONE policy; the restore path refuses any other M.
+            population=np.int64(1),
             sharded_collect=np.bool_(mesh_mode),
             prio_writeback_batch=np.int64(prio_writeback_batch),
             wb_count=np.int64(len(wb_pending)),

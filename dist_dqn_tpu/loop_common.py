@@ -218,6 +218,33 @@ def make_schedules(cfg: ExperimentConfig, B: int, num_shards: int
     return epsilon, beta_at
 
 
+def make_member_epsilon(cfg: ExperimentConfig, B: int, num_shards: int
+                        ) -> Callable:
+    """Per-member exploration decay for the population plane (ISSUE 20):
+    ``eps_at(iteration, delta, end)`` with TRACED ``delta`` / ``end``
+    scalars (member k's ``epsilon_start - epsilon_end`` and
+    ``epsilon_end`` under ``jax.vmap``).
+
+    Op-for-op the body of ``make_schedules``'s
+    ``optax.linear_schedule`` (polynomial power=1): same int32 clip,
+    same ``1 - count/steps`` promotion, same multiply-add — with the
+    constants arriving as [M]-array lanes instead of trace-time
+    literals, so member k's epsilon is bit-identical to a solo run
+    configured with member k's ``epsilon_end`` (the member-independence
+    pin). ``delta`` must be folded on the HOST in float64 then cast to
+    f32, exactly as the schedule's Python-literal subtraction is
+    (population.member_hp does this).
+    """
+    steps = max(cfg.actor.epsilon_decay_steps // (B * num_shards), 1)
+
+    def eps_at(iteration: Array, delta: Array, end: Array) -> Array:
+        count = jnp.clip(iteration, 0, steps)
+        frac = 1 - count / steps
+        return delta * frac + end
+
+    return eps_at
+
+
 def pallas_routing(enabled: bool) -> Tuple[bool, bool]:
     """(use_pallas, pallas_interpret) for the priority-sampling kernel.
 

@@ -31,12 +31,13 @@ class _PolicyEntry:
     """One policy id's checkpoint binding + current snapshot."""
 
     def __init__(self, policy_id: str, checkpoint_dir: str, ckpt, prefix,
-                 epsilon: float):
+                 epsilon: float, member: Optional[int] = None):
         self.policy_id = policy_id
         self.checkpoint_dir = checkpoint_dir
         self.ckpt = ckpt                      # open TrainCheckpointer
         self.prefix = prefix
         self.epsilon = epsilon
+        self.member = member                  # population member slice
         self.snapshot: Optional[PolicySnapshot] = None
 
 
@@ -65,13 +66,20 @@ class ModelStore:
 
     # -- registration -------------------------------------------------------
     def add_policy(self, policy_id: str, checkpoint_dir: str,
-                   epsilon: float = 0.0) -> PolicySnapshot:
+                   epsilon: float = 0.0,
+                   member: Optional[int] = None) -> PolicySnapshot:
         """Register a tenant and BLOCKING-restore its newest checkpoint
         (startup path — the serving loop is not live yet). Raises the
         distinct CheckpointMissingError when the directory is absent or
         holds no complete checkpoint yet — the retryable
         launched-beside-training shape the CLI's --wait-for-checkpoint
-        waits on (unrelated startup failures stay loud)."""
+        waits on (unrelated startup failures stay loud).
+
+        ``member`` serves one policy out of a --population run's
+        [M]-stacked checkpoint (ISSUE 20): every restore — startup and
+        hot-reload alike — extracts member k's slice, so M tenants can
+        bind M members of the same run directory and hot-reload
+        independently off one stacked save."""
         import os
 
         from dist_dqn_tpu.utils.checkpoint import (CheckpointMissingError,
@@ -91,7 +99,7 @@ class ModelStore:
                   else ())
         ckpt = TrainCheckpointer(checkpoint_dir)
         entry = _PolicyEntry(policy_id, checkpoint_dir, ckpt, prefix,
-                             epsilon)
+                             epsilon, member=member)
         try:
             snap = self._restore(entry, step=None, version=1)
         except BaseException:
@@ -127,7 +135,9 @@ class ModelStore:
                       "step": e.snapshot.step,
                       "epsilon": e.snapshot.epsilon,
                       "param_checksum": e.snapshot.param_checksum,
-                      "checkpoint_dir": e.checkpoint_dir}
+                      "checkpoint_dir": e.checkpoint_dir,
+                      **({"member": e.member}
+                         if e.member is not None else {})}
                 for pid, e in self._entries.items()
                 if e.snapshot is not None
             }
@@ -163,13 +173,17 @@ class ModelStore:
             chaos.sleep_for(ev)
         restored = entry.ckpt.restore_params(self.example_params,
                                              step=step,
-                                             prefix=entry.prefix)
+                                             prefix=entry.prefix,
+                                             member=entry.member)
         if restored is None:
             return None
         got_step, params = restored
         ptr = read_latest_pointer(entry.checkpoint_dir)
+        # Population entries serve a member SLICE; the pointer's digest
+        # covers the whole stacked tree, so it would mislabel the slice.
         checksum = (ptr.get("param_checksum")
                     if ptr and int(ptr.get("step", -1)) == got_step
+                    and entry.member is None
                     else None)
         return PolicySnapshot(
             policy_id=entry.policy_id, params=params, version=version,

@@ -162,13 +162,27 @@ INGEST_DEGRADED = "dqn_ingest_degraded"
 # counts successful whole-state restores per {loop}; REFUSED counts
 # resume attempts rejected at the pins, per {reason=
 # "sidecar_version"|"chunk_iters"|"dp"|"per"|"prio_writeback_batch"|
-# "torn_sidecar"} — the sidecar pins are enumerated in
-# docs/fault_tolerance.md.
+# "torn_sidecar"|"population"} — the sidecar pins are enumerated in
+# docs/fault_tolerance.md ("population" joined in ISSUE 20: a stacked
+# tree's member-axis width is checkpoint structure, pinned by the
+# POPULATION marker in utils/checkpoint.py and the sidecar scalar).
 CHECKPOINT_SAVE_SECONDS = "dqn_checkpoint_save_seconds"
 CHECKPOINT_BYTES = "dqn_checkpoint_bytes_total"
 CHECKPOINT_SHARDS_SAVED = "dqn_checkpoint_shards_saved"
 CHECKPOINT_RESUMES = "dqn_checkpoint_resumes_total"
 CHECKPOINT_REFUSED = "dqn_checkpoint_refused_resumes_total"
+
+# Population training plane (ISSUE 20): M vmap-stacked policies in ONE
+# fused program (dist_dqn_tpu/population.py). SIZE is the member-axis
+# width M of the running program; LOSS/EVAL_RETURN are the per-{member}
+# twins of dqn_loss and the eval_return log column — the selection
+# signals a PBT controller would read. All three labeled {loop} like
+# the learner families; the shared fused counters (dqn_env_steps_total,
+# dqn_learner_grad_steps_total) count AGGREGATE member-steps under a
+# population, because that is what the chip actually sustained.
+POPULATION_SIZE = "dqn_population_size"
+POPULATION_LOSS = "dqn_population_loss"
+POPULATION_EVAL_RETURN = "dqn_population_eval_return"
 
 # Zero-copy ingest subsystem (ISSUE 9): the schema-negotiated
 # experience path (dist_dqn_tpu/ingest/). RECORDS/BYTES are labeled

@@ -74,6 +74,24 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     multiple hosts: each process runs this function, process 0 logs, and
     checkpoint/eval work from the replicated learner copy.
     """
+    # Population training plane (ISSUE 20): M > 1 routes to the
+    # vmap-stacked trainer; M == 1 with a spec applies member 0's
+    # overrides STATICALLY and falls through to the plain program —
+    # so `--population 1` is bit-identical to today's run by
+    # construction (no traced-hyperparameter lanes, no vmap).
+    if cfg.population.size > 1:
+        return _train_population(
+            cfg, total_env_steps=total_env_steps, seed=seed,
+            chunk_iters=chunk_iters, log_fn=log_fn,
+            checkpoint_dir=checkpoint_dir,
+            save_every_frames=save_every_frames,
+            profile_dir=profile_dir, num_devices=num_devices,
+            stop_fn=stop_fn, checkpoint_replay=checkpoint_replay,
+            telemetry_port=telemetry_port,
+            telemetry_host=telemetry_host)
+    if cfg.population.spec_json:
+        from dist_dqn_tpu import population as _pop
+        cfg = _pop.member_config(cfg, _pop.resolve_spec(cfg), 0)
     multiprocess = jax.process_count() > 1
     if multiprocess:
         from dist_dqn_tpu.parallel.distributed import main_process_log
@@ -402,6 +420,308 @@ def train(cfg: ExperimentConfig, total_env_steps: int = 0, seed: int = None,
     return carry, history
 
 
+def _train_population(cfg: ExperimentConfig, total_env_steps: int = 0,
+                      seed: int = None, chunk_iters: int = 2000,
+                      log_fn=print, checkpoint_dir: str = None,
+                      save_every_frames: int = 0, profile_dir: str = None,
+                      num_devices: int = 1, stop_fn=None,
+                      checkpoint_replay: bool = False,
+                      telemetry_port: int = None,
+                      telemetry_host: str = "127.0.0.1"):
+    """The population twin of :func:`train` (ISSUE 20): M vmap-stacked
+    policies advance as ONE jitted program, one dispatch per chunk.
+
+    Every carry leaf — params, optimizer state, target params, replay
+    ring, env vector, rng — carries a leading member axis; per-member
+    hyperparameters (``population.spec_json``) ride as traced [M]
+    lanes. Member independence is pinned (tests/test_population.py):
+    member k's lane bit-matches an M=1 stacked run configured with
+    member k's spec entry and seeded with member k's spawn-key stream
+    (``population.member_seeds``), so the population is M independent
+    experiments sharing a chip, not a coupled batch.
+
+    Frame accounting: the ``frames`` cursor (and ``total_env_steps``)
+    is PER MEMBER — each member trains the same budget a solo run
+    would — while telemetry counters and the ``env_steps_per_sec`` /
+    ``grad_steps_per_sec`` log columns report the AGGREGATE
+    member-steps the chip actually sustained (the north-star the
+    population exists to raise). Checkpoints hold the [M]-stacked tree
+    (learner-only by default, the whole stacked carry under
+    ``checkpoint_replay``) plus a ``POPULATION`` width marker; resume
+    at a different ``--population`` is refused with the actual cause,
+    and ``restore_params(member=k)`` extracts one member for
+    evaluate.py / the serving ModelStore.
+    """
+    from dist_dqn_tpu import population as pop
+    from dist_dqn_tpu import telemetry
+    from dist_dqn_tpu.telemetry import collectors as tmc
+    from dist_dqn_tpu.telemetry import watchdog as tm_watchdog
+
+    M = cfg.population.size
+    if num_devices != 1 or jax.process_count() > 1:
+        raise ValueError(
+            "--population composes with the single-device fused runtime "
+            "only for now: the population fills ONE chip by vmap-stacking "
+            "members; run one population process per device instead of "
+            "--mesh-devices")
+    if cfg.network.lstm_size:
+        raise ValueError(
+            "--population is not supported by the recurrent (R2D2) fused "
+            "loop yet (its sequence learner has no member axis)")
+    spec = pop.resolve_spec(cfg)
+    hp = pop.member_hp(cfg, spec)
+    seed = cfg.seed if seed is None else seed
+    total = total_env_steps or cfg.total_env_steps
+    env = make_jax_env(cfg.env_name)
+    net = build_network(cfg.network, env.num_actions)
+
+    _flight = telemetry.get_flight()
+    _hb_chunk = tm_watchdog.heartbeat(
+        "population.chunk", startup_grace_s=tm_watchdog.STARTUP_GRACE_S)
+    _reg = telemetry.get_registry()
+    _fl = {"loop": "fused"}
+    _reg.gauge(tmc.POPULATION_SIZE,
+               "vmap-stacked members in this run", _fl).set(M)
+    _member_loss = [
+        _reg.gauge(tmc.POPULATION_LOSS, "chunk-mean TD loss per member",
+                   {**_fl, "member": str(k)}) for k in range(M)]
+    _member_eval = [
+        _reg.gauge(tmc.POPULATION_EVAL_RETURN,
+                   "greedy eval return per member",
+                   {**_fl, "member": str(k)}) for k in range(M)]
+    # The shared fused-loop families count AGGREGATE member-steps: the
+    # chip runs M policies, so its env/grad throughput is M-fold.
+    _tm = {
+        "env_steps": _reg.counter(tmc.ENV_STEPS, "env frames processed"),
+        "env_rate": _reg.gauge(tmc.ENV_RATE, "env-steps/sec (last chunk)"),
+        "grad_steps": _reg.counter(tmc.GRAD_STEPS,
+                                   "learner grad steps taken"),
+        "chunk": _reg.histogram("dqn_chunk_seconds",
+                                "fused chunk wall time"),
+        "loss": _reg.gauge("dqn_loss", "chunk-mean TD loss"),
+        "episodes": _reg.counter("dqn_episodes_completed_total",
+                                 "training episodes finished"),
+        "ep_return": _reg.gauge("dqn_episode_return",
+                                "chunk-mean finished-episode return"),
+        "grad_rate": _reg.gauge(tmc.LEARNER_GRAD_RATE,
+                                "grad steps per second (last chunk)",
+                                _fl),
+    }
+    from dist_dqn_tpu import loop_common as _lc
+    _reg.gauge(tmc.LEARNER_REPLAY_RATIO,
+               "grad sub-steps per train event",
+               _fl).set(_lc.resolve_replay_ratio(cfg))
+    _reg.gauge(tmc.LEARNER_TRAIN_BATCH,
+               "effective (bucketed) train batch width",
+               _fl).set(_lc.resolve_train_batch(cfg))
+    telemetry_server = None
+    if telemetry_port is not None:
+        telemetry_server = telemetry.start_server(telemetry_port,
+                                                  host=telemetry_host)
+        log_fn(json.dumps({"telemetry_port": telemetry_server.port}))
+        from dist_dqn_tpu.telemetry import fleet as _fleet
+        _fleet.register_endpoint("learner", telemetry_server.port,
+                                 host=telemetry_host,
+                                 labels={"loop": "fused"})
+
+    # Per-member host rng streams: member k's stream is EXACTLY the one
+    # a solo run seeded with member_seeds(seed, M)[k] would consume —
+    # init key and eval keys split in the same order (the PR 5
+    # spawn-key discipline; the member-independence pin depends on it).
+    seeds = pop.member_seeds(seed, M)
+    host_rngs = [jax.random.PRNGKey(s) for s in seeds]
+    k_inits = []
+    for k in range(M):
+        host_rngs[k], k_init = jax.random.split(host_rngs[k])
+        k_inits.append(np.asarray(k_init))
+    init_p, run_population_chunk = pop.make_population_train(cfg, env, net)
+    carries = init_p(np.stack(k_inits), hp)
+    run = jax.jit(run_population_chunk, static_argnums=2, donate_argnums=0)
+    evaluate = jax.jit(jax.vmap(make_evaluator(
+        cfg, env, net, num_episodes=cfg.eval_episodes)))
+    # Chip-time attribution (ISSUE 19): the population chunk is still
+    # ONE program — M members' acting, replay and grad scans fused into
+    # a single dispatch — so dqn_learner_mfu prices the whole
+    # population's FLOPs against the same chunk wall.
+    _prog_chunk = telemetry.register_program(
+        "population.chunk", loop="fused", role="train")
+    _ledger = telemetry.UtilizationLedger("fused", _reg)
+
+    ckpt = None
+    frame_offset = 0
+    resumed_frames = 0
+    if checkpoint_dir:
+        from dist_dqn_tpu.utils.checkpoint import (TrainCheckpointer,
+                                                   record_checkpoint_kind,
+                                                   record_population_size)
+        ckpt = TrainCheckpointer(
+            checkpoint_dir,
+            save_every_frames=save_every_frames or cfg.eval_every_steps
+            or 100_000)
+        record_checkpoint_kind(checkpoint_dir,
+                               "carry" if checkpoint_replay else "learner")
+        try:
+            record_population_size(checkpoint_dir, M)
+        except ValueError:
+            # The stacked tree's member axis is structural: resuming a
+            # population-M' directory at M would fail as an opaque
+            # shape mismatch — refuse with the cause, counted under the
+            # same family as the host-replay sidecar pins.
+            _reg.counter(tmc.CHECKPOINT_REFUSED,
+                         "resume attempts refused at the sidecar pins",
+                         {**_fl, "reason": "population"}).inc()
+            raise
+        restored = ckpt.restore_latest(
+            carries if checkpoint_replay else carries.learner)
+        if restored is not None:
+            frame_offset, tree = restored
+            resumed_frames = frame_offset
+            log_fn(json.dumps({"resumed_at_frames": frame_offset,
+                               "with_replay": checkpoint_replay,
+                               "population": M}))
+            if checkpoint_replay:
+                carries = tree
+                frame_offset = 0
+            else:
+                carries = carries._replace(learner=tree)
+
+    _emerg = {"frames": resumed_frames, "carry": carries}
+    if ckpt is not None:
+        from dist_dqn_tpu.utils.checkpoint import save_pytree as _save_pt
+
+        def _emergency_save():
+            import os
+
+            tree = (_emerg["carry"] if checkpoint_replay
+                    else _emerg["carry"].learner)
+            _save_pt(os.path.join(checkpoint_dir, "emergency_learner"),
+                     {"learner": tree})
+
+        tm_watchdog.register_emergency_hook("population.checkpoint",
+                                            _emergency_save)
+
+    B = cfg.actor.num_envs
+    history = []
+    frames = resumed_frames   # PER-MEMBER cursor (see docstring)
+    next_eval = frames if cfg.eval_every_steps else float("inf")
+    chunk_index = 0
+    _t_prev_fence = None
+    profile_chunk = 1 if total > frames + chunk_iters * B else 0
+    try:
+        while frames < total:
+            profiling = (profile_dir is not None
+                         and chunk_index == profile_chunk)
+            if profiling:
+                jax.profiler.start_trace(profile_dir)
+            if not _prog_chunk.cost_attached:
+                _c, _hp, _ci = carries, hp, chunk_iters
+                _prog_chunk.attach_cost(lambda: run.lower(_c, _hp, _ci))
+            t0 = time.perf_counter()
+            carries, metrics = run(carries, hp, chunk_iters)
+            # Every metric leaf is [M]; fetch once, fence the chunk.
+            metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
+            dt = time.perf_counter() - t0
+            _prog_chunk.count_dispatch()
+            _prog_chunk.add_device_seconds(dt)
+            if profiling:
+                jax.profiler.stop_trace()
+                log_fn(json.dumps({"profile_trace": profile_dir}))
+            chunk_index += 1
+            prev_frames = frames
+            # Members advance in lockstep (same lane count, same chunk),
+            # so member 0's cumulative frame metric IS the cursor.
+            frames = frame_offset + int(metrics["env_frames"][0])
+            frames_delta = max(frames - prev_frames, 0)
+            grad_member = float(np.mean(metrics["grad_steps_in_chunk"]))
+            grad_total = float(np.sum(metrics["grad_steps_in_chunk"]))
+            _tm["env_steps"].inc(frames_delta * M)
+            _tm["env_rate"].set(frames_delta * M / dt)
+            _tm["grad_steps"].inc(grad_total)
+            _tm["chunk"].observe(dt)
+            _tm["grad_rate"].set(grad_total / dt)
+            _hb_chunk.beat()
+            losses = [float(v) for v in metrics["loss"]]
+            _loss = float(np.mean(losses))
+            for k in range(M):
+                _member_loss[k].set(losses[k])
+            _flight.record("chunk", "population.chunk", frames=frames,
+                           loss=_loss, wall_s=round(dt, 4))
+            # The sentinel watches the population MEAN: one diverged
+            # member shifts it enough to trip, and the forensics
+            # bundle's registry snapshot carries the per-member gauges
+            # to say which.
+            tm_watchdog.observe_divergence(loss=_loss, step=frames)
+            _tm["loss"].set(_loss)
+            episodes = float(np.sum(metrics["episodes"]))
+            _tm["episodes"].inc(max(episodes, 0.0))
+            ep_members = metrics["episodes"] > 0
+            if np.any(ep_members):
+                _tm["ep_return"].set(float(np.mean(
+                    metrics["episode_return"][ep_members])))
+            _t_now = time.perf_counter()
+            _ledger.observe_chunk(
+                _t_now - (_t_prev_fence if _t_prev_fence is not None
+                          else t0), dt)
+            _t_prev_fence = _t_now
+            telemetry.set_learner_mfu("fused", reg=_reg)
+            telemetry.sweep_device_memory(_reg)
+            row = {
+                "env_frames": frames,
+                "population": M,
+                "episode_return": (float(np.mean(
+                    metrics["episode_return"][ep_members]))
+                    if np.any(ep_members) else 0.0),
+                "episodes": episodes,
+                "loss": _loss,
+                "loss_members": losses,
+                # Aggregate member-steps/sec — the chip's actual
+                # throughput and the bench acceptance column.
+                "env_steps_per_sec": M * chunk_iters * B / dt,
+                "grad_steps_in_chunk": grad_member,
+                "grad_steps_per_sec": grad_total / dt,
+                "grad_steps_per_sec_member": grad_member / dt,
+            }
+            if frames >= next_eval:
+                keys = []
+                for k in range(M):
+                    host_rngs[k], k_eval = jax.random.split(host_rngs[k])
+                    keys.append(np.asarray(k_eval))
+                rets = np.asarray(jax.device_get(evaluate(
+                    carries.learner.params, np.stack(keys))))
+                row["eval_return_members"] = [float(r) for r in rets]
+                row["eval_return"] = float(np.mean(rets))
+                for k in range(M):
+                    _member_eval[k].set(float(rets[k]))
+                next_eval = frames + cfg.eval_every_steps
+            history.append(row)
+
+            def _round(v):
+                if isinstance(v, float):
+                    return round(v, 3)
+                if isinstance(v, list):
+                    return [round(x, 3) if isinstance(x, float) else x
+                            for x in v]
+                return v
+
+            log_fn(json.dumps({k: _round(v) for k, v in row.items()}))
+            _emerg["frames"], _emerg["carry"] = frames, carries
+            if ckpt is not None:
+                ckpt.maybe_save(frames, carries if checkpoint_replay
+                                else carries.learner)
+            if stop_fn is not None and stop_fn(row):
+                break
+    finally:
+        _hb_chunk.close()
+        tm_watchdog.unregister_emergency_hook("population.checkpoint")
+    if ckpt is not None:
+        ckpt.save(frames, carries if checkpoint_replay
+                  else carries.learner)
+        ckpt.close()
+    if telemetry_server is not None:
+        telemetry_server.close()
+    return carries, history
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", choices=sorted(CONFIGS), required=True)
@@ -414,6 +734,28 @@ def main():
     parser.add_argument("--total-env-steps", type=int, default=0)
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--chunk-iters", type=int, default=2000)
+    parser.add_argument("--population", type=int, default=None,
+                        metavar="M",
+                        help="fused runtime (ISSUE 20): train M "
+                             "vmap-stacked policies (population.size) as "
+                             "ONE program — every carry leaf gains a "
+                             "leading member axis and one dispatch per "
+                             "chunk advances all members. Per-member "
+                             "seeds spawn from --seed (member k of an "
+                             "M-run bit-matches a stacked run with only "
+                             "member k); --population 1 is bit-identical "
+                             "to the plain program. Mutually exclusive "
+                             "with --mesh-devices; see --population-spec "
+                             "and docs/performance.md")
+    parser.add_argument("--population-spec", default=None, metavar="JSON",
+                        help="per-member hyperparameter vectors "
+                             "(population.spec_json): a JSON object with "
+                             "any of \"epsilon\" (exploration floor "
+                             "epsilon_end), \"lr\", \"gamma\" — each a "
+                             "length-M array; members without an "
+                             "override inherit the config. Example: "
+                             "--population 2 --population-spec "
+                             "'{\"lr\": [1e-3, 3e-4]}'")
     parser.add_argument("--replay-ratio", type=int, default=None,
                         metavar="N",
                         help="on-device replay ratio "
@@ -765,6 +1107,43 @@ def main():
         else:
             cfg = _dc.replace(cfg, network=_dc.replace(
                 cfg.network, actor_dtype=args.actor_dtype))
+    # Population plane (ISSUE 20): fused-runtime-only, like the knobs
+    # above — warn-and-ignore on runtimes without a member axis, but
+    # REFUSE the population x mesh cross outright (silently dropping
+    # either flag would run a different experiment than asked for).
+    if args.population is not None or args.population_spec is not None:
+        if args.population is not None and args.population < 1:
+            parser.error(f"--population must be >= 1, got "
+                         f"{args.population}")
+        if args.runtime != "fused":
+            print("# --population/--population-spec apply to the fused "
+                  "runtime only (the apex/host-replay runtimes have no "
+                  "stacked-member plane yet); ignored")
+        elif _recurrent_fused:
+            print("# --population is not supported by the recurrent "
+                  "(R2D2) fused loop yet (its sequence learner has no "
+                  "member axis); ignored")
+        elif args.mesh_devices != 1 and (args.population or 1) > 1:
+            parser.error(
+                "--population and --mesh-devices are mutually exclusive: "
+                "the population fills ONE chip by vmap-stacking members; "
+                "run one population process per device (or drop one "
+                "flag)")
+        else:
+            cfg = _dc.replace(cfg, population=_dc.replace(
+                cfg.population,
+                size=(args.population if args.population is not None
+                      else cfg.population.size),
+                spec_json=(args.population_spec
+                           if args.population_spec is not None
+                           else cfg.population.spec_json)))
+            try:
+                # Validate at the CLI boundary (spec shape/range + the
+                # lr-schedule pin), not as a mid-startup stack trace.
+                from dist_dqn_tpu.population import resolve_spec as _rs
+                _rs(cfg)
+            except ValueError as e:
+                parser.error(str(e))
     # Run manifest (ISSUE 4 satellite): one provenance line per run —
     # git sha, versions, config hash, argv — reused verbatim by the
     # forensics bundles and served at /debug/config.

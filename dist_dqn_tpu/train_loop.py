@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from dist_dqn_tpu import loop_common
 from dist_dqn_tpu.agents.dqn import LearnerState, make_actor_step, \
-    make_learner
+    make_learner, make_population_optimizer, set_member_lr
 from dist_dqn_tpu.config import ExperimentConfig
 from dist_dqn_tpu.envs.base import JaxEnv
 from dist_dqn_tpu.replay import device as ring
@@ -30,6 +30,24 @@ from dist_dqn_tpu.replay import prioritized_device as pring
 from dist_dqn_tpu.types import PyTree
 
 Array = jnp.ndarray
+
+
+class MemberHP(NamedTuple):
+    """Per-member hyperparameters of the population plane (ISSUE 20).
+
+    Scalar f32 leaves under the vmapped member axis — [M] arrays at the
+    stacked entry points, member k's scalars inside the per-member body.
+    ``eps_delta`` is ``epsilon_start - epsilon_end`` folded on the host
+    in float64 then cast to f32 (the exact constant
+    ``optax.linear_schedule`` embeds — loop_common.make_member_epsilon).
+    ``lr`` is consumed only when the member optimizer is the injected
+    one (``member_lr=True``); it rides along untouched otherwise.
+    """
+
+    eps_delta: Array
+    eps_end: Array
+    gamma: Array
+    lr: Array
 
 
 class TrainCarry(NamedTuple):
@@ -48,18 +66,31 @@ class TrainCarry(NamedTuple):
 
 
 def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
-                     axis_name: Optional[str] = None, num_shards: int = 1):
+                     axis_name: Optional[str] = None, num_shards: int = 1,
+                     member_hp: bool = False, member_lr: bool = False):
     """Returns (init, run_chunk): ``run_chunk(carry, num_iters)`` executes
     ``num_iters`` fused iterations and reports aggregated metrics.
 
     With ``axis_name`` set the returned functions are per-device bodies to be
     wrapped in ``shard_map`` (parallel/learner.py); all sizes below become
     per-shard sizes and chunk metrics are psum-reduced to global values.
+
+    With ``member_hp`` set (the population plane, ISSUE 20) the returned
+    functions become the PER-MEMBER bodies population.py vmaps over the
+    member axis: ``init(rng, hp)`` / ``run_chunk(carry, hp, num_iters)``
+    take a :class:`MemberHP` of traced scalars, epsilon decays through
+    ``loop_common.make_member_epsilon`` (bit-identical to the solo
+    schedule per member) and ``hp.gamma`` threads into the n-step fold
+    at sample time. ``member_lr`` additionally swaps the optimizer for
+    :func:`make_population_optimizer` and seeds each member's
+    ``hp.lr`` into its opt_state. ``member_hp=False`` (every existing
+    caller) compiles the EXACT pre-knob program.
     """
     prioritized = cfg.replay.prioritized
     spmd = axis_name is not None
-    init_learner, train_step = make_learner(net, cfg.learner,
-                                            axis_name=axis_name)
+    init_learner, train_step = make_learner(
+        net, cfg.learner, axis_name=axis_name,
+        tx=make_population_optimizer(cfg.learner) if member_lr else None)
     act = make_actor_step(net)
     # Replay-ratio engine (ISSUE 6): each train event scans
     # updates_per_train * updates_per_chunk grad sub-steps over
@@ -87,6 +118,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                    else cfg.replay.store_final_obs)
 
     epsilon, beta_at = loop_common.make_schedules(cfg, B, num_shards)
+    eps_member = (loop_common.make_member_epsilon(cfg, B, num_shards)
+                  if member_hp else None)
     _split_rng = loop_common.make_rng_splitter(spmd)
     use_pallas, pallas_interpret = loop_common.pallas_routing(
         prioritized and cfg.replay.pallas_sampler)
@@ -130,7 +163,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                                                       frame_stack=stack)),
             iteration % cfg.train_every == 0)
 
-    def init(rng: Array) -> TrainCarry:
+    def init(rng: Array, hp: Optional[MemberHP] = None) -> TrainCarry:
         base = rng
         if spmd:
             # Per-device rng stream for envs/exploration; the learner init
@@ -159,6 +192,8 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                                          store_final_obs=store_final,
                                          merge_obs_rows=flat_storage)
         learner = init_learner(k_learn, obs_example)
+        if member_lr:
+            learner = set_member_lr(learner, hp.lr)
         zero = jnp.float32(0.0)
         return TrainCarry(env_state=env_state, obs=obs, replay=replay,
                           learner=learner,
@@ -168,10 +203,14 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                           completed_return=zero, completed_count=zero,
                           loss_sum=zero, train_count=zero)
 
-    def one_iteration(actor_params, carry: TrainCarry, _
+    def one_iteration(actor_params, hp, carry: TrainCarry, _
                       ) -> Tuple[TrainCarry, None]:
         rng, (k_act, k_sample) = _split_rng(carry.rng, 2)
-        eps = epsilon(carry.iteration)
+        # Population members decay epsilon through the traced-constant
+        # twin of the same schedule (bit-identical per member).
+        eps = (eps_member(carry.iteration, hp.eps_delta, hp.eps_end)
+               if member_hp else epsilon(carry.iteration))
+        gamma = hp.gamma if member_hp else cfg.learner.gamma
         # Dtype split (ISSUE 6): with actor_dtype="bfloat16" the actor
         # reads the bf16 snapshot cast once at chunk entry; otherwise
         # the live fp32 learner params, exactly the pre-split program.
@@ -198,7 +237,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                 if prioritized:
                     s = pring.prioritized_ring_sample(
                         rep, key, batch_size, cfg.learner.n_step,
-                        cfg.learner.gamma, cfg.replay.priority_exponent,
+                        gamma, cfg.replay.priority_exponent,
                         beta, use_pallas=use_pallas,
                         pallas_interpret=pallas_interpret,
                         merge_obs_rows=flat_storage,
@@ -219,7 +258,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
                 else:
                     batch = ring.time_ring_sample(rep, key, batch_size,
                                                   cfg.learner.n_step,
-                                                  cfg.learner.gamma,
+                                                  gamma,
                                                   merge_obs_rows=flat_storage,
                                                   frame_stack=stack,
                                                   frame_shape=_frame_shape)
@@ -261,13 +300,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
             loss_sum=carry.loss_sum + loss,
             train_count=carry.train_count + trained), None
 
-    def run_chunk(carry: TrainCarry, num_iters: int):
-        """Run ``num_iters`` iterations; returns (carry, summary metrics).
-
-        Chunk accumulators are zeroed on entry and (in SPMD mode) psum-
-        reduced into the reported metrics, then zeroed in the returned carry
-        so every accumulator leaf stays replicated across devices.
-        """
+    def _run_chunk(carry: TrainCarry, hp, num_iters: int):
         zero = jnp.float32(0.0)
         carry = carry._replace(completed_return=zero, completed_count=zero,
                                loss_sum=zero, train_count=zero)
@@ -277,7 +310,7 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
         actor_params = (_cast_actor(carry.learner.params)
                         if _actor_split else None)
         carry, _ = jax.lax.scan(
-            lambda c, x: one_iteration(actor_params, c, x),
+            lambda c, x: one_iteration(actor_params, hp, c, x),
             carry, None, length=num_iters)
         metrics, replace = loop_common.reduce_chunk_metrics(
             carry, axis_name, B, num_shards)
@@ -290,6 +323,22 @@ def make_fused_train(cfg: ExperimentConfig, env: JaxEnv, net,
             carry = carry._replace(**replace)
         return carry, metrics
 
+    def run_chunk(carry: TrainCarry, num_iters: int):
+        """Run ``num_iters`` iterations; returns (carry, summary metrics).
+
+        Chunk accumulators are zeroed on entry and (in SPMD mode) psum-
+        reduced into the reported metrics, then zeroed in the returned carry
+        so every accumulator leaf stays replicated across devices.
+        """
+        return _run_chunk(carry, None, num_iters)
+
+    def run_member_chunk(carry: TrainCarry, hp: MemberHP, num_iters: int):
+        """Per-member chunk body for the population vmap: identical to
+        ``run_chunk`` with member hyperparameters threaded through."""
+        return _run_chunk(carry, hp, num_iters)
+
+    if member_hp:
+        return init, run_member_chunk
     return init, run_chunk
 
 

@@ -233,7 +233,8 @@ class TrainCheckpointer:
 
     def restore_params(self, example_params: PyTree,
                        step: Optional[int] = None,
-                       prefix: Tuple[str, ...] = ()
+                       prefix: Tuple[str, ...] = (),
+                       member: Optional[int] = None
                        ) -> Optional[Tuple[int, PyTree]]:
         """Restore ONLY the policy parameters of a checkpoint.
 
@@ -248,15 +249,42 @@ class TrainCheckpointer:
         eval, and carry-kind checkpoints (``prefix=("learner",)``) no
         longer pay a ring-sized template either. Read-only: never
         advances the save schedule.
+
+        Population checkpoints (ISSUE 20) hold an [M]-stacked params
+        tree; ``member=k`` templates the stacked shape from the solo
+        ``example_params``, restores the stack and returns member k's
+        slice — so evaluate.py and the serving ModelStore serve any
+        single member of a population run without knowing how to train
+        one. Direction mismatches fail with the actual cause: a member
+        request against a solo directory, or a member-less restore of a
+        stacked directory (its leaves would come back [M]-leading and
+        shape-mismatch the live net downstream).
         """
         if step is None:
             step = self.latest_step()
         if step is None:
             return None
+        pop_size = read_population_size(self.directory)
+        if member is not None:
+            if pop_size is None:
+                raise ValueError(
+                    f"member={member} requested but {self.directory!r} "
+                    "is not a population checkpoint (no POPULATION "
+                    "width marker) — drop the member selector")
+            if not 0 <= member < pop_size:
+                raise ValueError(
+                    f"member={member} is out of range for a population-"
+                    f"{pop_size} checkpoint (members are 0-based)")
+        elif pop_size is not None:
+            raise ValueError(
+                f"{self.directory!r} holds a population-{pop_size} "
+                "[M]-stacked tree — pass member=k (evaluate.py "
+                "--member k) to extract one policy")
         default_dev = jax.local_devices()[0]
+        stack = (pop_size,) if member is not None else ()
         live_abs = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(
-                np.shape(x), x.dtype,
+                stack + tuple(np.shape(x)), x.dtype,
                 sharding=getattr(x, "sharding", None)
                 or jax.sharding.SingleDeviceSharding(default_dev)),
             example_params)
@@ -286,6 +314,8 @@ class TrainCheckpointer:
                 f"checkpoint restore left {len(bad)} parameter leaves "
                 f"unrestored (first: {bad[0]}) — network architecture "
                 "drift between save and eval.")
+        if member is not None:
+            out = jax.tree.map(lambda x: x[member], out)
         return int(step), out
 
     def _pytree_restore_mgr(self):
@@ -567,6 +597,47 @@ def read_checkpoint_kind(directory: str):
             return fh.read().strip() or None
     except OSError:
         return None
+
+
+_POPULATION_FILE = "POPULATION"
+
+
+def record_population_size(directory: str, size: int) -> None:
+    """Stamp a population run's member-axis width M (ISSUE 20). The
+    stacked tree's leading [M] axis is checkpoint STRUCTURE: resuming a
+    population-M' directory at a different --population would fail as
+    an opaque orbax shape mismatch, so — like the kind marker above —
+    the width is pinned up front and a mismatch says the actual cause
+    (callers count it under dqn_checkpoint_refused_resumes_total with
+    reason="population")."""
+    import os
+
+    existing = read_population_size(directory)
+    if existing is not None and existing != size:
+        raise ValueError(
+            f"checkpoint directory {directory!r} holds a population-"
+            f"{existing} stacked tree but this run trains --population "
+            f"{size} — the member axis is part of the checkpoint "
+            "structure. Resume with the same --population, use a fresh "
+            "--checkpoint-dir, or extract single members with "
+            "restore_params(member=k) / evaluate.py --member.")
+    if existing is None:
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, _POPULATION_FILE), "w") as fh:
+            fh.write(str(int(size)))
+
+
+def read_population_size(directory: str):
+    """The recorded member width M, or None (solo directories — every
+    pre-population checkpoint by construction)."""
+    import os
+
+    try:
+        with open(os.path.join(directory, _POPULATION_FILE)) as fh:
+            text = fh.read().strip()
+    except OSError:
+        return None
+    return int(text) if text else None
 
 
 def list_checkpoint_steps(directory: str) -> Tuple[int, ...]:

@@ -34,7 +34,7 @@ from typing import Dict, Iterable, Tuple
 #: Bump on ANY change to the field set below, and append the new
 #: (version, digest) pair to SIDECAR_HISTORY — scripts/check_ckpt_schema.py
 #: prints the expected digest on mismatch.
-SIDECAR_VERSION = 3
+SIDECAR_VERSION = 4
 
 #: Scalar fields present in every host_loop sidecar.
 SIDECAR_SCALAR_FIELDS: Tuple[str, ...] = (
@@ -65,6 +65,13 @@ SIDECAR_SCALAR_FIELDS: Tuple[str, ...] = (
                          # resume that silently swapped backends would
                          # break the bit-identical-resume contract;
                          # refuse loudly instead (reason=sampler_kind)
+    "population",        # v4 (ISSUE 20): member-axis width pin — the
+                         # host-replay runtime has no stacked-member
+                         # plane yet so its writer always stamps 1; a
+                         # sidecar stamped differently (a future
+                         # population-capable writer) cannot resume
+                         # into this loop's solo state shapes — refuse
+                         # loudly instead (reason=population)
 )
 
 #: Conditional scalars: present only when their ``has_*`` flag is set.
@@ -117,6 +124,7 @@ SIDECAR_HISTORY: Dict[int, str] = {
     1: "948b5e00114da529",
     2: "0e038b7fe0331a3d",
     3: "8ef0d7a524f3d7d3",
+    4: "a21f0ff7cab3aeb5",
 }
 
 _COMPILED = None

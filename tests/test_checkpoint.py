@@ -512,7 +512,8 @@ def test_host_all_steps_skips_only_missing_checkpoints(tmp_path, capsys):
     ckpt.wait()
     ckpt.close()
 
-    def fake_host_eval(cfg, ckpt_dir, host_env, episodes, seed, step):
+    def fake_host_eval(cfg, ckpt_dir, host_env, episodes, seed, step,
+                       member=None):
         if step == 100:
             raise ev.CheckpointMissingError("step 100 vanished")
         return {"eval_return": 1.0, "frames": step, "episodes": episodes,
